@@ -1,0 +1,22 @@
+"""dynamo-tpu: a TPU-native distributed LLM inference-serving framework.
+
+A ground-up rebuild of the capability surface of NVIDIA Dynamo
+(reference: /root/reference) designed for TPU hardware:
+
+- a distributed component runtime with lease-based discovery and a typed
+  streaming pipeline (reference: lib/runtime/*),
+- an OpenAI-compatible HTTP frontend with preprocessing/detokenization
+  operators (reference: lib/llm/src/http, preprocessor.rs, backend.rs),
+- a *native* JAX/XLA inference engine — continuous batching over a paged KV
+  cache with Pallas attention kernels, sharded over a `jax.sharding.Mesh`
+  (the reference outsources this to vLLM/sglang; here it is first-class),
+- KV-cache-aware routing (reference: lib/llm/src/kv_router/*),
+- disaggregated prefill/decode with an ICI/DCN KV-transfer path
+  (reference: NIXL + vLLM patch),
+- a planner, SDK and CLIs (reference: deploy/dynamo/sdk, launch/*).
+
+Infrastructure services (discovery, events, queues) are provided by the
+built-in `hub` — no external etcd/NATS processes are required.
+"""
+
+__version__ = "0.1.0"

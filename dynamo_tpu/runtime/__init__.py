@@ -1,0 +1,33 @@
+"""Distributed component runtime.
+
+TPU-native rebuild of the reference's `dynamo-runtime` crate
+(reference: lib/runtime/src/lib.rs): Runtime/DistributedRuntime,
+Namespace/Component/Endpoint addressing, lease-based discovery, a typed
+streaming pipeline, and the network planes. Discovery/events/queues are served
+by the built-in hub (`dynamo_tpu.runtime.hub`) instead of external etcd/NATS.
+"""
+
+__all__ = [
+    "Runtime",
+    "Worker",
+    "DistributedRuntime",
+    "Namespace",
+    "Component",
+    "Endpoint",
+]
+
+
+def __getattr__(name):  # lazy to keep `import dynamo_tpu.runtime.hub` light
+    if name in ("Runtime", "Worker"):
+        from dynamo_tpu.runtime import runtime as _m
+
+        return getattr(_m, name)
+    if name in ("DistributedRuntime",):
+        from dynamo_tpu.runtime import distributed as _m
+
+        return getattr(_m, name)
+    if name in ("Namespace", "Component", "Endpoint"):
+        from dynamo_tpu.runtime import component as _m
+
+        return getattr(_m, name)
+    raise AttributeError(name)

@@ -1,0 +1,218 @@
+"""Client: live instance discovery + routed streaming requests.
+
+Combines the reference's `Client` (etcd prefix watch → live endpoint set,
+reference: lib/runtime/src/component/client.rs:52-190) and `PushRouter`
+(random / round-robin / direct / KV-aware instance selection, reference:
+lib/runtime/src/pipeline/network/egress/push_router.rs:35-191). Requests go
+straight over the data plane to the chosen instance; the response is a
+deserialized async stream. Caller-side cancellation propagates as stop/kill
+frames.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import random as _random
+from typing import Any, AsyncIterator, Optional
+
+from dynamo_tpu.runtime.component import (
+    EndpointId,
+    InstanceInfo,
+    pack_payload,
+    unpack_payload,
+)
+from dynamo_tpu.runtime.pipeline.context import Context
+from dynamo_tpu.utils.logging import get_logger
+
+log = get_logger("dynamo_tpu.client")
+
+
+class NoInstancesError(RuntimeError):
+    pass
+
+
+class Client:
+    """Tracks live instances of one endpoint via a hub prefix watch."""
+
+    def __init__(self, drt, endpoint_id: EndpointId):
+        self._drt = drt
+        self.endpoint_id = endpoint_id
+        self.instances: dict[int, InstanceInfo] = {}
+        self._watch = None
+        self._watch_task: Optional[asyncio.Task] = None
+        self._changed = asyncio.Event()
+        self._rr_index = 0
+
+    @classmethod
+    async def new_dynamic(cls, drt, endpoint_id: EndpointId) -> "Client":
+        self = cls(drt, endpoint_id)
+        self._watch = await drt.hub.watch_prefix(endpoint_id.instance_root)
+        for item in self._watch.snapshot:
+            info = InstanceInfo.unpack(item["value"])
+            self.instances[info.worker_id] = info
+        self._watch_task = asyncio.create_task(self._watch_loop())
+        return self
+
+    @classmethod
+    def new_static(cls, drt, endpoint_id: EndpointId, address: str) -> "Client":
+        """Static mode: fixed single instance, no discovery (reference:
+        `is_static` runtimes, lib/runtime/src/distributed.rs:160-187)."""
+        self = cls(drt, endpoint_id)
+        info = InstanceInfo(
+            endpoint=endpoint_id.subject, address=address, worker_id=0, lease_id=0
+        )
+        self.instances[0] = info
+        return self
+
+    async def _watch_loop(self) -> None:
+        async for ev in self._watch:
+            worker_hex = ev["key"].rsplit("/", 1)[-1]
+            try:
+                worker_id = int(worker_hex, 16)
+            except ValueError:
+                continue
+            if ev["type"] == "put":
+                info = InstanceInfo.unpack(ev["value"])
+                self.instances[info.worker_id] = info
+                log.debug("instance up: %s %x", info.endpoint, info.worker_id)
+            else:
+                self.instances.pop(worker_id, None)
+                log.debug("instance down: %s %x", self.endpoint_id.subject, worker_id)
+                self._drt.notify_instance_down(self.endpoint_id, worker_id)
+            self._changed.set()
+            self._changed = asyncio.Event()
+
+    def instance_ids(self) -> list[int]:
+        return sorted(self.instances.keys())
+
+    async def wait_for_instances(self, timeout: float = 30.0) -> list[int]:
+        """Block until ≥1 instance is live (reference: client.rs
+        wait_for_endpoints)."""
+        deadline = asyncio.get_running_loop().time() + timeout
+        while not self.instances:
+            remaining = deadline - asyncio.get_running_loop().time()
+            if remaining <= 0:
+                raise TimeoutError(
+                    f"no instances of {self.endpoint_id.subject} within {timeout}s"
+                )
+            event = self._changed
+            with contextlib.suppress(asyncio.TimeoutError):
+                await asyncio.wait_for(event.wait(), remaining)
+        return self.instance_ids()
+
+    async def close(self) -> None:
+        if self._watch_task:
+            self._watch_task.cancel()
+            self._watch_task = None
+        if self._watch:
+            await self._watch.cancel()
+
+    # ------------------------------------------------------------- routing
+
+    def _pick(self, mode: str, instance_id: Optional[int]) -> InstanceInfo:
+        if not self.instances:
+            raise NoInstancesError(f"no live instances of {self.endpoint_id.subject}")
+        if mode == "direct":
+            if instance_id is None:
+                raise ValueError("direct routing requires instance_id")
+            info = self.instances.get(instance_id)
+            if info is None:
+                raise NoInstancesError(
+                    f"instance {instance_id:x} of {self.endpoint_id.subject} not found"
+                )
+            return info
+        ids = sorted(self.instances.keys())
+        if mode == "round_robin":
+            self._rr_index = (self._rr_index + 1) % len(ids)
+            return self.instances[ids[self._rr_index]]
+        return self.instances[_random.choice(ids)]  # "random"
+
+    async def generate(
+        self,
+        payload: Any,
+        context: Optional[Context] = None,
+        mode: str = "random",
+        instance_id: Optional[int] = None,
+    ) -> AsyncIterator[Any]:
+        """Route one request; returns a typed async response stream."""
+        info = self._pick(mode, instance_id)
+        ctx = context or Context(payload)
+        handle = await self._drt.data_plane_client.request(
+            info.address,
+            self.endpoint_id.subject,
+            pack_payload(payload),
+            request_id=ctx.id,
+            metadata=ctx.metadata,
+        )
+
+        async def _stream() -> AsyncIterator[Any]:
+            monitor = asyncio.create_task(_propagate_cancel(ctx, handle))
+            try:
+                async for raw in handle:
+                    yield unpack_payload(raw)
+            finally:
+                monitor.cancel()
+
+        return _stream()
+
+    async def random(self, payload: Any, **kw) -> AsyncIterator[Any]:
+        return await self.generate(payload, mode="random", **kw)
+
+    async def round_robin(self, payload: Any, **kw) -> AsyncIterator[Any]:
+        return await self.generate(payload, mode="round_robin", **kw)
+
+    async def direct(self, payload: Any, instance_id: int, **kw) -> AsyncIterator[Any]:
+        return await self.generate(payload, mode="direct", instance_id=instance_id, **kw)
+
+    async def scrape_stats(self, timeout: float = 2.0) -> dict[int, dict]:
+        """Poll every live instance's stats handler (reference: NATS
+        $SRV.STATS scrape, lib/runtime/src/transports/nats.rs:109-121)."""
+        results: dict[int, dict] = {}
+
+        async def _one(worker_id: int, info: InstanceInfo) -> None:
+            try:
+                handle = await self._drt.data_plane_client.request(
+                    info.address, f"{self.endpoint_id.subject}/stats", b"\xc0"
+                )
+                async for raw in handle:
+                    results[worker_id] = unpack_payload(raw)
+            except Exception:  # noqa: BLE001 — a dead worker just drops out
+                pass
+
+        tasks = [
+            asyncio.create_task(_one(wid, info)) for wid, info in self.instances.items()
+        ]
+        if tasks:
+            await asyncio.wait(tasks, timeout=timeout)
+            for t in tasks:
+                t.cancel()
+        return results
+
+
+async def _propagate_cancel(ctx: Context, handle) -> None:
+    with contextlib.suppress(asyncio.CancelledError, ConnectionError):
+        await ctx.controller.stopped()
+        if ctx.is_killed():
+            await handle.kill()
+        else:
+            await handle.stop()
+
+
+class PushRouter:
+    """Mode-carrying wrapper over Client, mirroring the reference API
+    (push_router.rs:35-70). KV-aware mode lives in
+    `dynamo_tpu.kv_router.KvPushRouter` which subclasses this."""
+
+    def __init__(self, client: Client, mode: str = "random"):
+        self.client = client
+        self.mode = mode
+
+    @classmethod
+    async def from_client(cls, client: Client, mode: str = "random") -> "PushRouter":
+        return cls(client, mode)
+
+    async def generate(
+        self, payload: Any, context: Optional[Context] = None
+    ) -> AsyncIterator[Any]:
+        return await self.client.generate(payload, context=context, mode=self.mode)

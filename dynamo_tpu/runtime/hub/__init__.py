@@ -1,0 +1,22 @@
+"""The hub: dynamo-tpu's built-in control plane.
+
+One lightweight asyncio TCP service providing everything the reference gets
+from external etcd + NATS processes (reference: lib/runtime/src/transports/
+{etcd.rs,nats.rs}):
+
+- lease-based key-value store with prefix watch (discovery / liveness),
+- create-if-absent transactions,
+- pub/sub subjects (events plane, e.g. KV-cache events),
+- durable FIFO queues with competing consumers (prefill queue),
+- an object store (model deployment card artifacts).
+
+Wire format is 4-byte length-prefixed msgpack frames (`codec.py`). The hub is
+intentionally a single-process, single-loop service: serving control traffic
+for a TPU pod is orders of magnitude below its capacity, and a single loop
+gives linearizable semantics for free.
+"""
+
+from dynamo_tpu.runtime.hub.server import HubServer
+from dynamo_tpu.runtime.hub.client import HubClient, Lease, PrefixWatch, Subscription
+
+__all__ = ["HubServer", "HubClient", "Lease", "PrefixWatch", "Subscription"]

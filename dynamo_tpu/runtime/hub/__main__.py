@@ -1,0 +1,29 @@
+"""Run a standalone hub: ``python -m dynamo_tpu.runtime.hub [--port 2379]``."""
+
+import argparse
+import asyncio
+
+from dynamo_tpu.runtime.hub.server import HubServer
+from dynamo_tpu.utils.logging import configure_logging
+
+
+async def _main(host: str, port: int) -> None:
+    hub = HubServer()
+    await hub.start(host, port)
+    await hub.serve_forever()
+
+
+def main() -> None:
+    configure_logging()
+    parser = argparse.ArgumentParser(description="dynamo-tpu hub (control plane)")
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=2379)
+    args = parser.parse_args()
+    try:
+        asyncio.run(_main(args.host, args.port))
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

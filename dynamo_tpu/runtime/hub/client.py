@@ -1,0 +1,322 @@
+"""Hub client: async API over the hub wire protocol.
+
+Plays the role of the reference's etcd::Client + nats::Client pair
+(reference: lib/runtime/src/transports/etcd.rs:41-80, nats.rs:50-121):
+request/reply with correlation ids, pushed watch/subscription events routed to
+per-watch queues, and a `Lease` handle with an automatic keepalive task.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import os
+from typing import Any, AsyncIterator, Optional
+
+from dynamo_tpu.runtime.hub import codec
+from dynamo_tpu.utils.logging import get_logger
+
+log = get_logger("dynamo_tpu.hub.client")
+
+DEFAULT_HUB_ADDR = "127.0.0.1:2379"
+
+
+def hub_addr_from_env() -> str:
+    return os.environ.get("DYN_HUB_ADDR", DEFAULT_HUB_ADDR)
+
+
+class HubError(RuntimeError):
+    pass
+
+
+class Lease:
+    """A granted lease with background keepalive.
+
+    Keepalives are sent at ttl/3; `revoke()` (or hub-side expiry after the
+    process dies) deletes every key attached to the lease — this is the
+    liveness primitive for service discovery (reference:
+    lib/runtime/src/transports/etcd.rs lease keep-alive; lease.rs).
+    """
+
+    def __init__(self, client: "HubClient", lease_id: int, ttl: float):
+        self.client = client
+        self.lease_id = lease_id
+        self.ttl = ttl
+        self._task: Optional[asyncio.Task] = None
+        self._revoked = False
+
+    def start_keepalive(self) -> None:
+        if self._task is None:
+            self._task = asyncio.create_task(self._keepalive_loop())
+
+    async def _keepalive_loop(self) -> None:
+        try:
+            while not self._revoked:
+                await asyncio.sleep(self.ttl / 3.0)
+                ok = await self.client.request("lease_keepalive", lease_id=self.lease_id)
+                if not ok:
+                    log.warning("lease %#x no longer valid", self.lease_id)
+                    return
+        except (asyncio.CancelledError, ConnectionError):
+            pass
+
+    async def is_valid(self) -> bool:
+        if self._revoked:
+            return False
+        return bool(await self.client.request("lease_is_valid", lease_id=self.lease_id))
+
+    async def revoke(self) -> None:
+        if self._revoked:
+            return
+        self._revoked = True
+        if self._task:
+            self._task.cancel()
+            self._task = None
+        try:
+            await self.client.request("lease_revoke", lease_id=self.lease_id)
+        except (ConnectionError, HubError):
+            pass
+
+
+class PrefixWatch:
+    """Snapshot + live put/delete events for a key prefix."""
+
+    def __init__(self, client: "HubClient", watch_id: int, snapshot: list[dict]):
+        self.client = client
+        self.watch_id = watch_id
+        self.snapshot = snapshot
+        self.events: asyncio.Queue[dict] = asyncio.Queue()
+
+    async def next(self, timeout: float | None = None) -> dict | None:
+        try:
+            return await asyncio.wait_for(self.events.get(), timeout)
+        except asyncio.TimeoutError:
+            return None
+
+    def __aiter__(self) -> AsyncIterator[dict]:
+        return self._iter()
+
+    async def _iter(self) -> AsyncIterator[dict]:
+        while True:
+            ev = await self.events.get()
+            if ev is None:  # closed
+                return
+            yield ev
+
+    async def cancel(self) -> None:
+        self.client._pushes.pop(self.watch_id, None)
+        try:
+            await self.client.request("watch_cancel", watch_id=self.watch_id)
+        except (ConnectionError, HubError):
+            pass
+        self.events.put_nowait(None)
+
+
+class Subscription:
+    """A pub/sub subscription delivering `{subject, data}` events."""
+
+    def __init__(self, client: "HubClient", sub_id: int):
+        self.client = client
+        self.sub_id = sub_id
+        self.events: asyncio.Queue[dict] = asyncio.Queue()
+
+    async def next(self, timeout: float | None = None) -> dict | None:
+        try:
+            return await asyncio.wait_for(self.events.get(), timeout)
+        except asyncio.TimeoutError:
+            return None
+
+    def __aiter__(self) -> AsyncIterator[dict]:
+        return self._iter()
+
+    async def _iter(self) -> AsyncIterator[dict]:
+        while True:
+            ev = await self.events.get()
+            if ev is None:
+                return
+            yield ev
+
+    async def unsubscribe(self) -> None:
+        self.client._pushes.pop(self.sub_id, None)
+        try:
+            await self.client.request("unsubscribe", sub_id=self.sub_id)
+        except (ConnectionError, HubError):
+            pass
+        self.events.put_nowait(None)
+
+
+class HubClient:
+    def __init__(self) -> None:
+        self._reader: Optional[asyncio.StreamReader] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._req_ids = itertools.count(1)
+        self._pending: dict[int, asyncio.Future] = {}
+        # Client-chosen push ids (shared counter for watches and subs); the
+        # delivery queue is registered *before* the watch/subscribe request is
+        # sent, so a push can never race the registration.
+        self._push_ids = itertools.count(1)
+        self._pushes: dict[int, asyncio.Queue] = {}
+        self._recv_task: Optional[asyncio.Task] = None
+        self._closed = False
+        self.addr = ""
+
+    # ------------------------------------------------------------- lifecycle
+
+    @classmethod
+    async def connect(cls, addr: str | None = None) -> "HubClient":
+        self = cls()
+        self.addr = addr or hub_addr_from_env()
+        host, port = self.addr.rsplit(":", 1)
+        self._reader, self._writer = await asyncio.open_connection(host, int(port))
+        self._recv_task = asyncio.create_task(self._recv_loop())
+        return self
+
+    async def close(self) -> None:
+        self._closed = True
+        if self._recv_task:
+            self._recv_task.cancel()
+            self._recv_task = None
+        if self._writer:
+            self._writer.close()
+            self._writer = None
+        for fut in self._pending.values():
+            if not fut.done():
+                fut.set_exception(ConnectionError("hub client closed"))
+        self._pending.clear()
+
+    async def _recv_loop(self) -> None:
+        assert self._reader is not None
+        try:
+            while True:
+                msg = await codec.read_frame(self._reader)
+                if msg is None:
+                    break
+                if "push" in msg:
+                    self._route_push(msg["push"], msg["ev"])
+                    continue
+                fut = self._pending.pop(msg.get("i"), None)
+                if fut is None or fut.done():
+                    continue
+                if msg.get("ok"):
+                    fut.set_result(msg.get("r"))
+                else:
+                    fut.set_exception(HubError(msg.get("e", "hub error")))
+        except asyncio.CancelledError:
+            return
+        finally:
+            if not self._closed:
+                for fut in self._pending.values():
+                    if not fut.done():
+                        fut.set_exception(ConnectionError("hub connection lost"))
+                self._pending.clear()
+                for q in self._pushes.values():
+                    q.put_nowait(None)
+
+    def _route_push(self, push_id: int, ev: dict) -> None:
+        q = self._pushes.get(push_id)
+        if q is not None:
+            q.put_nowait(ev)
+
+    async def request(self, op: str, **args: Any) -> Any:
+        if self._writer is None:
+            raise ConnectionError("hub client not connected")
+        req_id = next(self._req_ids)
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending[req_id] = fut
+        codec.write_frame(self._writer, {"i": req_id, "op": op, **args})
+        await self._writer.drain()
+        return await fut
+
+    # -------------------------------------------------------------------- kv
+
+    async def kv_put(self, key: str, value: bytes, lease: Lease | int | None = None) -> int:
+        lease_id = lease.lease_id if isinstance(lease, Lease) else (lease or 0)
+        return await self.request("kv_put", key=key, value=value, lease=lease_id)
+
+    async def kv_get(self, key: str) -> Optional[dict]:
+        return await self.request("kv_get", key=key)
+
+    async def kv_get_prefix(self, prefix: str) -> list[dict]:
+        return await self.request("kv_get_prefix", prefix=prefix)
+
+    async def kv_del(self, key: str, prefix: bool = False) -> int:
+        return await self.request("kv_del", key=key, prefix=prefix)
+
+    async def kv_create(self, key: str, value: bytes, lease: Lease | int | None = None) -> bool:
+        lease_id = lease.lease_id if isinstance(lease, Lease) else (lease or 0)
+        return await self.request("kv_create", key=key, value=value, lease=lease_id)
+
+    async def kv_create_or_validate(self, key: str, value: bytes) -> bool:
+        return await self.request("kv_create_or_validate", key=key, value=value)
+
+    async def watch_prefix(self, prefix: str) -> PrefixWatch:
+        wid = next(self._push_ids)
+        watch = PrefixWatch(self, wid, [])
+        self._pushes[wid] = watch.events
+        try:
+            r = await self.request("watch_prefix", prefix=prefix, watch_id=wid)
+        except BaseException:
+            self._pushes.pop(wid, None)
+            raise
+        watch.snapshot = r["snapshot"]
+        return watch
+
+    # ---------------------------------------------------------------- leases
+
+    async def lease_grant(self, ttl: float = 10.0, keepalive: bool = True) -> Lease:
+        r = await self.request("lease_grant", ttl=ttl)
+        lease = Lease(self, r["lease_id"], r["ttl"])
+        if keepalive:
+            lease.start_keepalive()
+        return lease
+
+    # --------------------------------------------------------------- pub/sub
+
+    async def publish(self, subject: str, data: bytes) -> int:
+        return await self.request("publish", subject=subject, data=data)
+
+    async def subscribe(self, subject: str) -> Subscription:
+        sid = next(self._push_ids)
+        sub = Subscription(self, sid)
+        self._pushes[sid] = sub.events
+        try:
+            await self.request("subscribe", subject=subject, sub_id=sid)
+        except BaseException:
+            self._pushes.pop(sid, None)
+            raise
+        return sub
+
+    # ---------------------------------------------------------------- queues
+
+    async def q_push(self, name: str, data: bytes) -> int:
+        return await self.request("q_push", name=name, data=data)
+
+    async def q_pop(
+        self, name: str, block: bool = False, timeout: float | None = None
+    ) -> Optional[bytes]:
+        return await self.request("q_pop", name=name, block=block, timeout=timeout)
+
+    async def q_len(self, name: str) -> int:
+        return await self.request("q_len", name=name)
+
+    # ------------------------------------------------------------ object store
+
+    async def obj_put(self, bucket: str, name: str, data: bytes) -> bool:
+        return await self.request("obj_put", bucket=bucket, name=name, data=data)
+
+    async def obj_get(self, bucket: str, name: str) -> Optional[bytes]:
+        return await self.request("obj_get", bucket=bucket, name=name)
+
+    async def obj_del(self, bucket: str, name: str) -> bool:
+        return await self.request("obj_del", bucket=bucket, name=name)
+
+    async def obj_list(self, bucket: str) -> list[str]:
+        return await self.request("obj_list", bucket=bucket)
+
+    # ------------------------------------------------------------------ misc
+
+    async def ping(self) -> str:
+        return await self.request("ping")
+
+    async def stats(self) -> dict:
+        return await self.request("stats")

@@ -1,0 +1,13 @@
+"""Typed streaming pipeline: Context, AsyncEngine, Operators.
+
+Rebuild of the reference's pipeline module (reference: lib/runtime/src/
+{engine.rs,pipeline.rs,pipeline/nodes.rs,pipeline/context.rs}) in idiomatic
+async Python: engines are `generate(Context[T]) -> AsyncIterator[U]`,
+operators are middleware that transform the request on the way in and the
+response stream on the way out.
+"""
+
+from dynamo_tpu.runtime.pipeline.context import Context, StreamController
+from dynamo_tpu.runtime.pipeline.engine import AsyncEngine, Operator, link
+
+__all__ = ["Context", "StreamController", "AsyncEngine", "Operator", "link"]

@@ -1,0 +1,4 @@
+from dynamo_tpu.utils.logging import configure_logging, get_logger
+from dynamo_tpu.utils.pool import Pool, PoolItem, SharedPoolItem
+
+__all__ = ["configure_logging", "get_logger", "Pool", "PoolItem", "SharedPoolItem"]

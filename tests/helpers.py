@@ -1,0 +1,29 @@
+"""Shared async helpers for tests (used instead of async pytest fixtures)."""
+
+from __future__ import annotations
+
+import contextlib
+
+from dynamo_tpu.runtime.hub.client import HubClient
+from dynamo_tpu.runtime.hub.server import HubServer
+
+
+@contextlib.asynccontextmanager
+async def hub_server():
+    server = HubServer()
+    await server.start("127.0.0.1", 0)
+    try:
+        yield server
+    finally:
+        await server.stop()
+
+
+@contextlib.asynccontextmanager
+async def hub_pair():
+    """An in-process hub plus one connected client."""
+    async with hub_server() as server:
+        client = await HubClient.connect(f"127.0.0.1:{server.port}")
+        try:
+            yield server, client
+        finally:
+            await client.close()

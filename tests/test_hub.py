@@ -1,0 +1,215 @@
+"""Hub control-plane tests: KV/watch/lease/pubsub/queue/object-store semantics.
+
+Coverage mirrors what the reference exercises against real etcd/nats in
+lib/bindings/python/tests/test_etcd_bindings.py and test_kv_bindings.py,
+but against the built-in hub.
+"""
+
+import asyncio
+
+from dynamo_tpu.runtime.hub.client import HubClient
+from dynamo_tpu.runtime.hub.server import subject_matches
+
+from .helpers import hub_pair, hub_server
+
+
+async def test_kv_put_get_del():
+    async with hub_pair() as (_, c):
+        rev1 = await c.kv_put("/a/b", b"one")
+        rev2 = await c.kv_put("/a/c", b"two")
+        assert rev2 > rev1
+        got = await c.kv_get("/a/b")
+        assert got["value"] == b"one"
+        items = await c.kv_get_prefix("/a/")
+        assert {i["key"] for i in items} == {"/a/b", "/a/c"}
+        assert await c.kv_del("/a/b") == 1
+        assert await c.kv_get("/a/b") is None
+        assert await c.kv_del("/a/", prefix=True) == 1
+
+
+async def test_kv_create_if_absent():
+    async with hub_pair() as (_, c):
+        assert await c.kv_create("/x", b"1") is True
+        assert await c.kv_create("/x", b"2") is False
+        assert (await c.kv_get("/x"))["value"] == b"1"
+        assert await c.kv_create_or_validate("/x", b"1") is True
+        assert await c.kv_create_or_validate("/x", b"9") is False
+
+
+async def test_watch_prefix_events():
+    async with hub_pair() as (_, c):
+        await c.kv_put("/svc/a", b"A")
+        watch = await c.watch_prefix("/svc/")
+        assert [e["key"] for e in watch.snapshot] == ["/svc/a"]
+        await c.kv_put("/svc/b", b"B")
+        ev = await watch.next(timeout=2)
+        assert ev["type"] == "put" and ev["key"] == "/svc/b" and ev["value"] == b"B"
+        await c.kv_del("/svc/a")
+        ev = await watch.next(timeout=2)
+        assert ev["type"] == "delete" and ev["key"] == "/svc/a"
+        await watch.cancel()
+        await c.kv_put("/svc/c", b"C")
+        assert await watch.next(timeout=0.2) is None
+
+
+async def test_lease_expiry_deletes_keys_and_fires_watch():
+    async with hub_server() as server:
+        c1 = await HubClient.connect(f"127.0.0.1:{server.port}")
+        c2 = await HubClient.connect(f"127.0.0.1:{server.port}")
+        try:
+            watch = await c2.watch_prefix("/ep/")
+            lease = await c1.lease_grant(ttl=0.5, keepalive=False)
+            await c1.kv_put("/ep/worker1", b"addr", lease=lease)
+            assert (await c2.kv_get("/ep/worker1"))["value"] == b"addr"
+            ev = await watch.next(timeout=3)
+            assert ev["type"] == "put" and ev["key"] == "/ep/worker1"
+            # no keepalive → expires after ~0.5s (+tick)
+            ev = await watch.next(timeout=3)
+            assert ev["type"] == "delete" and ev["key"] == "/ep/worker1"
+            assert await c2.kv_get("/ep/worker1") is None
+            assert await lease.is_valid() is False
+        finally:
+            await c1.close()
+            await c2.close()
+
+
+async def test_lease_keepalive_sustains_keys():
+    async with hub_pair() as (_, c):
+        lease = await c.lease_grant(ttl=0.4)  # keepalive task running
+        await c.kv_put("/ka/k", b"v", lease=lease)
+        await asyncio.sleep(1.2)  # several ttl periods
+        assert (await c.kv_get("/ka/k"))["value"] == b"v"
+        await lease.revoke()
+        assert await c.kv_get("/ka/k") is None
+
+
+async def test_pubsub_with_wildcard():
+    async with hub_server() as server:
+        pub = await HubClient.connect(f"127.0.0.1:{server.port}")
+        sub_c = await HubClient.connect(f"127.0.0.1:{server.port}")
+        try:
+            exact = await sub_c.subscribe("ns.comp.kv_events")
+            wild = await sub_c.subscribe("ns.>")
+            n = await pub.publish("ns.comp.kv_events", b"ev1")
+            assert n == 2
+            e1 = await exact.next(timeout=2)
+            assert e1["subject"] == "ns.comp.kv_events" and e1["data"] == b"ev1"
+            e2 = await wild.next(timeout=2)
+            assert e2["data"] == b"ev1"
+            await exact.unsubscribe()
+            assert await pub.publish("ns.comp.kv_events", b"ev2") == 1
+        finally:
+            await pub.close()
+            await sub_c.close()
+
+
+def test_subject_matching():
+    assert subject_matches("a.b", "a.b")
+    assert not subject_matches("a.b", "a.b.c")
+    assert subject_matches("a.>", "a.b.c")
+    assert subject_matches("a.>", "a")
+    assert not subject_matches("a.>", "ab.c")
+
+
+async def test_queue_fifo_and_blocking_pop():
+    async with hub_server() as server:
+        c1 = await HubClient.connect(f"127.0.0.1:{server.port}")
+        c2 = await HubClient.connect(f"127.0.0.1:{server.port}")
+        try:
+            assert await c1.q_pop("prefill") is None
+            await c1.q_push("prefill", b"r1")
+            await c1.q_push("prefill", b"r2")
+            assert await c1.q_len("prefill") == 2
+            assert await c2.q_pop("prefill") == b"r1"
+            assert await c2.q_pop("prefill") == b"r2"
+            # blocking pop woken by later push
+            pop_task = asyncio.create_task(c2.q_pop("prefill", block=True, timeout=5))
+            await asyncio.sleep(0.05)
+            await c1.q_push("prefill", b"r3")
+            assert await pop_task == b"r3"
+            # blocking pop timeout
+            assert await c2.q_pop("prefill", block=True, timeout=0.1) is None
+        finally:
+            await c1.close()
+            await c2.close()
+
+
+async def test_blocking_pop_does_not_starve_keepalives():
+    """Regression: a blocking q_pop on a connection must not head-of-line
+    block lease keepalives multiplexed on the same connection."""
+    async with hub_pair() as (_, c):
+        lease = await c.lease_grant(ttl=0.5)  # keepalive task running
+        await c.kv_put("/hol/k", b"v", lease=lease)
+        # block for several TTL periods with no producer
+        assert await c.q_pop("empty-q", block=True, timeout=1.6) is None
+        assert (await c.kv_get("/hol/k"))["value"] == b"v"
+        assert await lease.is_valid() is True
+
+
+async def test_dead_consumer_does_not_swallow_queue_item():
+    """Regression: a waiter whose connection died must not receive (and lose)
+    a pushed queue item."""
+    async with hub_server() as server:
+        dead = await HubClient.connect(f"127.0.0.1:{server.port}")
+        pop_task = asyncio.create_task(dead.q_pop("jobs", block=True, timeout=30))
+        await asyncio.sleep(0.1)  # let the pop register its waiter
+        await dead.close()
+        pop_task.cancel()
+        await asyncio.sleep(0.1)  # let the hub drop the connection
+        live = await HubClient.connect(f"127.0.0.1:{server.port}")
+        try:
+            await live.q_push("jobs", b"job1")
+            assert await live.q_pop("jobs", block=True, timeout=2) == b"job1"
+        finally:
+            await live.close()
+
+
+async def test_watch_registered_before_racing_events():
+    """Regression: events arriving immediately after the watch reply must be
+    delivered (queue is registered before the request is sent)."""
+    async with hub_server() as server:
+        writer = await HubClient.connect(f"127.0.0.1:{server.port}")
+        watcher = await HubClient.connect(f"127.0.0.1:{server.port}")
+        try:
+            for round_i in range(20):
+                watch = await watcher.watch_prefix(f"/race{round_i}/")
+                await writer.kv_put(f"/race{round_i}/k", b"v")
+                ev = await watch.next(timeout=2)
+                assert ev is not None and ev["key"] == f"/race{round_i}/k"
+                await watch.cancel()
+                assert not watcher._pushes  # no leaked queues after cancel
+        finally:
+            await writer.close()
+            await watcher.close()
+
+
+async def test_object_store():
+    async with hub_pair() as (_, c):
+        blob = bytes(range(256)) * 100
+        await c.obj_put("mdc", "tokenizer.json", blob)
+        assert await c.obj_get("mdc", "tokenizer.json") == blob
+        assert await c.obj_list("mdc") == ["tokenizer.json"]
+        assert await c.obj_del("mdc", "tokenizer.json") is True
+        assert await c.obj_get("mdc", "tokenizer.json") is None
+
+
+async def test_concurrent_clients_many_ops():
+    """Smoke: many clients hammering KV + pubsub concurrently."""
+    async with hub_server() as server:
+
+        async def worker(i: int):
+            c = await HubClient.connect(f"127.0.0.1:{server.port}")
+            try:
+                for j in range(20):
+                    await c.kv_put(f"/load/{i}/{j}", f"v{j}".encode())
+                items = await c.kv_get_prefix(f"/load/{i}/")
+                assert len(items) == 20
+            finally:
+                await c.close()
+
+        await asyncio.gather(*(worker(i) for i in range(8)))
+        c = await HubClient.connect(f"127.0.0.1:{server.port}")
+        try:
+            assert len(await c.kv_get_prefix("/load/")) == 160
+        finally:
+            await c.close()

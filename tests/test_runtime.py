@@ -1,0 +1,227 @@
+"""Distributed runtime tests: endpoint hosting, discovery, routing,
+cancellation, pipeline composition.
+
+Mirrors the reference's pipeline/lifecycle integration tests
+(reference: lib/runtime/tests/{pipeline,lifecycle}.rs) with real (loopback)
+transport instead of mocks — the hub and data plane are in-process.
+"""
+
+import asyncio
+import contextlib
+
+from dynamo_tpu.runtime.client import NoInstancesError
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.pipeline.context import Context
+from dynamo_tpu.runtime.pipeline.engine import LambdaEngine, Operator, link
+
+from .helpers import hub_server
+
+
+@contextlib.asynccontextmanager
+async def drt_on(server, **kw):
+    drt = await DistributedRuntime.from_settings(
+        hub_addr=f"127.0.0.1:{server.port}", **kw
+    )
+    try:
+        yield drt
+    finally:
+        await drt.shutdown()
+
+
+def echo_engine():
+    async def _gen(ctx: Context):
+        for tok in ctx.payload["text"].split():
+            yield {"token": tok, "request_id": ctx.id}
+
+    return LambdaEngine(_gen)
+
+
+async def test_serve_discover_generate():
+    async with hub_server() as server:
+        async with drt_on(server) as worker, drt_on(server) as frontend:
+            ep = worker.namespace("test").component("backend").endpoint("generate")
+            served = await ep.serve_engine(echo_engine())
+
+            client_ep = frontend.namespace("test").component("backend").endpoint("generate")
+            client = await client_ep.client()
+            await client.wait_for_instances(timeout=5)
+
+            ctx = Context({"text": "hello tpu world"})
+            out = [item async for item in await client.generate(ctx.payload, context=ctx)]
+            assert [o["token"] for o in out] == ["hello", "tpu", "world"]
+            assert all(o["request_id"] == ctx.id for o in out)
+            await served.shutdown()
+            await client.close()
+
+
+async def test_round_robin_across_instances():
+    async with hub_server() as server:
+        async with drt_on(server) as w1, drt_on(server) as w2, drt_on(server) as fe:
+
+            def tagged(tag):
+                async def _gen(ctx):
+                    yield {"worker": tag}
+
+                return LambdaEngine(_gen)
+
+            for drt, tag in ((w1, "a"), (w2, "b")):
+                ep = drt.namespace("t").component("c").endpoint("generate")
+                await ep.serve_engine(tagged(tag))
+
+            client = await fe.namespace("t").component("c").endpoint("generate").client()
+            await client.wait_for_instances(timeout=5)
+            # watch may deliver the second instance slightly later
+            for _ in range(50):
+                if len(client.instances) == 2:
+                    break
+                await asyncio.sleep(0.05)
+            assert len(client.instances) == 2
+
+            seen = set()
+            for _ in range(4):
+                out = [i async for i in await client.generate({}, mode="round_robin")]
+                seen.add(out[0]["worker"])
+            assert seen == {"a", "b"}
+
+            # direct routing hits the requested instance only
+            wid = client.instance_ids()[0]
+            out = [i async for i in await client.direct({}, instance_id=wid)]
+            assert out[0]["worker"] in {"a", "b"}
+            await client.close()
+
+
+async def test_lease_expiry_removes_instance():
+    async with hub_server() as server:
+        async with drt_on(server) as worker, drt_on(server) as fe:
+            ep = worker.namespace("t").component("dying").endpoint("generate")
+            # short dedicated lease, no keepalive → instance should vanish
+            lease = await worker.hub.lease_grant(ttl=0.5, keepalive=False)
+            await ep.endpoint_builder().engine(echo_engine()).lease(lease).start()
+
+            client = await fe.namespace("t").component("dying").endpoint("generate").client()
+            await client.wait_for_instances(timeout=5)
+            assert len(client.instances) == 1
+            for _ in range(40):
+                if not client.instances:
+                    break
+                await asyncio.sleep(0.1)
+            assert client.instances == {}
+            try:
+                await client.generate({"text": "x"})
+                raise AssertionError("expected NoInstancesError")
+            except NoInstancesError:
+                pass
+            await client.close()
+
+
+async def test_cancellation_propagates_to_server():
+    async with hub_server() as server:
+        async with drt_on(server) as worker, drt_on(server) as fe:
+            server_saw_stop = asyncio.Event()
+
+            async def _slow(ctx: Context):
+                for i in range(1000):
+                    if ctx.is_stopped():
+                        server_saw_stop.set()
+                        return
+                    yield {"i": i}
+                    await asyncio.sleep(0.01)
+
+            ep = worker.namespace("t").component("slow").endpoint("generate")
+            await ep.serve_engine(LambdaEngine(_slow))
+
+            client = await fe.namespace("t").component("slow").endpoint("generate").client()
+            await client.wait_for_instances(timeout=5)
+
+            ctx = Context({})
+            stream = await client.generate({}, context=ctx)
+            got = 0
+            async for _item in stream:
+                got += 1
+                if got == 3:
+                    ctx.stop_generating()
+                    break
+            await asyncio.wait_for(server_saw_stop.wait(), timeout=5)
+            await client.close()
+
+
+async def test_missing_endpoint_prologue_error():
+    async with hub_server() as server:
+        async with drt_on(server) as worker, drt_on(server) as fe:
+            ep = worker.namespace("t").component("real").endpoint("generate")
+            await ep.serve_engine(echo_engine())
+            client = await fe.namespace("t").component("real").endpoint("generate").client()
+            await client.wait_for_instances(timeout=5)
+            # request a non-registered endpoint at the same address
+            info = next(iter(client.instances.values()))
+            try:
+                await fe.data_plane_client.request(info.address, "t.bogus.generate", b"\xc0")
+                raise AssertionError("expected prologue error")
+            except RuntimeError as exc:
+                assert "no endpoint" in str(exc)
+            await client.close()
+
+
+async def test_engine_exception_propagates_as_stream_error():
+    async with hub_server() as server:
+        async with drt_on(server) as worker, drt_on(server) as fe:
+
+            async def _fail(ctx):
+                yield {"ok": 1}
+                raise ValueError("engine exploded")
+
+            ep = worker.namespace("t").component("failing").endpoint("generate")
+            await ep.serve_engine(LambdaEngine(_fail))
+            client = await fe.namespace("t").component("failing").endpoint("generate").client()
+            await client.wait_for_instances(timeout=5)
+
+            stream = await client.generate({})
+            items = []
+            try:
+                async for item in stream:
+                    items.append(item)
+                raise AssertionError("expected stream error")
+            except RuntimeError as exc:
+                assert "engine exploded" in str(exc)
+            assert items == [{"ok": 1}]
+            await client.close()
+
+
+async def test_pipeline_operator_composition():
+    """Operators transform request (forward) and stream (backward),
+    composed via link() — in-process, no network."""
+
+    class Upper(Operator):
+        async def generate(self, request, next_engine):
+            upstream = await next_engine.generate(
+                request.map({"text": request.payload["text"].upper()})
+            )
+
+            async def _out():
+                async for item in upstream:
+                    yield {**item, "via": "upper"}
+
+            return _out()
+
+    pipeline = link(Upper(), echo_engine())
+    out = [i async for i in await pipeline.generate(Context({"text": "ab cd"}))]
+    assert [o["token"] for o in out] == ["AB", "CD"]
+    assert all(o["via"] == "upper" for o in out)
+
+
+async def test_stats_scrape():
+    async with hub_server() as server:
+        async with drt_on(server) as worker, drt_on(server) as fe:
+            ep = worker.namespace("t").component("stats").endpoint("generate")
+            await (
+                ep.endpoint_builder()
+                .engine(echo_engine())
+                .stats_handler(lambda: {"kv_active_blocks": 7})
+                .start()
+            )
+            client = await fe.namespace("t").component("stats").endpoint("generate").client()
+            await client.wait_for_instances(timeout=5)
+            stats = await client.scrape_stats()
+            assert len(stats) == 1
+            assert next(iter(stats.values()))["kv_active_blocks"] == 7
+            await client.close()
