@@ -1,0 +1,7 @@
+"""LLM serving layer: OpenAI frontend, preprocessing, detokenization, model
+cards, engines façade.
+
+Rebuild of the reference's `dynamo-llm` crate (reference: lib/llm/src/*) —
+the hardware-agnostic half of the serving stack. The native JAX engine lives
+in `dynamo_tpu.engine`; KV-aware routing in `dynamo_tpu.kv_router`.
+"""

@@ -1,0 +1,143 @@
+"""Prometheus-format service metrics (no external prometheus dependency).
+
+Equivalent of the reference's HTTP metrics (reference:
+lib/llm/src/http/service/metrics.rs:36-201): `{prefix}_requests_total`
+(model/endpoint/status labels), `{prefix}_inflight_requests`,
+`{prefix}_request_duration_seconds` histogram, plus the RAII
+`InflightGuard` that records status on exit.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import defaultdict
+from typing import Iterable
+
+DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+
+
+def _fmt_labels(labels: dict[str, str]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+class Counter:
+    def __init__(self, name: str, help_: str):
+        self.name = name
+        self.help = help_
+        self._values: dict[tuple, float] = defaultdict(float)
+
+    def inc(self, amount: float = 1.0, **labels: str) -> None:
+        self._values[tuple(sorted(labels.items()))] += amount
+
+    def render(self) -> Iterable[str]:
+        yield f"# HELP {self.name} {self.help}"
+        yield f"# TYPE {self.name} counter"
+        if not self._values:
+            yield f"{self.name} 0"
+        for key, val in self._values.items():
+            yield f"{self.name}{_fmt_labels(dict(key))} {val}"
+
+
+class Gauge:
+    def __init__(self, name: str, help_: str):
+        self.name = name
+        self.help = help_
+        self._values: dict[tuple, float] = defaultdict(float)
+
+    def set(self, value: float, **labels: str) -> None:
+        self._values[tuple(sorted(labels.items()))] = value
+
+    def add(self, amount: float, **labels: str) -> None:
+        self._values[tuple(sorted(labels.items()))] += amount
+
+    def render(self) -> Iterable[str]:
+        yield f"# HELP {self.name} {self.help}"
+        yield f"# TYPE {self.name} gauge"
+        if not self._values:
+            yield f"{self.name} 0"
+        for key, val in self._values.items():
+            yield f"{self.name}{_fmt_labels(dict(key))} {val}"
+
+
+class Histogram:
+    def __init__(self, name: str, help_: str, buckets: tuple[float, ...] = DEFAULT_BUCKETS):
+        self.name = name
+        self.help = help_
+        self.buckets = buckets
+        self._counts: dict[tuple, list[int]] = {}
+        self._sums: dict[tuple, float] = defaultdict(float)
+        self._totals: dict[tuple, int] = defaultdict(int)
+
+    def observe(self, value: float, **labels: str) -> None:
+        key = tuple(sorted(labels.items()))
+        if key not in self._counts:
+            self._counts[key] = [0] * len(self.buckets)
+        # per-bucket counts here; render() accumulates into cumulative form
+        for i, b in enumerate(self.buckets):
+            if value <= b:
+                self._counts[key][i] += 1
+                break
+        self._sums[key] += value
+        self._totals[key] += 1
+
+    def render(self) -> Iterable[str]:
+        yield f"# HELP {self.name} {self.help}"
+        yield f"# TYPE {self.name} histogram"
+        for key, counts in self._counts.items():
+            labels = dict(key)
+            cum = 0
+            for b, c in zip(self.buckets, counts):
+                cum += c
+                yield f'{self.name}_bucket{_fmt_labels({**labels, "le": str(b)})} {cum}'
+            yield f'{self.name}_bucket{_fmt_labels({**labels, "le": "+Inf"})} {self._totals[key]}'
+            yield f"{self.name}_sum{_fmt_labels(labels)} {self._sums[key]}"
+            yield f"{self.name}_count{_fmt_labels(labels)} {self._totals[key]}"
+
+
+class ServiceMetrics:
+    def __init__(self, prefix: str = "dynamo_tpu"):
+        self.requests_total = Counter(
+            f"{prefix}_http_service_requests_total", "Total HTTP LLM requests"
+        )
+        self.inflight = Gauge(
+            f"{prefix}_http_service_inflight_requests", "In-flight HTTP LLM requests"
+        )
+        self.duration = Histogram(
+            f"{prefix}_http_service_request_duration_seconds",
+            "HTTP LLM request duration",
+        )
+        self.extra: list = []  # extra renderables (engine metrics etc.)
+
+    def inflight_guard(self, model: str, endpoint: str) -> "InflightGuard":
+        return InflightGuard(self, model, endpoint)
+
+    def render(self) -> str:
+        lines: list[str] = []
+        for metric in (self.requests_total, self.inflight, self.duration, *self.extra):
+            lines.extend(metric.render())
+        return "\n".join(lines) + "\n"
+
+
+class InflightGuard:
+    """RAII request accounting (reference: metrics.rs:201 InflightGuard)."""
+
+    def __init__(self, metrics: ServiceMetrics, model: str, endpoint: str):
+        self._m = metrics
+        self._model = model
+        self._endpoint = endpoint
+        self._start = time.monotonic()
+        self.status = "error"
+        self._m.inflight.add(1, model=model)
+
+    def mark_ok(self) -> None:
+        self.status = "success"
+
+    def close(self) -> None:
+        self._m.inflight.add(-1, model=self._model)
+        self._m.requests_total.inc(
+            1, model=self._model, endpoint=self._endpoint, status=self.status
+        )
+        self._m.duration.observe(time.monotonic() - self._start, model=self._model)

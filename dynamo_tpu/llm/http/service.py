@@ -1,0 +1,238 @@
+"""OpenAI-compatible HTTP service.
+
+Equivalent of the reference's axum HttpService (reference:
+lib/llm/src/http/service/service_v2.rs:25-130, openai.rs:133-559):
+
+- ``POST /v1/chat/completions`` / ``POST /v1/completions`` — streaming (SSE)
+  and non-streaming; client disconnect kills the request context so engines
+  stop wasting compute (openai.rs:433 monitor_for_disconnects);
+- ``GET /v1/models`` — model listing;
+- ``GET /metrics`` — Prometheus text;
+- ``GET /health`` / ``GET /live``.
+
+`ModelManager` (reference: lib/llm/src/http/service.rs:59-130) maps model
+name → engine per flavor (chat/completion). Engines here are full pipelines:
+for discovered backend workers that's preprocessor → backend → push-router.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import json
+from typing import Optional
+
+from aiohttp import web
+
+from dynamo_tpu.llm.http.metrics import ServiceMetrics
+from dynamo_tpu.llm.protocols.openai import (
+    ChatCompletionRequest,
+    CompletionRequest,
+    RequestError,
+    aggregate_chat_stream,
+    aggregate_completion_stream,
+)
+from dynamo_tpu.runtime.pipeline.context import Context
+from dynamo_tpu.runtime.pipeline.engine import AsyncEngine
+from dynamo_tpu.utils.logging import get_logger
+
+log = get_logger("dynamo_tpu.http")
+
+
+class ModelManager:
+    def __init__(self) -> None:
+        self._chat: dict[str, AsyncEngine] = {}
+        self._completion: dict[str, AsyncEngine] = {}
+        self.cards: dict[str, dict] = {}  # display info for /v1/models
+
+    def add_chat_model(self, name: str, engine: AsyncEngine) -> None:
+        self._chat[name] = engine
+
+    def add_completion_model(self, name: str, engine: AsyncEngine) -> None:
+        self._completion[name] = engine
+
+    def remove_model(self, name: str) -> None:
+        self._chat.pop(name, None)
+        self._completion.pop(name, None)
+        self.cards.pop(name, None)
+
+    def get_chat(self, name: str) -> Optional[AsyncEngine]:
+        return self._chat.get(name)
+
+    def get_completion(self, name: str) -> Optional[AsyncEngine]:
+        return self._completion.get(name)
+
+    def list_models(self) -> list[str]:
+        return sorted(set(self._chat) | set(self._completion))
+
+
+class HttpService:
+    def __init__(
+        self,
+        manager: Optional[ModelManager] = None,
+        metrics: Optional[ServiceMetrics] = None,
+    ):
+        self.manager = manager or ModelManager()
+        self.metrics = metrics or ServiceMetrics()
+        self.app = web.Application()
+        self.app.add_routes(
+            [
+                web.post("/v1/chat/completions", self._chat_completions),
+                web.post("/v1/completions", self._completions),
+                web.get("/v1/models", self._models),
+                web.get("/metrics", self._metrics),
+                web.get("/health", self._health),
+                web.get("/live", self._health),
+            ]
+        )
+        self._runner: Optional[web.AppRunner] = None
+        self.port: int = 0
+
+    # ------------------------------------------------------------ lifecycle
+
+    async def start(self, host: str = "0.0.0.0", port: int = 0) -> None:
+        self._runner = web.AppRunner(self.app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, host, port)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]
+        log.info("http service listening on %s:%d", host, self.port)
+
+    async def stop(self) -> None:
+        if self._runner:
+            await self._runner.cleanup()
+            self._runner = None
+
+    # --------------------------------------------------------------- routes
+
+    async def _health(self, request: web.Request) -> web.Response:
+        return web.json_response({"status": "ok", "models": self.manager.list_models()})
+
+    async def _models(self, request: web.Request) -> web.Response:
+        return web.json_response(
+            {
+                "object": "list",
+                "data": [
+                    {"id": name, "object": "model", "owned_by": "dynamo-tpu"}
+                    for name in self.manager.list_models()
+                ],
+            }
+        )
+
+    async def _metrics(self, request: web.Request) -> web.Response:
+        return web.Response(
+            text=self.metrics.render(), content_type="text/plain", charset="utf-8"
+        )
+
+    async def _chat_completions(self, request: web.Request) -> web.StreamResponse:
+        return await self._serve_llm(
+            request, kind="chat", parse=ChatCompletionRequest.from_body
+        )
+
+    async def _completions(self, request: web.Request) -> web.StreamResponse:
+        return await self._serve_llm(
+            request, kind="completion", parse=CompletionRequest.from_body
+        )
+
+    async def _serve_llm(self, request: web.Request, kind: str, parse) -> web.StreamResponse:
+        try:
+            body = await request.json()
+        except (json.JSONDecodeError, UnicodeDecodeError):
+            return _error_response(400, "invalid JSON body")
+        try:
+            req = parse(body)
+        except RequestError as exc:
+            return _error_response(400, str(exc))
+
+        engine = (
+            self.manager.get_chat(req.model)
+            if kind == "chat"
+            else self.manager.get_completion(req.model)
+        )
+        if engine is None:
+            return _error_response(404, f"model {req.model!r} not found")
+
+        guard = self.metrics.inflight_guard(req.model, kind)
+        ctx = Context(req)
+        try:
+            stream = await engine.generate(ctx)
+        except RequestError as exc:
+            guard.close()
+            return _error_response(400, str(exc))
+        except Exception as exc:  # noqa: BLE001 — engine startup failure
+            log.error("engine failed for %s", req.model, exc_info=exc)
+            guard.close()
+            return _error_response(502, f"engine error: {exc}")
+
+        try:
+            if req.stream:
+                return await self._stream_sse(request, ctx, stream, guard)
+            return await self._respond_full(ctx, stream, guard, kind)
+        except asyncio.CancelledError:
+            # client disconnected (aiohttp cancels the handler) → kill the
+            # context so remote engines stop generating for a vanished caller
+            log.info("client disconnected; killing request %s", ctx.id)
+            ctx.kill()
+            raise
+        finally:
+            guard.close()
+
+    async def _stream_sse(self, request, ctx, stream, guard) -> web.StreamResponse:
+        resp = web.StreamResponse(
+            headers={
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-cache",
+                "Connection": "keep-alive",
+            }
+        )
+        await resp.prepare(request)
+        try:
+            async for item in stream:
+                if "__annotation__" in item:
+                    # reference: SSE `event:` lines for annotations
+                    name, data = item["__annotation__"], item["data"]
+                    await resp.write(
+                        f"event: {name}\ndata: {json.dumps(data)}\n\n".encode()
+                    )
+                    continue
+                await resp.write(f"data: {json.dumps(item)}\n\n".encode())
+            await resp.write(b"data: [DONE]\n\n")
+            guard.mark_ok()
+        except (ConnectionResetError, asyncio.CancelledError):
+            # client went away → kill the context so the engine stops
+            # (reference: openai.rs:433 monitor_for_disconnects)
+            log.info("client disconnected; killing request %s", ctx.id)
+            ctx.kill()
+            raise
+        except RuntimeError as exc:
+            # engine error mid-stream: emit an SSE error event then close
+            log.error("stream error for request %s: %s", ctx.id, exc)
+            await resp.write(
+                f'event: error\ndata: {json.dumps({"message": str(exc)})}\n\n'.encode()
+            )
+        with contextlib.suppress(ConnectionResetError):
+            await resp.write_eof()
+        return resp
+
+    async def _respond_full(self, ctx, stream, guard, kind) -> web.Response:
+        async def _data_only():
+            async for item in stream:
+                if "__annotation__" not in item:
+                    yield item
+
+        try:
+            if kind == "chat":
+                full = await aggregate_chat_stream(_data_only())
+            else:
+                full = await aggregate_completion_stream(_data_only())
+        except RuntimeError as exc:
+            return _error_response(502, f"engine error: {exc}")
+        guard.mark_ok()
+        return web.json_response(full)
+
+
+def _error_response(status: int, message: str) -> web.Response:
+    return web.json_response(
+        {"error": {"message": message, "type": "invalid_request_error"}}, status=status
+    )
+

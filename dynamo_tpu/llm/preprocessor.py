@@ -1,0 +1,228 @@
+"""OpenAI → backend preprocessing operator.
+
+Equivalent of the reference's OpenAIPreprocessor (reference:
+lib/llm/src/preprocessor.rs:64-235 + preprocessor/prompt/*): renders the
+model's chat template (Jinja2, same dialect HF ships in
+tokenizer_config.json), tokenizes, merges stop conditions and eos ids into a
+`PreprocessedRequest`, then maps the engine's `EngineOutput` stream back into
+OpenAI chat/completion chunks via `DeltaGenerator`.
+
+Annotations (reference: nvext annotations, preprocessor.rs): requesting
+``formatted_prompt`` or ``token_ids`` yields annotation items
+(``{"__annotation__": name, "data": ...}``) ahead of the data stream; the
+HTTP layer renders them as SSE events.
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+from typing import AsyncIterator, Optional
+
+import jinja2
+
+from dynamo_tpu.llm.model_card import ModelDeploymentCard
+from dynamo_tpu.llm.protocols.common import EngineOutput, PreprocessedRequest
+from dynamo_tpu.llm.protocols.openai import (
+    ChatCompletionRequest,
+    CompletionRequest,
+    DeltaGenerator,
+    RequestError,
+)
+from dynamo_tpu.llm.tokenizer import HuggingFaceTokenizer
+from dynamo_tpu.runtime.pipeline.context import Context
+from dynamo_tpu.runtime.pipeline.engine import AsyncEngine, Operator
+from dynamo_tpu.utils.logging import get_logger
+
+log = get_logger("dynamo_tpu.preprocessor")
+
+
+def _raise_exception(message: str):
+    raise jinja2.exceptions.TemplateError(message)
+
+
+def _strftime_now(fmt: str) -> str:
+    return datetime.datetime.now().strftime(fmt)
+
+
+class PromptFormatter:
+    """HF-style chat template renderer (reference: preprocessor/prompt/
+    template/tokcfg.rs)."""
+
+    def __init__(self, template: str, bos_token: Optional[str], eos_token: Optional[str]):
+        env = jinja2.Environment(
+            trim_blocks=True, lstrip_blocks=True, keep_trailing_newline=True
+        )
+        env.globals["raise_exception"] = _raise_exception
+        env.globals["strftime_now"] = _strftime_now
+        env.filters["tojson"] = lambda v, **kw: json.dumps(v, **kw)
+        self._template = env.from_string(template)
+        self._bos = bos_token
+        self._eos = eos_token
+
+    @classmethod
+    def from_card(cls, card: ModelDeploymentCard) -> Optional["PromptFormatter"]:
+        template = card.chat_template
+        bos = eos = None
+        cfg_path = card.artifacts.get("tokenizer_config.json")
+        if cfg_path:
+            with open(cfg_path) as f:
+                cfg = json.load(f)
+            template = template or cfg.get("chat_template")
+
+            def _tok(v):
+                return v.get("content") if isinstance(v, dict) else v
+
+            bos, eos = _tok(cfg.get("bos_token")), _tok(cfg.get("eos_token"))
+        if not template:
+            return None
+        return cls(template, bos, eos)
+
+    def render(
+        self,
+        messages: list[dict],
+        tools: Optional[list[dict]] = None,
+        add_generation_prompt: bool = True,
+    ) -> str:
+        return self._template.render(
+            messages=messages,
+            tools=tools,
+            add_generation_prompt=add_generation_prompt,
+            bos_token=self._bos or "",
+            eos_token=self._eos or "",
+        )
+
+
+def _message_text(message: dict) -> str:
+    """Normalize OpenAI message content (str | content-part list | None)."""
+    content = message.get("content")
+    if content is None:
+        return ""
+    if isinstance(content, str):
+        return content
+    if isinstance(content, list):
+        parts = []
+        for part in content:
+            if isinstance(part, dict) and part.get("type") == "text":
+                parts.append(part.get("text") or "")
+            elif isinstance(part, str):
+                parts.append(part)
+            else:
+                raise RequestError(
+                    f"unsupported content part type {part.get('type') if isinstance(part, dict) else type(part).__name__!r}"
+                )
+        return "".join(parts)
+    raise RequestError("message 'content' must be a string or list of parts")
+
+
+def _normalize_messages(messages: list[dict]) -> list[dict]:
+    return [{**m, "content": _message_text(m)} for m in messages]
+
+
+class OpenAIPreprocessor(Operator):
+    def __init__(
+        self,
+        card: ModelDeploymentCard,
+        tokenizer: Optional[HuggingFaceTokenizer] = None,
+    ):
+        self.card = card
+        self.tokenizer = tokenizer or HuggingFaceTokenizer.from_file(card.tokenizer_dir())
+        self.formatter = PromptFormatter.from_card(card)
+        self.eos_ids = self.tokenizer.eos_token_ids()
+
+    # ---------------------------------------------------------------- build
+
+    def preprocess_chat(self, req: ChatCompletionRequest) -> tuple[PreprocessedRequest, str]:
+        """reference: preprocessor.rs:117-186 preprocess_request."""
+        messages = _normalize_messages(req.messages)
+        if req.ext.use_raw_prompt:
+            prompt = "".join(m["content"] for m in messages)
+        elif self.formatter is not None:
+            prompt = self.formatter.render(messages, tools=req.tools)
+        else:
+            # no chat template: simple role-tagged concatenation
+            prompt = (
+                "".join(f"{m.get('role')}: {m['content']}\n" for m in messages)
+                + "assistant:"
+            )
+        token_ids = self.tokenizer.encode(prompt)
+        if len(token_ids) >= self.card.context_length:
+            raise RequestError(
+                f"prompt ({len(token_ids)} tokens) exceeds context length "
+                f"{self.card.context_length}"
+            )
+        pre = PreprocessedRequest(
+            token_ids=token_ids,
+            stop_conditions=req.stop_conditions(),
+            sampling_options=req.sampling_options(),
+            eos_token_ids=list(self.eos_ids),
+            annotations=list(req.ext.annotations),
+            mdc_sum=self.card.checksum,
+        )
+        return pre, prompt
+
+    def preprocess_completion(self, req: CompletionRequest) -> tuple[PreprocessedRequest, str]:
+        if isinstance(req.prompt, str):
+            prompt = req.prompt
+            token_ids = self.tokenizer.encode(prompt)
+        elif isinstance(req.prompt, list) and all(isinstance(t, int) for t in req.prompt):
+            prompt = ""
+            token_ids = list(req.prompt)
+        else:
+            raise RequestError("'prompt' must be a string or list of token ids")
+        if len(token_ids) >= self.card.context_length:
+            raise RequestError(
+                f"prompt ({len(token_ids)} tokens) exceeds context length "
+                f"{self.card.context_length}"
+            )
+        pre = PreprocessedRequest(
+            token_ids=token_ids,
+            stop_conditions=req.stop_conditions(),
+            sampling_options=req.sampling_options(),
+            eos_token_ids=list(self.eos_ids),
+            annotations=list(req.ext.annotations),
+            mdc_sum=self.card.checksum,
+        )
+        return pre, prompt
+
+    # ------------------------------------------------------------- operator
+
+    async def generate(
+        self, request: Context, next_engine: AsyncEngine
+    ) -> AsyncIterator[dict]:
+        req = request.payload
+        if isinstance(req, ChatCompletionRequest):
+            pre, prompt = self.preprocess_chat(req)
+            kind = "chat"
+        elif isinstance(req, CompletionRequest):
+            pre, prompt = self.preprocess_completion(req)
+            kind = "completion"
+        else:
+            raise TypeError(f"unsupported request type {type(req).__name__}")
+
+        delta = DeltaGenerator(req.model, kind=kind)
+        delta.prompt_tokens = len(pre.token_ids)
+        upstream = await next_engine.generate(request.map(pre.to_dict()))
+
+        async def _out() -> AsyncIterator[dict]:
+            # reference: annotations emitted ahead of the stream
+            if "formatted_prompt" in pre.annotations:
+                yield {"__annotation__": "formatted_prompt", "data": prompt}
+            if "token_ids" in pre.annotations:
+                yield {"__annotation__": "token_ids", "data": pre.token_ids}
+            finish_sent = False
+            async for raw in upstream:
+                out = EngineOutput.from_dict(raw) if isinstance(raw, dict) else raw
+                text = out.text
+                if text is None and out.tokens:
+                    text = "".join(out.tokens)
+                delta.completion_tokens += len(out.token_ids)
+                if text or out.finish_reason:
+                    if out.finish_reason:
+                        finish_sent = True
+                    yield delta.chunk(text, out.finish_reason)
+            if not finish_sent:
+                yield delta.chunk(None, "stop")
+            yield {**delta.chunk(None, None), "usage": delta.usage(), "choices": []}
+
+        return _out()

@@ -1,0 +1,1 @@
+"""Wire protocols: OpenAI API types and the internal backend IO types."""

@@ -1,0 +1,310 @@
+"""OpenAI-compatible API types, delta generation, and stream aggregation.
+
+Equivalent of the reference's OpenAI protocol layer (reference:
+lib/llm/src/protocols/openai.rs + chat_completions/, completions/,
+nvext.rs:26-60). Requests are validated loosely (unknown fields ignored) and
+carry a `dyn_ext` extension block mirroring the reference's `nvext`
+(ignore_eos, top_k, repetition_penalty, greedy sampling, use_raw_prompt,
+annotations).
+
+`DeltaGenerator` turns `EngineOutput` steps into chat/completion stream
+chunks; `aggregate_chat_stream`/`aggregate_completion_stream` fold a chunk
+stream into a full response for non-streaming callers (reference:
+chat_completions/aggregator.rs, completions/aggregator.rs).
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, AsyncIterator, Optional
+
+from dynamo_tpu.llm.protocols.common import (
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+
+
+class RequestError(ValueError):
+    """Invalid client request → HTTP 400."""
+
+
+@dataclass
+class DynExt:
+    """Extension block (reference: nvext.rs:26-60). Accepted under key
+    "dyn_ext" or "nvext" for drop-in compatibility."""
+
+    ignore_eos: bool = False
+    top_k: Optional[int] = None
+    repetition_penalty: Optional[float] = None
+    greed_sampling: bool = False
+    use_raw_prompt: bool = False
+    annotations: list[str] = field(default_factory=list)
+
+    @classmethod
+    def from_request(cls, body: dict) -> "DynExt":
+        raw = body.get("dyn_ext") or body.get("nvext") or {}
+        return cls(
+            ignore_eos=bool(raw.get("ignore_eos", False)),
+            top_k=raw.get("top_k"),
+            repetition_penalty=raw.get("repetition_penalty"),
+            greed_sampling=bool(raw.get("greed_sampling", False)),
+            use_raw_prompt=bool(raw.get("use_raw_prompt", False)),
+            annotations=list(raw.get("annotations") or []),
+        )
+
+
+@dataclass
+class ChatCompletionRequest:
+    model: str
+    messages: list[dict]
+    stream: bool = False
+    max_tokens: Optional[int] = None
+    max_completion_tokens: Optional[int] = None
+    temperature: Optional[float] = None
+    top_p: Optional[float] = None
+    n: int = 1
+    stop: list[str] = field(default_factory=list)
+    seed: Optional[int] = None
+    frequency_penalty: Optional[float] = None
+    presence_penalty: Optional[float] = None
+    logprobs: bool = False
+    tools: Optional[list[dict]] = None
+    tool_choice: Any = None
+    ext: DynExt = field(default_factory=DynExt)
+    raw: dict = field(default_factory=dict)
+
+    @classmethod
+    def from_body(cls, body: dict) -> "ChatCompletionRequest":
+        if not isinstance(body.get("model"), str):
+            raise RequestError("'model' must be a string")
+        messages = body.get("messages")
+        if not isinstance(messages, list) or not messages:
+            raise RequestError("'messages' must be a non-empty list")
+        for m in messages:
+            if not isinstance(m, dict) or "role" not in m:
+                raise RequestError("each message needs a 'role'")
+        stop = body.get("stop") or []
+        if isinstance(stop, str):
+            stop = [stop]
+        return cls(
+            model=body["model"],
+            messages=messages,
+            stream=bool(body.get("stream", False)),
+            max_tokens=body.get("max_tokens"),
+            max_completion_tokens=body.get("max_completion_tokens"),
+            temperature=body.get("temperature"),
+            top_p=body.get("top_p"),
+            n=int(body.get("n", 1)),
+            stop=list(stop),
+            seed=body.get("seed"),
+            frequency_penalty=body.get("frequency_penalty"),
+            presence_penalty=body.get("presence_penalty"),
+            logprobs=bool(body.get("logprobs", False)),
+            tools=body.get("tools"),
+            tool_choice=body.get("tool_choice"),
+            ext=DynExt.from_request(body),
+            raw=body,
+        )
+
+    def sampling_options(self) -> SamplingOptions:
+        return SamplingOptions(
+            n=self.n,
+            temperature=self.temperature,
+            top_p=self.top_p,
+            top_k=self.ext.top_k,
+            frequency_penalty=self.frequency_penalty,
+            presence_penalty=self.presence_penalty,
+            repetition_penalty=self.ext.repetition_penalty,
+            seed=self.seed,
+            greedy=self.ext.greed_sampling,
+        )
+
+    def stop_conditions(self) -> StopConditions:
+        return StopConditions(
+            max_tokens=self.max_completion_tokens or self.max_tokens,
+            stop=list(self.stop),
+            ignore_eos=self.ext.ignore_eos,
+        )
+
+
+@dataclass
+class CompletionRequest:
+    model: str
+    prompt: Any  # str | list[str] | list[int]
+    stream: bool = False
+    max_tokens: Optional[int] = None
+    temperature: Optional[float] = None
+    top_p: Optional[float] = None
+    n: int = 1
+    stop: list[str] = field(default_factory=list)
+    seed: Optional[int] = None
+    echo: bool = False
+    ext: DynExt = field(default_factory=DynExt)
+    raw: dict = field(default_factory=dict)
+
+    @classmethod
+    def from_body(cls, body: dict) -> "CompletionRequest":
+        if not isinstance(body.get("model"), str):
+            raise RequestError("'model' must be a string")
+        if "prompt" not in body:
+            raise RequestError("'prompt' is required")
+        stop = body.get("stop") or []
+        if isinstance(stop, str):
+            stop = [stop]
+        return cls(
+            model=body["model"],
+            prompt=body["prompt"],
+            stream=bool(body.get("stream", False)),
+            max_tokens=body.get("max_tokens"),
+            temperature=body.get("temperature"),
+            top_p=body.get("top_p"),
+            n=int(body.get("n", 1)),
+            stop=list(stop),
+            seed=body.get("seed"),
+            echo=bool(body.get("echo", False)),
+            ext=DynExt.from_request(body),
+            raw=body,
+        )
+
+    def sampling_options(self) -> SamplingOptions:
+        return SamplingOptions(
+            n=self.n,
+            temperature=self.temperature,
+            top_p=self.top_p,
+            top_k=self.ext.top_k,
+            seed=self.seed,
+            greedy=self.ext.greed_sampling,
+        )
+
+    def stop_conditions(self) -> StopConditions:
+        return StopConditions(
+            max_tokens=self.max_tokens,
+            stop=list(self.stop),
+            ignore_eos=self.ext.ignore_eos,
+        )
+
+
+# --------------------------------------------------------------------------
+# Delta generation (engine steps → OpenAI stream chunks)
+# --------------------------------------------------------------------------
+
+
+class DeltaGenerator:
+    """Builds chat-completion stream chunks (reference: DeltaGeneratorExt /
+    chat_completions delta generator)."""
+
+    def __init__(self, model: str, kind: str = "chat"):
+        self.id = f"{'chatcmpl' if kind == 'chat' else 'cmpl'}-{uuid.uuid4().hex[:24]}"
+        self.model = model
+        self.kind = kind
+        self.created = int(time.time())
+        self._first = True
+        self.completion_tokens = 0
+        self.prompt_tokens = 0
+
+    def _base(self) -> dict:
+        return {
+            "id": self.id,
+            "object": (
+                "chat.completion.chunk" if self.kind == "chat" else "text_completion"
+            ),
+            "created": self.created,
+            "model": self.model,
+        }
+
+    def chunk(self, text: Optional[str], finish_reason: Optional[str] = None) -> dict:
+        out = self._base()
+        if self.kind == "chat":
+            delta: dict[str, Any] = {}
+            if self._first:
+                delta["role"] = "assistant"
+                self._first = False
+            if text:
+                delta["content"] = text
+            out["choices"] = [
+                {"index": 0, "delta": delta, "finish_reason": finish_reason}
+            ]
+        else:
+            out["choices"] = [
+                {"index": 0, "text": text or "", "finish_reason": finish_reason}
+            ]
+        return out
+
+    def usage(self) -> dict:
+        return {
+            "prompt_tokens": self.prompt_tokens,
+            "completion_tokens": self.completion_tokens,
+            "total_tokens": self.prompt_tokens + self.completion_tokens,
+        }
+
+
+async def aggregate_chat_stream(chunks: AsyncIterator[dict]) -> dict:
+    """Fold stream chunks into a full chat completion
+    (reference: chat_completions/aggregator.rs)."""
+    text_parts: list[str] = []
+    finish_reason = None
+    base: dict = {}
+    usage = None
+    role = "assistant"
+    async for chunk in chunks:
+        if not base:
+            base = {k: chunk.get(k) for k in ("id", "created", "model")}
+        if chunk.get("usage"):
+            usage = chunk["usage"]
+        for choice in chunk.get("choices", []):
+            delta = choice.get("delta", {})
+            if delta.get("role"):
+                role = delta["role"]
+            if delta.get("content"):
+                text_parts.append(delta["content"])
+            if choice.get("finish_reason"):
+                finish_reason = choice["finish_reason"]
+    out = {
+        "id": base.get("id"),
+        "object": "chat.completion",
+        "created": base.get("created"),
+        "model": base.get("model"),
+        "choices": [
+            {
+                "index": 0,
+                "message": {"role": role, "content": "".join(text_parts)},
+                "finish_reason": finish_reason,
+            }
+        ],
+    }
+    if usage:
+        out["usage"] = usage
+    return out
+
+
+async def aggregate_completion_stream(chunks: AsyncIterator[dict]) -> dict:
+    """reference: completions/aggregator.rs."""
+    text_parts: list[str] = []
+    finish_reason = None
+    base: dict = {}
+    usage = None
+    async for chunk in chunks:
+        if not base:
+            base = {k: chunk.get(k) for k in ("id", "created", "model")}
+        if chunk.get("usage"):
+            usage = chunk["usage"]
+        for choice in chunk.get("choices", []):
+            if choice.get("text"):
+                text_parts.append(choice["text"])
+            if choice.get("finish_reason"):
+                finish_reason = choice["finish_reason"]
+    out = {
+        "id": base.get("id"),
+        "object": "text_completion",
+        "created": base.get("created"),
+        "model": base.get("model"),
+        "choices": [
+            {"index": 0, "text": "".join(text_parts), "finish_reason": finish_reason}
+        ],
+    }
+    if usage:
+        out["usage"] = usage
+    return out

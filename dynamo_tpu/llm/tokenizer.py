@@ -1,0 +1,103 @@
+"""Tokenizer wrapper with incremental (streaming) detokenization.
+
+Equivalent of the reference's tokenizer layer (reference:
+lib/llm/src/tokenizers.rs): a thin wrapper over the HuggingFace `tokenizers`
+runtime plus a `DecodeStream` that converts a stream of token ids into text
+increments without ever re-decoding the full sequence.
+
+Incremental decode uses the prefix-window technique: keep the last few
+undecoded ids, decode `prefix` and `prefix+new` and emit the suffix — this
+handles multi-byte/multi-token unicode and SentencePiece leading-space
+conventions correctly (same approach as the reference's DecodeStream).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional, Sequence
+
+from tokenizers import Tokenizer
+
+
+class HuggingFaceTokenizer:
+    def __init__(self, tokenizer: Tokenizer, config: Optional[dict] = None):
+        self._tok = tokenizer
+        self.config = config or {}
+
+    @classmethod
+    def from_file(cls, path: str) -> "HuggingFaceTokenizer":
+        """`path` is a tokenizer.json file or a model dir containing one."""
+        if os.path.isdir(path):
+            config = {}
+            cfg_path = os.path.join(path, "tokenizer_config.json")
+            if os.path.exists(cfg_path):
+                with open(cfg_path) as f:
+                    config = json.load(f)
+            return cls(Tokenizer.from_file(os.path.join(path, "tokenizer.json")), config)
+        return cls(Tokenizer.from_file(path))
+
+    def encode(self, text: str, add_special_tokens: bool = True) -> list[int]:
+        return self._tok.encode(text, add_special_tokens=add_special_tokens).ids
+
+    def decode(self, ids: Sequence[int], skip_special_tokens: bool = True) -> str:
+        return self._tok.decode(list(ids), skip_special_tokens=skip_special_tokens)
+
+    def token_to_id(self, token: str) -> Optional[int]:
+        return self._tok.token_to_id(token)
+
+    def id_to_token(self, token_id: int) -> Optional[str]:
+        return self._tok.id_to_token(token_id)
+
+    @property
+    def vocab_size(self) -> int:
+        return self._tok.get_vocab_size()
+
+    def eos_token_ids(self) -> list[int]:
+        """Collect eos ids from tokenizer_config (eos_token) if present."""
+        ids = []
+        eos = self.config.get("eos_token")
+        if isinstance(eos, dict):
+            eos = eos.get("content")
+        if isinstance(eos, str):
+            tid = self.token_to_id(eos)
+            if tid is not None:
+                ids.append(tid)
+        return ids
+
+    def decode_stream(self, skip_special_tokens: bool = True) -> "DecodeStream":
+        return DecodeStream(self, skip_special_tokens)
+
+
+class DecodeStream:
+    """Incremental detokenizer (reference: tokenizers.rs DecodeStream)."""
+
+    def __init__(self, tokenizer: HuggingFaceTokenizer, skip_special_tokens: bool = True):
+        self._tok = tokenizer
+        self._skip = skip_special_tokens
+        self._ids: list[int] = []
+        self._prefix_offset = 0  # start of the comparison window
+        self._read_offset = 0  # ids before this are already emitted
+
+    def step(self, token_id: int) -> Optional[str]:
+        """Feed one token id; returns newly-decodable text or None (e.g. the
+        id is part of an incomplete multi-token unicode character)."""
+        self._ids.append(token_id)
+        prefix_text = self._tok.decode(
+            self._ids[self._prefix_offset : self._read_offset],
+            skip_special_tokens=self._skip,
+        )
+        new_text = self._tok.decode(
+            self._ids[self._prefix_offset :], skip_special_tokens=self._skip
+        )
+        if new_text.endswith("�"):
+            # incomplete utf-8 sequence; wait for more ids
+            return None
+        if len(new_text) <= len(prefix_text):
+            # nothing new materialized (e.g. pure special token)
+            self._read_offset = len(self._ids)
+            return None
+        text = new_text[len(prefix_text) :]
+        self._prefix_offset = self._read_offset
+        self._read_offset = len(self._ids)
+        return text

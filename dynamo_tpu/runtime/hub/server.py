@@ -107,10 +107,13 @@ class HubServer:
             self._expiry_task = None
         if self._server:
             self._server.close()
+            # Close live connections BEFORE wait_closed(): since 3.12
+            # wait_closed() also waits for all connection handlers, which
+            # would deadlock while peers keep their connections open.
+            for conn in list(self._conns.values()):
+                conn.writer.close()
             await self._server.wait_closed()
             self._server = None
-        for conn in list(self._conns.values()):
-            conn.writer.close()
         self._conns.clear()
 
     async def serve_forever(self) -> None:

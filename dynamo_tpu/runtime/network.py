@@ -51,6 +51,7 @@ class DataPlaneServer:
         self._handlers: dict[str, BytesHandler] = {}
         self._server: Optional[asyncio.Server] = None
         self._inflight: dict[tuple[int, int], Context] = {}  # (conn, sid) -> ctx
+        self._conns: dict[asyncio.StreamWriter, asyncio.Queue] = {}  # writer -> outbox
         self._conn_ids = itertools.count(1)
         self._drained = asyncio.Event()
         self._drained.set()
@@ -75,8 +76,6 @@ class DataPlaneServer:
         self._closing = True
         if self._server:
             self._server.close()
-            await self._server.wait_closed()
-            self._server = None
         for ctx in self._inflight.values():
             ctx.stop_generating()
         try:
@@ -85,12 +84,29 @@ class DataPlaneServer:
             log.warning("drain timeout with %d streams in flight", len(self._inflight))
             for ctx in self._inflight.values():
                 ctx.kill()
+        # Let per-connection sender loops flush queued response frames (the
+        # drained streams' final data/end frames may still sit in outboxes).
+        flush_deadline = asyncio.get_running_loop().time() + 5.0
+        while any(not q.empty() for q in self._conns.values()):
+            if asyncio.get_running_loop().time() > flush_deadline:
+                log.warning("outbox flush timeout on shutdown")
+                break
+            await asyncio.sleep(0.01)
+        # Close live peer connections BEFORE wait_closed(): since 3.12 it
+        # waits for all connection handlers, which would deadlock while
+        # clients keep pooled connections open.
+        for writer in list(self._conns):
+            writer.close()
+        if self._server:
+            await self._server.wait_closed()
+            self._server = None
 
     async def _handle_conn(
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
     ) -> None:
         conn_id = next(self._conn_ids)
         outbox: asyncio.Queue = asyncio.Queue()
+        self._conns[writer] = outbox
         sender = asyncio.create_task(self._sender_loop(writer, outbox))
         tasks: dict[int, asyncio.Task] = {}
         try:
@@ -126,6 +142,7 @@ class DataPlaneServer:
                 if cid == conn_id:
                     ctx.kill()
             sender.cancel()
+            self._conns.pop(writer, None)
             writer.close()
 
     async def _sender_loop(self, writer: asyncio.StreamWriter, outbox: asyncio.Queue):

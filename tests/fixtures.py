@@ -1,0 +1,83 @@
+"""Self-generated test fixtures: a tiny BPE tokenizer + HF-style model dir.
+
+Built programmatically (no network, no copied artifacts) once per session
+under a cache dir. Mirrors the role of the reference's checked-in
+sample-model dirs (reference: lib/llm/tests/data/sample-models/).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+
+_CORPUS = [
+    "the quick brown fox jumps over the lazy dog",
+    "pack my box with five dozen liquor jugs",
+    "how vexingly quick daft zebras jump",
+    "sphinx of black quartz judge my vow",
+    "hello world this is a tiny test corpus for the tokenizer",
+    "streaming tokens over the wire one at a time",
+    "paged attention blocks live in high bandwidth memory",
+    "the mesh has eight devices and two axes",
+    "STOP right there and END the stream now",
+    "unicode snowman ☃ and accents éàü for byte level coverage",
+]
+
+CHAT_TEMPLATE = (
+    "{% for message in messages %}"
+    "<|{{ message['role'] }}|>\n{{ message['content'] }}<|eot|>\n"
+    "{% endfor %}"
+    "{% if add_generation_prompt %}<|assistant|>\n{% endif %}"
+)
+
+_DIR = None
+
+
+def tiny_model_dir() -> str:
+    """Create (once) and return a tiny HF-style model dir."""
+    global _DIR
+    if _DIR is not None and os.path.exists(os.path.join(_DIR, "tokenizer.json")):
+        return _DIR
+    from tokenizers import Tokenizer, decoders, models, pre_tokenizers, trainers
+
+    path = os.path.join(tempfile.gettempdir(), "dynamo_tpu_tiny_model")
+    os.makedirs(path, exist_ok=True)
+    tok = Tokenizer(models.BPE(unk_token=None))
+    tok.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False)
+    tok.decoder = decoders.ByteLevel()
+    trainer = trainers.BpeTrainer(
+        vocab_size=512,
+        special_tokens=["<|bos|>", "<|eos|>", "<|eot|>", "<|user|>", "<|assistant|>", "<|system|>"],
+        show_progress=False,
+    )
+    tok.train_from_iterator(_CORPUS, trainer)
+    tok.save(os.path.join(path, "tokenizer.json"))
+    with open(os.path.join(path, "tokenizer_config.json"), "w") as f:
+        json.dump(
+            {
+                "bos_token": "<|bos|>",
+                "eos_token": "<|eos|>",
+                "chat_template": CHAT_TEMPLATE,
+            },
+            f,
+        )
+    with open(os.path.join(path, "config.json"), "w") as f:
+        json.dump(
+            {
+                "architectures": ["LlamaForCausalLM"],
+                "model_type": "llama",
+                "max_position_embeddings": 2048,
+                "hidden_size": 64,
+                "intermediate_size": 128,
+                "num_attention_heads": 4,
+                "num_key_value_heads": 2,
+                "num_hidden_layers": 2,
+                "vocab_size": 512,
+                "rms_norm_eps": 1e-5,
+                "rope_theta": 10000.0,
+            },
+            f,
+        )
+    _DIR = path
+    return path

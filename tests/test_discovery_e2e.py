@@ -1,0 +1,83 @@
+"""Distributed e2e: worker registers a model; frontend discovers it and
+serves OpenAI chat over the network — all CPU, echo engine.
+
+This is the dynamo-tpu equivalent of the reference's first e2e milestone
+(`dynamo run in=http out=dyn://... | in=dyn://... out=echo_core`).
+"""
+
+import asyncio
+
+import aiohttp
+
+from dynamo_tpu.llm.engines import EchoEngineCore
+from dynamo_tpu.llm.http.discovery import ModelWatcher, register_llm
+from dynamo_tpu.llm.http.service import HttpService
+from dynamo_tpu.llm.model_card import ModelDeploymentCard
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+from .fixtures import tiny_model_dir
+from .helpers import hub_server
+
+
+async def test_worker_frontend_e2e():
+    async with hub_server() as server:
+        hub_addr = f"127.0.0.1:{server.port}"
+        worker = await DistributedRuntime.from_settings(hub_addr=hub_addr)
+        frontend = await DistributedRuntime.from_settings(hub_addr=hub_addr)
+        svc = HttpService()
+        watcher = ModelWatcher(frontend, svc.manager)
+        try:
+            # worker side: publish card + entry, serve echo engine
+            card = ModelDeploymentCard.from_local_path(tiny_model_dir(), name="tiny-echo")
+            await register_llm(
+                worker, EchoEngineCore(), card, "dyn://demo.backend.generate"
+            )
+
+            # frontend side: watcher + http
+            await watcher.start()
+            await svc.start("127.0.0.1", 0)
+            for _ in range(50):
+                if svc.manager.get_chat("tiny-echo"):
+                    break
+                await asyncio.sleep(0.1)
+            assert svc.manager.get_chat("tiny-echo") is not None
+
+            async with aiohttp.ClientSession(f"http://127.0.0.1:{svc.port}") as session:
+                r = await session.post(
+                    "/v1/chat/completions",
+                    json={
+                        "model": "tiny-echo",
+                        "messages": [{"role": "user", "content": "jump the lazy dog"}],
+                    },
+                )
+                assert r.status == 200
+                body = await r.json()
+                assert "jump the lazy dog" in body["choices"][0]["message"]["content"]
+
+                # streaming too
+                r = await session.post(
+                    "/v1/chat/completions",
+                    json={
+                        "model": "tiny-echo",
+                        "messages": [{"role": "user", "content": "stream me"}],
+                        "stream": True,
+                    },
+                )
+                assert r.status == 200
+                text = await r.text()
+                assert "data: [DONE]" in text
+
+            # worker goes away → model disappears from the frontend
+            await worker.shutdown()
+            worker = None
+            for _ in range(50):
+                if svc.manager.get_chat("tiny-echo") is None:
+                    break
+                await asyncio.sleep(0.1)
+            assert svc.manager.get_chat("tiny-echo") is None
+        finally:
+            await watcher.stop()
+            await svc.stop()
+            if worker is not None:
+                await worker.shutdown()
+            await frontend.shutdown()

@@ -1,0 +1,202 @@
+"""HTTP service tests: SSE round trips, aggregation, error paths, metrics.
+
+Mirrors reference coverage in lib/llm/tests/http-service.rs (counting /
+always-fail engines, full SSE round trip) using aiohttp's client.
+"""
+
+import contextlib
+import json
+
+import aiohttp
+
+from dynamo_tpu.llm.backend import Backend
+from dynamo_tpu.llm.engines import AlwaysFailEngine, EchoEngineCore, EchoEngineFull
+from dynamo_tpu.llm.http.service import HttpService
+from dynamo_tpu.llm.model_card import ModelDeploymentCard
+from dynamo_tpu.llm.preprocessor import OpenAIPreprocessor
+from dynamo_tpu.runtime.pipeline.engine import link
+
+from .fixtures import tiny_model_dir
+
+
+@contextlib.asynccontextmanager
+async def http_service():
+    svc = HttpService()
+    card = ModelDeploymentCard.from_local_path(tiny_model_dir(), name="tiny")
+    pipeline = link(OpenAIPreprocessor(card), Backend.from_card(card), EchoEngineCore())
+    svc.manager.add_chat_model("tiny", pipeline)
+    svc.manager.add_completion_model("tiny", pipeline)
+    svc.manager.add_chat_model("echo", EchoEngineFull())
+    svc.manager.add_chat_model("broken", AlwaysFailEngine())
+    await svc.start("127.0.0.1", 0)
+    async with aiohttp.ClientSession(f"http://127.0.0.1:{svc.port}") as session:
+        try:
+            yield svc, session
+        finally:
+            pass
+    await svc.stop()
+
+
+async def _read_sse(resp):
+    """Parse an SSE body into (events, data_items, done_seen)."""
+    events, items, done = [], [], False
+    current_event = None
+    async for raw_line in resp.content:
+        line = raw_line.decode().rstrip("\n")
+        if line.startswith("event: "):
+            current_event = line[len("event: ") :]
+        elif line.startswith("data: "):
+            data = line[len("data: ") :]
+            if data == "[DONE]":
+                done = True
+            elif current_event:
+                events.append((current_event, json.loads(data)))
+                current_event = None
+            else:
+                items.append(json.loads(data))
+    return events, items, done
+
+
+async def test_models_and_health():
+    async with http_service() as (svc, session):
+        r = await session.get("/v1/models")
+        assert r.status == 200
+        names = {m["id"] for m in (await r.json())["data"]}
+        assert {"tiny", "echo", "broken"} <= names
+        r = await session.get("/health")
+        assert r.status == 200
+
+
+async def test_chat_streaming_sse():
+    async with http_service() as (svc, session):
+        r = await session.post(
+            "/v1/chat/completions",
+            json={
+                "model": "tiny",
+                "messages": [{"role": "user", "content": "hello world"}],
+                "stream": True,
+                "dyn_ext": {"annotations": ["token_ids"]},
+            },
+        )
+        assert r.status == 200
+        assert r.headers["Content-Type"].startswith("text/event-stream")
+        events, items, done = await _read_sse(r)
+        assert done
+        assert any(name == "token_ids" for name, _ in events)
+        text = "".join(
+            c["choices"][0]["delta"].get("content", "")
+            for c in items
+            if c.get("choices")
+        )
+        assert "hello world" in text
+        finishes = [
+            c["choices"][0].get("finish_reason") for c in items if c.get("choices")
+        ]
+        assert finishes[-1] is not None
+
+
+async def test_chat_non_streaming():
+    async with http_service() as (svc, session):
+        r = await session.post(
+            "/v1/chat/completions",
+            json={
+                "model": "tiny",
+                "messages": [{"role": "user", "content": "pack my box"}],
+            },
+        )
+        assert r.status == 200
+        body = await r.json()
+        assert body["object"] == "chat.completion"
+        assert "pack my box" in body["choices"][0]["message"]["content"]
+        assert body["usage"]["total_tokens"] > 0
+
+
+async def test_completions_endpoint():
+    async with http_service() as (svc, session):
+        r = await session.post(
+            "/v1/completions",
+            json={"model": "tiny", "prompt": "five dozen liquor jugs"},
+        )
+        assert r.status == 200
+        body = await r.json()
+        assert body["object"] == "text_completion"
+        assert "five dozen" in body["choices"][0]["text"]
+
+
+async def test_error_paths():
+    async with http_service() as (svc, session):
+        r = await session.post(
+            "/v1/chat/completions",
+            json={"model": "nope", "messages": [{"role": "user", "content": "x"}]},
+        )
+        assert r.status == 404
+        r = await session.post(
+            "/v1/chat/completions", data=b"{not json", headers={"Content-Type": "application/json"}
+        )
+        assert r.status == 400
+        r = await session.post("/v1/chat/completions", json={"model": "tiny"})
+        assert r.status == 400  # missing messages
+        r = await session.post(
+            "/v1/chat/completions",
+            json={"model": "broken", "messages": [{"role": "user", "content": "x"}]},
+        )
+        assert r.status == 502
+
+
+def test_histogram_buckets_are_cumulative_once():
+    """Regression: bucket counts must never exceed +Inf/_count."""
+    from dynamo_tpu.llm.http.metrics import Histogram
+
+    h = Histogram("t_seconds", "test", buckets=(0.1, 1.0, 10.0))
+    h.observe(0.05)
+    lines = list(h.render())
+    counts = {
+        line.split("le=")[1].split("}")[0].strip('"'): float(line.rsplit(" ", 1)[1])
+        for line in lines
+        if "_bucket" in line
+    }
+    assert counts == {"0.1": 1.0, "1.0": 1.0, "10.0": 1.0, "+Inf": 1.0}
+
+
+async def test_content_parts_messages():
+    """OpenAI content-part lists are flattened to text before templating."""
+    async with http_service() as (svc, session):
+        r = await session.post(
+            "/v1/chat/completions",
+            json={
+                "model": "tiny",
+                "messages": [
+                    {
+                        "role": "user",
+                        "content": [{"type": "text", "text": "hello world"}],
+                    }
+                ],
+            },
+        )
+        assert r.status == 200
+        body = await r.json()
+        assert "hello world" in body["choices"][0]["message"]["content"]
+        assert "'type'" not in body["choices"][0]["message"]["content"]
+        # unsupported part type → 400, not 502
+        r = await session.post(
+            "/v1/chat/completions",
+            json={
+                "model": "tiny",
+                "messages": [
+                    {"role": "user", "content": [{"type": "image_url", "image_url": {}}]}
+                ],
+            },
+        )
+        assert r.status == 400
+
+
+async def test_metrics_exposed():
+    async with http_service() as (svc, session):
+        await session.post(
+            "/v1/chat/completions",
+            json={"model": "tiny", "messages": [{"role": "user", "content": "hi"}]},
+        )
+        r = await session.get("/metrics")
+        text = await r.text()
+        assert 'dynamo_tpu_http_service_requests_total{endpoint="chat",model="tiny",status="success"} 1' in text
+        assert "dynamo_tpu_http_service_request_duration_seconds_bucket" in text

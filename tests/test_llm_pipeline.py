@@ -1,0 +1,183 @@
+"""Preprocessor / backend / echo-engine pipeline tests (CPU-only).
+
+Mirrors reference coverage in lib/llm/tests/{preprocessor,backend}.rs using
+the self-generated tiny model fixture.
+"""
+
+from dynamo_tpu.llm.backend import Backend, StopSequenceDecoder
+from dynamo_tpu.llm.engines import EchoEngineCore
+from dynamo_tpu.llm.model_card import ModelDeploymentCard
+from dynamo_tpu.llm.preprocessor import OpenAIPreprocessor
+from dynamo_tpu.llm.protocols.openai import (
+    ChatCompletionRequest,
+    CompletionRequest,
+    RequestError,
+    aggregate_chat_stream,
+)
+from dynamo_tpu.llm.tokenizer import HuggingFaceTokenizer
+from dynamo_tpu.runtime.pipeline.context import Context
+from dynamo_tpu.runtime.pipeline.engine import link
+
+from .fixtures import tiny_model_dir
+
+
+def make_card():
+    return ModelDeploymentCard.from_local_path(tiny_model_dir(), name="tiny")
+
+
+def test_card_from_local_path():
+    card = make_card()
+    assert card.display_name == "tiny"
+    assert card.architecture == "LlamaForCausalLM"
+    assert card.context_length == 2048
+    assert "tokenizer.json" in card.artifacts
+    assert card.checksum
+
+
+def test_chat_template_rendering():
+    card = make_card()
+    pre = OpenAIPreprocessor(card)
+    req = ChatCompletionRequest.from_body(
+        {
+            "model": "tiny",
+            "messages": [
+                {"role": "system", "content": "be brief"},
+                {"role": "user", "content": "hello world"},
+            ],
+        }
+    )
+    built, prompt = pre.preprocess_chat(req)
+    assert "<|system|>\nbe brief<|eot|>" in prompt
+    assert "<|user|>\nhello world<|eot|>" in prompt
+    assert prompt.endswith("<|assistant|>\n")
+    assert built.token_ids
+    assert built.mdc_sum == card.checksum
+
+
+def test_tokenize_roundtrip():
+    tok = HuggingFaceTokenizer.from_file(tiny_model_dir())
+    text = "the quick brown fox ☃ jumps"
+    ids = tok.encode(text)
+    assert tok.decode(ids) == text
+
+
+def test_decode_stream_incremental():
+    tok = HuggingFaceTokenizer.from_file(tiny_model_dir())
+    text = "hello world the quick brown fox é☃ end"
+    ids = tok.encode(text)
+    ds = tok.decode_stream()
+    out = ""
+    for tid in ids:
+        piece = ds.step(tid)
+        if piece:
+            out += piece
+    assert out == text
+
+
+def test_context_length_rejection():
+    card = make_card()
+    card.context_length = 4
+    pre = OpenAIPreprocessor(card)
+    req = ChatCompletionRequest.from_body(
+        {"model": "tiny", "messages": [{"role": "user", "content": "a " * 50}]}
+    )
+    try:
+        pre.preprocess_chat(req)
+        raise AssertionError("expected RequestError")
+    except RequestError as exc:
+        assert "context length" in str(exc)
+
+
+def test_stop_sequence_decoder_jail():
+    """A stop string split across token boundaries must be jailed and
+    suppressed; text before it must be released."""
+    tok = HuggingFaceTokenizer.from_file(tiny_model_dir())
+    # "END" will arrive via byte-level tokens; use a stop string present in vocab corpus
+    ids = tok.encode("hello world STOP right there")
+    dec = StopSequenceDecoder(
+        tok,
+        stop_sequences=["STOP"],
+        eos_token_ids=set(),
+        stop_token_ids=set(),
+        max_tokens=None,
+    )
+    out = ""
+    for tid in ids:
+        piece = dec.step(tid)
+        if piece:
+            out += piece
+        if dec.finished:
+            break
+    assert dec.finished
+    assert dec.finish_reason == "stop"
+    assert out == "hello world "
+    assert "STOP" not in out
+
+
+def test_stop_decoder_max_tokens():
+    tok = HuggingFaceTokenizer.from_file(tiny_model_dir())
+    ids = tok.encode("one two three four five six")
+    dec = StopSequenceDecoder(
+        tok, stop_sequences=[], eos_token_ids=set(), stop_token_ids=set(), max_tokens=3
+    )
+    for tid in ids:
+        dec.step(tid)
+        if dec.finished:
+            break
+    assert dec.finish_reason == "length"
+
+
+def test_stop_decoder_eos():
+    tok = HuggingFaceTokenizer.from_file(tiny_model_dir())
+    eos = tok.token_to_id("<|eos|>")
+    dec = StopSequenceDecoder(
+        tok, stop_sequences=[], eos_token_ids={eos}, stop_token_ids=set(), max_tokens=None
+    )
+    ids = tok.encode("some text")
+    for tid in ids:
+        dec.step(tid)
+    assert not dec.finished
+    dec.step(eos)
+    assert dec.finish_reason == "stop"
+
+
+async def test_full_pipeline_chat_echo():
+    """link(preprocessor, backend, echo_core): the prompt tokens round-trip
+    through tokenize → echo → detokenize and come back as chat chunks."""
+    card = make_card()
+    pipeline = link(OpenAIPreprocessor(card), Backend.from_card(card), EchoEngineCore())
+    req = ChatCompletionRequest.from_body(
+        {
+            "model": "tiny",
+            "messages": [{"role": "user", "content": "the quick brown fox"}],
+            "dyn_ext": {"annotations": ["formatted_prompt", "token_ids"]},
+        }
+    )
+    items = [i async for i in await pipeline.generate(Context(req))]
+    annotations = [i for i in items if "__annotation__" in i]
+    chunks = [i for i in items if "__annotation__" not in i]
+    assert {a["__annotation__"] for a in annotations} == {"formatted_prompt", "token_ids"}
+    text = "".join(
+        c["choices"][0]["delta"].get("content", "")
+        for c in chunks
+        if c.get("choices")
+    )
+    # echo returns the whole templated prompt detokenized
+    assert "the quick brown fox" in text
+
+    async def _chunks():
+        for c in chunks:
+            yield c
+
+    full = await aggregate_chat_stream(_chunks())
+    assert full["object"] == "chat.completion"
+    assert "the quick brown fox" in full["choices"][0]["message"]["content"]
+    assert full["usage"]["completion_tokens"] > 0
+
+
+async def test_completion_pipeline_with_token_prompt():
+    card = make_card()
+    pre = OpenAIPreprocessor(card)
+    req = CompletionRequest.from_body({"model": "tiny", "prompt": [5, 6, 7]})
+    built, _ = pre.preprocess_completion(req)
+    assert built.token_ids == [5, 6, 7]

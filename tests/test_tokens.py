@@ -1,0 +1,55 @@
+"""Block hashing tests (mirroring reference: lib/tokens/src/lib.rs tests)."""
+
+from dynamo_tpu.llm.tokens import (
+    ROOT_PARENT_HASH,
+    TokenBlockSequence,
+    chain_hash,
+    compute_block_hashes,
+    hash_block_tokens,
+)
+
+
+def test_block_chunking_and_partial():
+    seq = TokenBlockSequence(range(10), block_size=4)
+    assert len(seq.blocks) == 2
+    assert seq.partial == [8, 9]
+    assert seq.total_tokens == 10
+    assert seq.all_tokens() == list(range(10))
+    assert seq.blocks[0].tokens == (0, 1, 2, 3)
+    assert seq.blocks[1].parent_sequence_hash == seq.blocks[0].sequence_hash
+    assert seq.blocks[0].parent_sequence_hash == ROOT_PARENT_HASH
+
+
+def test_incremental_extend_matches_bulk():
+    bulk = TokenBlockSequence(range(20), block_size=4)
+    inc = TokenBlockSequence([], block_size=4)
+    for t in range(20):
+        inc.extend([t])
+    assert bulk.sequence_hashes() == inc.sequence_hashes()
+
+
+def test_hash_determinism_and_chaining():
+    h1 = hash_block_tokens([1, 2, 3, 4])
+    assert h1 == hash_block_tokens([1, 2, 3, 4])
+    assert h1 != hash_block_tokens([1, 2, 3, 5])
+    assert chain_hash(0, h1) != chain_hash(h1, h1)
+
+
+def test_same_block_different_prefix_different_sequence_hash():
+    a = compute_block_hashes([1, 2, 3, 4, 9, 9, 9, 9], 4)
+    b = compute_block_hashes([5, 6, 7, 8, 9, 9, 9, 9], 4)
+    # same second block tokens, different parents → different sequence hashes
+    assert a[1] != b[1]
+
+
+def test_salt_changes_hashes():
+    assert compute_block_hashes([1, 2, 3, 4], 4) != compute_block_hashes(
+        [1, 2, 3, 4], 4, salt=b"model-v2"
+    )
+
+
+def test_shared_prefix_shares_hashes():
+    a = compute_block_hashes(list(range(16)) + [100, 101, 102, 103], 4)
+    b = compute_block_hashes(list(range(16)) + [200, 201, 202, 203], 4)
+    assert a[:4] == b[:4]
+    assert a[4] != b[4]
