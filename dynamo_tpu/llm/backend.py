@@ -156,15 +156,19 @@ class Backend(Operator):
                     yield EngineOutput.final(FINISH_REASON_CANCELLED).to_dict()
                     return
                 text_parts: list[str] = []
+                consumed = 0
                 for tid in out.token_ids:
                     piece = decoder.step(tid)
+                    consumed += 1
                     if piece:
                         text_parts.append(piece)
                     if decoder.finished:
                         break
                 if text_parts or decoder.finished:
+                    # only the consumed prefix: tokens past a mid-chunk stop
+                    # must not leak into usage accounting downstream
                     yield EngineOutput(
-                        token_ids=out.token_ids,
+                        token_ids=out.token_ids[:consumed],
                         text="".join(text_parts) or None,
                         finish_reason=decoder.finish_reason,
                         meta=out.meta,
@@ -174,8 +178,12 @@ class Backend(Operator):
                     request.stop_generating()
                     return
                 if out.finish_reason:
-                    # engine finished on its own (its own length/stop logic)
-                    yield EngineOutput.final(out.finish_reason).to_dict()
+                    # engine finished on its own (its own length/stop logic):
+                    # release any text held back as a partial stop-string match
+                    tail = decoder._flush_jail(None)
+                    yield EngineOutput(
+                        text=tail, finish_reason=out.finish_reason
+                    ).to_dict()
                     return
 
         return _out()

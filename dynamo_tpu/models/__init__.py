@@ -1,0 +1,11 @@
+"""Native JAX model layer.
+
+The reference outsources model execution to vLLM/sglang behind engine
+adapters (reference: lib/engines/*); here the model is first-class and
+TPU-native: pure-functional forwards over stacked parameter pytrees,
+paged KV caches, and mesh-axis sharding (SURVEY.md §7 step 3).
+"""
+
+from dynamo_tpu.models.config import ModelConfig, PRESETS, get_config
+
+__all__ = ["ModelConfig", "PRESETS", "get_config"]

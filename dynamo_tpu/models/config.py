@@ -1,0 +1,189 @@
+"""Model configurations for the Llama family (Llama 2/3, Mistral, Qwen2).
+
+One config dataclass covers the architectures the reference serves through
+vLLM/sglang (reference: examples/llm/configs/*.yaml serve Llama/DeepSeek
+distill models; lib/engines/* accept arbitrary HF models). The TPU build
+owns the model natively, so the config is ours, not an engine passthrough.
+
+Conventions:
+- `head_dim` is explicit (Llama3 keeps hidden/heads, but e.g. Qwen2-0.5B
+  differs), GQA via `num_kv_heads < num_heads`.
+- `rope_scaling` carries the Llama-3.1 long-context NTK scaling dict.
+- dtypes: weights/activations bfloat16 on TPU (MXU-native), float32 for
+  norms/softmax accumulation inside the ops.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import Any, Optional
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    name: str
+    vocab_size: int
+    hidden_size: int
+    intermediate_size: int
+    num_layers: int
+    num_heads: int
+    num_kv_heads: int
+    head_dim: int
+    rope_theta: float = 500000.0
+    rms_norm_eps: float = 1e-5
+    max_position_embeddings: int = 131072
+    tie_word_embeddings: bool = False
+    attn_bias: bool = False  # qwen2-style qkv bias
+    rope_scaling: Optional[dict[str, Any]] = None
+    dtype: str = "bfloat16"
+
+    @property
+    def q_size(self) -> int:
+        return self.num_heads * self.head_dim
+
+    @property
+    def kv_size(self) -> int:
+        return self.num_kv_heads * self.head_dim
+
+    def with_(self, **kw) -> "ModelConfig":
+        return replace(self, **kw)
+
+    @classmethod
+    def from_hf_config(cls, hf: dict, name: str = "hf-model") -> "ModelConfig":
+        """Build from a HuggingFace config.json dict (llama/mistral/qwen2)."""
+        num_heads = hf["num_attention_heads"]
+        head_dim = hf.get("head_dim") or hf["hidden_size"] // num_heads
+        return cls(
+            name=name,
+            vocab_size=hf["vocab_size"],
+            hidden_size=hf["hidden_size"],
+            intermediate_size=hf["intermediate_size"],
+            num_layers=hf["num_hidden_layers"],
+            num_heads=num_heads,
+            num_kv_heads=hf.get("num_key_value_heads", num_heads),
+            head_dim=head_dim,
+            rope_theta=hf.get("rope_theta", 10000.0),
+            rms_norm_eps=hf.get("rms_norm_eps", 1e-5),
+            max_position_embeddings=hf.get("max_position_embeddings", 8192),
+            tie_word_embeddings=hf.get("tie_word_embeddings", False),
+            attn_bias=hf.get("model_type") == "qwen2",
+            rope_scaling=hf.get("rope_scaling"),
+        )
+
+
+_LLAMA31_SCALING = {
+    "rope_type": "llama3",
+    "factor": 8.0,
+    "low_freq_factor": 1.0,
+    "high_freq_factor": 4.0,
+    "original_max_position_embeddings": 8192,
+}
+
+PRESETS: dict[str, ModelConfig] = {}
+
+
+def _preset(cfg: ModelConfig) -> ModelConfig:
+    PRESETS[cfg.name] = cfg
+    return cfg
+
+# Tiny config for CPU tests: dims respect TPU tiling multiples where cheap.
+TINY = _preset(ModelConfig(
+    name="tiny",
+    vocab_size=256,
+    hidden_size=64,
+    intermediate_size=128,
+    num_layers=2,
+    num_heads=4,
+    num_kv_heads=2,
+    head_dim=16,
+    rope_theta=10000.0,
+    max_position_embeddings=2048,
+    tie_word_embeddings=True,
+))
+
+# A ~1.2B debug/bench config (fits any single TPU chip in bf16).
+_preset(ModelConfig(
+    name="llama-3.2-1b",
+    vocab_size=128256,
+    hidden_size=2048,
+    intermediate_size=8192,
+    num_layers=16,
+    num_heads=32,
+    num_kv_heads=8,
+    head_dim=64,
+    rope_scaling=_LLAMA31_SCALING,
+    tie_word_embeddings=True,
+))
+
+_preset(ModelConfig(
+    name="llama-3.2-3b",
+    vocab_size=128256,
+    hidden_size=3072,
+    intermediate_size=8192,
+    num_layers=28,
+    num_heads=24,
+    num_kv_heads=8,
+    head_dim=128,
+    rope_scaling=_LLAMA31_SCALING,
+    tie_word_embeddings=True,
+))
+
+# Flagship (BASELINE.json north star: disagg Llama-3.1-8B on v5e-16).
+_preset(ModelConfig(
+    name="llama-3.1-8b",
+    vocab_size=128256,
+    hidden_size=4096,
+    intermediate_size=14336,
+    num_layers=32,
+    num_heads=32,
+    num_kv_heads=8,
+    head_dim=128,
+    rope_scaling=_LLAMA31_SCALING,
+))
+
+_preset(ModelConfig(
+    name="llama-3.1-70b",
+    vocab_size=128256,
+    hidden_size=8192,
+    intermediate_size=28672,
+    num_layers=80,
+    num_heads=64,
+    num_kv_heads=8,
+    head_dim=128,
+    rope_scaling=_LLAMA31_SCALING,
+))
+
+_preset(ModelConfig(
+    name="qwen2.5-0.5b",
+    vocab_size=151936,
+    hidden_size=896,
+    intermediate_size=4864,
+    num_layers=24,
+    num_heads=14,
+    num_kv_heads=2,
+    head_dim=64,
+    rope_theta=1000000.0,
+    rms_norm_eps=1e-6,
+    max_position_embeddings=32768,
+    tie_word_embeddings=True,
+    attn_bias=True,
+))
+
+_preset(ModelConfig(
+    name="mistral-7b",
+    vocab_size=32000,
+    hidden_size=4096,
+    intermediate_size=14336,
+    num_layers=32,
+    num_heads=32,
+    num_kv_heads=8,
+    head_dim=128,
+    rope_theta=1000000.0,
+    max_position_embeddings=32768,
+))
+
+
+def get_config(name: str) -> ModelConfig:
+    if name not in PRESETS:
+        raise KeyError(f"unknown model preset {name!r}; have {sorted(PRESETS)}")
+    return PRESETS[name]

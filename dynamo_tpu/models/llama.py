@@ -1,0 +1,179 @@
+"""Pure-functional Llama-family forward over a paged KV cache.
+
+One `forward()` serves prefill, chunked prefill and decode (see
+dynamo_tpu/ops/attention.py). Parameters are a plain pytree (dict of
+arrays, per-layer list) so sharding is an external concern
+(dynamo_tpu/parallel/mesh.py) and the same function runs on CPU tests,
+a single TPU chip, or a pjit mesh — XLA propagates the shardings.
+
+The reference never owns a model forward (it delegates to vLLM/sglang,
+reference: lib/engines/vllm0_8/src/lib.rs, SURVEY.md §2.3); this module is
+the "native engine" the TPU build adds (SURVEY.md §7 step 3).
+
+Weight layout: [in_features, out_features] (transposed from HF) so matmuls
+are `x @ w` — the natural MXU orientation.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.ops.attention import paged_attention, write_kv_slots
+from dynamo_tpu.ops.norm import rms_norm
+from dynamo_tpu.ops.rope import apply_rope, rope_cos_sin, rope_inv_freq
+
+Params = dict[str, Any]
+
+
+class KVCache(NamedTuple):
+    """Layer-stacked flat slot pools: k/v [num_layers, num_slots, K, Hd]."""
+
+    k: jnp.ndarray
+    v: jnp.ndarray
+
+    @property
+    def num_slots(self) -> int:
+        return self.k.shape[1]
+
+
+def init_kv_cache(
+    cfg: ModelConfig, num_slots: int, dtype=jnp.bfloat16
+) -> KVCache:
+    shape = (cfg.num_layers, num_slots, cfg.num_kv_heads, cfg.head_dim)
+    return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
+
+
+def _attn_block(
+    lp: Params,
+    cfg: ModelConfig,
+    x: jnp.ndarray,          # [B, T, D]
+    cos: jnp.ndarray,        # [B, T, Hd]
+    sin: jnp.ndarray,
+    kv_k: jnp.ndarray,       # [N, K, Hd] this layer's pools
+    kv_v: jnp.ndarray,
+    write_slots: jnp.ndarray,   # [B*T] int32
+    slot_matrix: jnp.ndarray,   # [B, C]
+    positions: jnp.ndarray,     # [B, T]
+):
+    b, t, _ = x.shape
+    h, kh, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+
+    q = x @ lp["wq"]
+    k = x @ lp["wk"]
+    v = x @ lp["wv"]
+    if cfg.attn_bias:
+        q = q + lp["bq"]
+        k = k + lp["bk"]
+        v = v + lp["bv"]
+    q = q.reshape(b, t, h, hd)
+    k = k.reshape(b, t, kh, hd)
+    v = v.reshape(b, t, kh, hd)
+
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+
+    kv_k, kv_v = write_kv_slots(
+        kv_k, kv_v, write_slots, k.reshape(b * t, kh, hd), v.reshape(b * t, kh, hd)
+    )
+    out = paged_attention(q, kv_k, kv_v, slot_matrix, positions)
+    return out.reshape(b, t, h * hd) @ lp["wo"], kv_k, kv_v
+
+
+def _mlp_block(lp: Params, x: jnp.ndarray) -> jnp.ndarray:
+    gate = jax.nn.silu(x @ lp["w_gate"])
+    up = x @ lp["w_up"]
+    return (gate * up) @ lp["w_down"]
+
+
+def forward(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,       # [B, T] int32
+    positions: jnp.ndarray,    # [B, T] int32 absolute positions
+    kv: KVCache,
+    write_slots: jnp.ndarray,  # [B*T] int32 flat slots for the new tokens (0=trash for pads)
+    slot_matrix: jnp.ndarray,  # [B, C] int32 per-sequence slot gather table
+) -> tuple[jnp.ndarray, KVCache]:
+    """One model step. Returns (hidden [B, T, D] after final norm, updated kv).
+
+    Logits are computed by `logits()` on the (usually sliced) hidden states
+    so prefill only pays the vocab matmul for the last position.
+    """
+    x = params["embed"][tokens]
+
+    inv_freq = jnp.asarray(rope_inv_freq(cfg))
+    cos, sin = rope_cos_sin(inv_freq, positions)  # [B, T, Hd]
+
+    new_k_layers = []
+    new_v_layers = []
+    for l, lp in enumerate(params["layers"]):
+        attn_in = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+        attn_out, layer_k, layer_v = _attn_block(
+            lp, cfg, attn_in, cos, sin, kv.k[l], kv.v[l],
+            write_slots, slot_matrix, positions,
+        )
+        x = x + attn_out
+        mlp_in = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
+        x = x + _mlp_block(lp, mlp_in)
+        new_k_layers.append(layer_k)
+        new_v_layers.append(layer_v)
+
+    kv = KVCache(k=jnp.stack(new_k_layers), v=jnp.stack(new_v_layers))
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    return x, kv
+
+
+def logits(params: Params, cfg: ModelConfig, hidden: jnp.ndarray) -> jnp.ndarray:
+    """Vocab projection [..., D] -> [..., V] in float32."""
+    head = params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
+    return jnp.einsum(
+        "...d,dv->...v", hidden, head, preferred_element_type=jnp.float32
+    )
+
+
+def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> Params:
+    """Random-init params (tests, benchmarks); HF loading lives in
+    dynamo_tpu/models/weights.py."""
+    d, f = cfg.hidden_size, cfg.intermediate_size
+    qs, kvs = cfg.q_size, cfg.kv_size
+    keys = iter(jax.random.split(key, 4 + 9 * cfg.num_layers))
+
+    def dense(k, shape, scale=None):
+        scale = scale or (shape[0] ** -0.5)
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(dtype)
+
+    layers = []
+    for _ in range(cfg.num_layers):
+        lp = {
+            "attn_norm": jnp.ones((d,), dtype),
+            "wq": dense(next(keys), (d, qs)),
+            "wk": dense(next(keys), (d, kvs)),
+            "wv": dense(next(keys), (d, kvs)),
+            "wo": dense(next(keys), (qs, d)),
+            "mlp_norm": jnp.ones((d,), dtype),
+            "w_gate": dense(next(keys), (d, f)),
+            "w_up": dense(next(keys), (d, f)),
+            "w_down": dense(next(keys), (f, d)),
+        }
+        if cfg.attn_bias:
+            lp["bq"] = jnp.zeros((qs,), dtype)
+            lp["bk"] = jnp.zeros((kvs,), dtype)
+            lp["bv"] = jnp.zeros((kvs,), dtype)
+        layers.append(lp)
+
+    params: Params = {
+        "embed": dense(next(keys), (cfg.vocab_size, d), scale=0.02),
+        "layers": layers,
+        "final_norm": jnp.ones((d,), dtype),
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = dense(next(keys), (d, cfg.vocab_size))
+    return params
+
+
+def param_count(params: Params) -> int:
+    return sum(int(p.size) for p in jax.tree.leaves(params))

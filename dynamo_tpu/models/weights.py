@@ -1,0 +1,108 @@
+"""Weight loading: HF safetensors checkpoints -> our param pytree.
+
+Equivalent surface to the reference's model resolution (reference:
+lib/llm/src/local_model.rs:37-124 + hub.rs — it downloads HF checkpoints for
+vLLM to load; here we load them into JAX directly). Zero-egress friendly:
+loads from a local directory only; `transformers` is used solely for
+tokenizers elsewhere.
+
+HF stores linear weights [out, in]; we store [in, out] (x @ w). Loading
+streams tensor-by-tensor so peak host memory is one tensor, and each tensor
+can be device_put against a sharding as it loads.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.models.llama import Params
+
+
+def _iter_safetensors(model_dir: str):
+    try:
+        from safetensors import safe_open  # packaged with transformers deps
+    except ImportError as e:  # pragma: no cover
+        raise RuntimeError("safetensors not available for weight loading") from e
+
+    files = sorted(
+        f for f in os.listdir(model_dir) if f.endswith(".safetensors")
+    )
+    if not files:
+        raise FileNotFoundError(f"no .safetensors files under {model_dir}")
+    for fname in files:
+        with safe_open(os.path.join(model_dir, fname), framework="np") as f:
+            for name in f.keys():
+                yield name, f.get_tensor(name)
+
+
+def load_config(model_dir: str, name: Optional[str] = None) -> ModelConfig:
+    with open(os.path.join(model_dir, "config.json")) as f:
+        hf = json.load(f)
+    return ModelConfig.from_hf_config(hf, name=name or os.path.basename(model_dir))
+
+
+def load_params(
+    model_dir: str,
+    cfg: ModelConfig,
+    dtype=jnp.bfloat16,
+    put: Optional[Callable[[str, np.ndarray], jnp.ndarray]] = None,
+) -> Params:
+    """Load params from a local HF checkpoint dir.
+
+    `put(path, np_array) -> jax array` lets the caller device_put each
+    tensor against its mesh sharding as it streams in; defaults to plain
+    jnp.asarray.
+    """
+    put = put or (lambda _path, arr: jnp.asarray(arr))
+
+    def convert(name: str, t: np.ndarray, transpose: bool) -> jnp.ndarray:
+        arr = np.ascontiguousarray(t.T) if transpose else t
+        return put(name, arr.astype(dtype))
+
+    layers: list[dict] = [dict() for _ in range(cfg.num_layers)]
+    params: Params = {"layers": layers}
+
+    hf_layer_map = {
+        "input_layernorm.weight": ("attn_norm", False),
+        "self_attn.q_proj.weight": ("wq", True),
+        "self_attn.k_proj.weight": ("wk", True),
+        "self_attn.v_proj.weight": ("wv", True),
+        "self_attn.o_proj.weight": ("wo", True),
+        "self_attn.q_proj.bias": ("bq", False),
+        "self_attn.k_proj.bias": ("bk", False),
+        "self_attn.v_proj.bias": ("bv", False),
+        "post_attention_layernorm.weight": ("mlp_norm", False),
+        "mlp.gate_proj.weight": ("w_gate", True),
+        "mlp.up_proj.weight": ("w_up", True),
+        "mlp.down_proj.weight": ("w_down", True),
+    }
+
+    for name, tensor in _iter_safetensors(model_dir):
+        if name == "model.embed_tokens.weight":
+            params["embed"] = convert(name, tensor, transpose=False)
+        elif name == "model.norm.weight":
+            params["final_norm"] = convert(name, tensor, transpose=False)
+        elif name == "lm_head.weight":
+            if not cfg.tie_word_embeddings:
+                params["lm_head"] = convert(name, tensor, transpose=True)
+        elif name.startswith("model.layers."):
+            rest = name[len("model.layers."):]
+            idx_s, _, sub = rest.partition(".")
+            mapped = hf_layer_map.get(sub)
+            if mapped is None:
+                continue  # rotary inv_freq etc.
+            ours, transpose = mapped
+            layers[int(idx_s)][ours] = convert(name, tensor, transpose)
+
+    missing = [
+        k for k in ("embed", "final_norm") if k not in params
+    ] + [f"layers[{i}]" for i, lp in enumerate(layers) if "wq" not in lp]
+    if missing:
+        raise ValueError(f"checkpoint {model_dir} missing tensors: {missing[:5]}")
+    return params

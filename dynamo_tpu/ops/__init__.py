@@ -1,0 +1,8 @@
+"""TPU compute ops: attention (prefill + paged decode), RoPE, norms, sampling.
+
+The reference's GPU hot ops live in vLLM/sglang CUDA kernels plus one
+first-party CUDA file (reference: lib/llm/src/kernels/block_copy.cu); here
+the hot path is JAX/XLA with Pallas TPU kernels where XLA fusion is not
+enough. Every op has a pure-`jax.numpy` implementation that runs on CPU —
+the correctness oracle for tests and the fallback off-TPU.
+"""

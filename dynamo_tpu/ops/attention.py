@@ -1,0 +1,98 @@
+"""Attention over a paged KV cache — one op for prefill, chunked prefill
+and decode.
+
+This is the TPU-native replacement for the engine-internal GPU attention the
+reference relies on (vLLM paged attention) plus its first-party block-copy
+kernel (reference: lib/llm/src/kernels/block_copy.cu — there, paging is a
+*copy* problem because attention lives inside vLLM; here paging is native to
+the attention op).
+
+KV cache layout (per layer): flat **slot** pools
+
+    k_cache, v_cache : [num_slots, num_kv_heads, head_dim]
+
+where slot = page_id * page_size + offset. Pages exist only in the
+allocator; the device sees flat slots, so scatter (write) and gather (read)
+are single-index ops and a reshape to [num_pages, page_size, K, Hd] is free
+when a Pallas kernel wants page-granular DMA. Slot 0 lives in the reserved
+trash page: padded positions scatter there, and it is never allocated.
+
+The unified step: new tokens' KV is **written first**, then queries attend
+over the sequence's gathered slots (which now include themselves) under the
+mask `slot_position <= query_position`. Prefill (cached_len=0), chunked
+prefill / prefix-cache hits (cached_len>0) and decode (T=1) are the same
+compiled graph family, bucketed by shape.
+
+Sharding: the `num_kv_heads` axis is the tensor-parallel axis; gathers and
+scatters are shard-local (no collectives on the KV path).
+
+All impls here are pure jax.numpy (run anywhere; the correctness oracle).
+Pallas TPU kernels live in `dynamo_tpu.ops.pallas_*` and are selected by the
+engine when running on TPU.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+_NEG_INF = -1e30
+
+
+def write_kv_slots(
+    k_cache: jnp.ndarray,  # [N, K, Hd]
+    v_cache: jnp.ndarray,
+    slots: jnp.ndarray,    # [M] int32 flat slot ids (0 = trash)
+    new_k: jnp.ndarray,    # [M, K, Hd]
+    new_v: jnp.ndarray,
+):
+    """Scatter per-token KV into the slot pool; in-place when donated.
+    Trash-slot writes (padding) are harmless by construction."""
+    return k_cache.at[slots].set(new_k), v_cache.at[slots].set(new_v)
+
+
+def slots_from_pages(block_tables: jnp.ndarray, page_size: int) -> jnp.ndarray:
+    """Expand page-id tables [..., W] into slot matrices [..., W*page_size]."""
+    s = block_tables[..., :, None] * page_size + jnp.arange(page_size)
+    return s.reshape(*block_tables.shape[:-1], -1)
+
+
+def _masked_softmax(logits: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+    """Softmax over the last axis in f32; fully-masked rows yield zeros."""
+    logits = jnp.where(mask, logits, _NEG_INF)
+    m = jnp.max(logits, axis=-1, keepdims=True)
+    p = jnp.exp(logits - m) * mask
+    denom = jnp.sum(p, axis=-1, keepdims=True)
+    return p / (denom + 1e-30)
+
+
+def paged_attention(
+    q: jnp.ndarray,            # [B, T, H, Hd] (rope applied; KV already written)
+    k_cache: jnp.ndarray,      # [N, K, Hd]
+    v_cache: jnp.ndarray,
+    slot_matrix: jnp.ndarray,  # [B, C] int32: the sequence's slots, position-ordered
+    positions: jnp.ndarray,    # [B, T] int32 absolute position of each query
+) -> jnp.ndarray:
+    """Gathered-slot attention. Gathered slot j holds absolute position j of
+    the sequence, so causality is `j <= positions[b, t]`; padded queries and
+    0-padded slot-table tails are masked out by the same comparison (their
+    garbage KV rides the trash page)."""
+    b, t, h, hd = q.shape
+    kh = k_cache.shape[1]
+    g = h // kh
+    scale = hd ** -0.5
+
+    k = k_cache[slot_matrix]  # [B, C, K, Hd]
+    v = v_cache[slot_matrix]
+    qg = q.reshape(b, t, kh, g, hd)
+    logits = jnp.einsum(
+        "btkgd,bskd->bkgts", qg, k, preferred_element_type=jnp.float32
+    ) * scale  # [B, K, G, T, C]
+
+    c = slot_matrix.shape[1]
+    j = jnp.arange(c)
+    mask = j[None, None, :] <= positions[:, :, None]  # [B, T, C]
+    mask = mask[:, None, None, :, :]
+
+    probs = _masked_softmax(logits, mask)
+    out = jnp.einsum("bkgts,bskd->btkgd", probs.astype(v.dtype), v)
+    return out.reshape(b, t, h, hd)

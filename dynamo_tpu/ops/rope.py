@@ -1,0 +1,59 @@
+"""Rotary position embeddings, HF rotate-half convention, Llama-3.1 scaling.
+
+HF convention (first-half/second-half pairing) is used so HF safetensors
+weights load without permutation. Frequencies are computed in float32 and
+the rotation applied in float32 before casting back — bf16 phase error
+compounds at long context.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from dynamo_tpu.models.config import ModelConfig
+
+
+def rope_inv_freq(cfg: ModelConfig) -> np.ndarray:
+    """Per-pair inverse frequencies [head_dim//2], with optional llama3
+    NTK-by-parts scaling (matches HF `Llama3RotaryEmbedding`)."""
+    half = cfg.head_dim // 2
+    inv = 1.0 / (cfg.rope_theta ** (np.arange(0, half, dtype=np.float64) / half))
+    sc = cfg.rope_scaling
+    if sc and sc.get("rope_type") in ("llama3",):
+        factor = sc["factor"]
+        low = sc["low_freq_factor"]
+        high = sc["high_freq_factor"]
+        orig = sc["original_max_position_embeddings"]
+        wavelen = 2 * np.pi / inv
+        # three bands: long wavelengths (> orig/low) fully scaled by 1/factor,
+        # short (< orig/high) untouched, smooth ramp between — the clip on
+        # `smooth` collapses the interpolation to 1/factor in the long band.
+        smooth = (orig / wavelen - low) / (high - low)
+        smooth = np.clip(smooth, 0.0, 1.0)
+        inv = np.where(
+            wavelen > orig / high,
+            (1 - smooth) * inv / factor + smooth * inv,
+            inv,
+        )
+    return inv.astype(np.float32)
+
+
+def rope_cos_sin(inv_freq: jnp.ndarray, positions: jnp.ndarray):
+    """cos/sin tables for integer positions [...]: returns [..., head_dim]
+    (frequencies tiled twice, HF layout)."""
+    angles = positions[..., None].astype(jnp.float32) * inv_freq  # [..., half]
+    angles = jnp.concatenate([angles, angles], axis=-1)
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray) -> jnp.ndarray:
+    """Rotate `x` [..., H, head_dim] by per-position cos/sin [..., head_dim]
+    (broadcast over the head axis)."""
+    orig_dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    half = x.shape[-1] // 2
+    x1, x2 = xf[..., :half], xf[..., half:]
+    rotated = jnp.concatenate([-x2, x1], axis=-1)
+    out = xf * cos[..., None, :] + rotated * sin[..., None, :]
+    return out.astype(orig_dtype)

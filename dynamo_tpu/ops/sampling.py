@@ -1,0 +1,55 @@
+"""In-jit batched token sampling: greedy / temperature / top-k / top-p.
+
+The reference forwards `SamplingOptions` (reference:
+lib/llm/src/protocols/common.rs:248) into vLLM; here sampling runs on-device
+inside the jitted decode step so no logits ever cross to the host. Per-slot
+parameters are arrays, so one compiled sampler serves a mixed batch.
+
+Top-k/top-p operate on a fixed `CANDIDATES`-wide shortlist (lax.top_k) —
+per-request k is a clamp within it, p a cumulative cutoff over it. This is
+exact for k <= CANDIDATES and a negligible-mass approximation for top-p
+(identical to common GPU serving practice, TPU-friendly static shape).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+CANDIDATES = 64  # shortlist width for top-k/top-p
+
+
+def sample_tokens(
+    logits: jnp.ndarray,       # [B, V] float
+    key: jax.Array,            # PRNG key
+    temperature: jnp.ndarray,  # [B] f32 (<= 0 treated as greedy)
+    top_k: jnp.ndarray,        # [B] i32 (<= 0 means disabled)
+    top_p: jnp.ndarray,        # [B] f32 (>= 1 means disabled)
+) -> jnp.ndarray:
+    """Returns sampled token ids [B] int32."""
+    b, v = logits.shape
+    logits = logits.astype(jnp.float32)
+    greedy_ids = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    is_greedy = temperature <= 0.0
+    temp = jnp.where(is_greedy, 1.0, temperature)
+    scaled = logits / temp[:, None]
+
+    cand_logits, cand_ids = jax.lax.top_k(scaled, min(CANDIDATES, v))  # sorted desc
+    n = cand_logits.shape[-1]
+    ranks = jnp.arange(n)
+
+    k = jnp.where(top_k <= 0, n, jnp.minimum(top_k, n))
+    keep_k = ranks[None, :] < k[:, None]
+
+    probs = jax.nn.softmax(cand_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # keep tokens whose *preceding* cumulative mass is below p (always >= 1 token)
+    keep_p = (cum - probs) < top_p[:, None]
+
+    keep = keep_k & keep_p
+    masked = jnp.where(keep, cand_logits, -1e30)
+    choice = jax.random.categorical(key, masked, axis=-1)  # [B] index into shortlist
+    sampled_ids = jnp.take_along_axis(cand_ids, choice[:, None], axis=-1)[:, 0]
+
+    return jnp.where(is_greedy, greedy_ids, sampled_ids).astype(jnp.int32)

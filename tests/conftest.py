@@ -12,13 +12,20 @@ Async tests: plain `async def test_*` functions are run in a fresh event loop
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# The ambient environment may point JAX at a tunneled TPU ('axon') and a
+# sitecustomize hook imports jax at interpreter startup — env vars set here
+# are too late, so force the platform through jax.config as well.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ.setdefault("DYN_LOG", "warn")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import asyncio  # noqa: E402
 import inspect  # noqa: E402

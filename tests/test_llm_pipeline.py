@@ -181,3 +181,63 @@ async def test_completion_pipeline_with_token_prompt():
     req = CompletionRequest.from_body({"model": "tiny", "prompt": [5, 6, 7]})
     built, _ = pre.preprocess_completion(req)
     assert built.token_ids == [5, 6, 7]
+
+
+async def test_backend_flushes_jail_on_engine_finish():
+    """If the engine finishes on its own while text is jailed as a partial
+    stop-string match, the held text must be released, not dropped."""
+    tok = HuggingFaceTokenizer.from_file(tiny_model_dir())
+    ids = tok.encode("hello world ST")  # "ST" is a partial match of "STOP"
+
+    class FinishingEngine:
+        async def generate(self, request):
+            async def _gen():
+                for tid in ids:
+                    yield {"token_ids": [tid]}
+                yield {"token_ids": [], "finish_reason": "length"}
+
+            return _gen()
+
+    backend = Backend(tok)
+    from dynamo_tpu.llm.protocols.common import PreprocessedRequest, StopConditions
+
+    pre = PreprocessedRequest(
+        token_ids=[1], stop_conditions=StopConditions(stop=["STOP"])
+    )
+    out = [
+        o
+        async for o in await backend.generate(Context(pre.to_dict()), FinishingEngine())
+    ]
+    text = "".join(o.get("text") or "" for o in out)
+    assert text == "hello world ST"  # trailing partial match released
+    assert out[-1]["finish_reason"] == "length"
+
+
+async def test_backend_truncates_tokens_at_mid_chunk_stop():
+    """A stop that triggers mid-chunk must not leak the unconsumed tail of
+    the chunk's token_ids into usage accounting."""
+    tok = HuggingFaceTokenizer.from_file(tiny_model_dir())
+    ids = tok.encode("one STOP two three four five six seven")
+
+    class BatchyEngine:
+        async def generate(self, request):
+            async def _gen():
+                yield {"token_ids": ids}  # everything in one frame
+                yield {"token_ids": [], "finish_reason": "length"}
+
+            return _gen()
+
+    backend = Backend(tok)
+    from dynamo_tpu.llm.protocols.common import PreprocessedRequest, StopConditions
+
+    pre = PreprocessedRequest(
+        token_ids=[1], stop_conditions=StopConditions(stop=["STOP"])
+    )
+    out = [
+        o
+        async for o in await backend.generate(Context(pre.to_dict()), BatchyEngine())
+    ]
+    emitted = sum(len(o.get("token_ids") or []) for o in out)
+    assert emitted < len(ids)  # tail after the stop point not counted
+    text = "".join(o.get("text") or "" for o in out)
+    assert text == "one "

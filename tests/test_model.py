@@ -1,0 +1,218 @@
+"""Model-layer correctness tests.
+
+Oracle strategy (SURVEY.md §4 "adopt"): no accelerators, strong references —
+(1) HF transformers LlamaForCausalLM on torch-CPU with identical weights is
+the numeric oracle for the full forward; (2) paged invariants: a
+prefill-then-decode split and a chunked prefill must reproduce the
+all-at-once logits bit-for-bit-ish (fp32 tolerance).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dynamo_tpu.models import config as cfgmod
+from dynamo_tpu.models import llama
+from dynamo_tpu.ops.attention import slots_from_pages
+
+CFG = cfgmod.get_config("tiny").with_(dtype="float32")
+PAGE = 8
+
+
+def _params(seed=0, dtype=jnp.float32):
+    return llama.init_params(CFG, jax.random.PRNGKey(seed), dtype=dtype)
+
+
+def _kv(num_slots=256, dtype=jnp.float32):
+    return llama.init_kv_cache(CFG, num_slots, dtype=dtype)
+
+
+def _run(params, kv, tokens, positions, write_slots, slot_matrix):
+    hidden, kv = llama.forward(
+        params, CFG,
+        jnp.asarray(tokens, jnp.int32),
+        jnp.asarray(positions, jnp.int32),
+        kv,
+        jnp.asarray(write_slots, jnp.int32),
+        jnp.asarray(slot_matrix, jnp.int32),
+    )
+    return llama.logits(params, CFG, hidden), kv
+
+
+def _contig_slots(start_page, n, cached=0):
+    """Slots for positions [cached, cached+n) in pages start_page..."""
+    pos = np.arange(cached, cached + n)
+    return (start_page + pos // PAGE) * PAGE + pos % PAGE
+
+
+def test_prefill_decode_matches_full_prefill():
+    """Splitting a sequence into prefill + N decode steps must give the same
+    per-position logits as one full prefill (paged-cache correctness)."""
+    params = _params()
+    toks = np.array([[5, 17, 42, 9, 88, 3, 21, 60, 14, 7]])
+    t = toks.shape[1]
+
+    # full prefill, pages 1..2
+    kv = _kv()
+    slots = _contig_slots(1, t)[None]
+    full_logits, _ = _run(
+        params, kv, toks, np.arange(t)[None], slots.ravel(), slots
+    )
+
+    # prefill first 6, then decode one at a time
+    kv = _kv()
+    pre = 6
+    slots_pre = _contig_slots(1, pre)[None]
+    logits_pre, kv = _run(
+        params, kv, toks[:, :pre], np.arange(pre)[None], slots_pre.ravel(), slots_pre
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits_pre), np.asarray(full_logits[:, :pre]), rtol=2e-4, atol=2e-4
+    )
+
+    for i in range(pre, t):
+        wslot = _contig_slots(1, 1, cached=i)[None]
+        smat = _contig_slots(1, i + 1)[None]
+        step_logits, kv = _run(
+            params, kv, toks[:, i : i + 1], np.array([[i]]), wslot.ravel(), smat
+        )
+        np.testing.assert_allclose(
+            np.asarray(step_logits[:, 0]),
+            np.asarray(full_logits[:, i]),
+            rtol=2e-4,
+            atol=2e-4,
+        )
+
+
+def test_chunked_prefill_matches_full():
+    """Prefilling in two chunks (prefix-cache hit path) == one shot."""
+    params = _params()
+    toks = np.random.RandomState(0).randint(1, 200, size=(1, 12))
+
+    kv = _kv()
+    slots = _contig_slots(2, 12)[None]
+    full_logits, _ = _run(params, kv, toks, np.arange(12)[None], slots.ravel(), slots)
+
+    kv = _kv()
+    s1 = _contig_slots(2, 8)[None]
+    _, kv = _run(params, kv, toks[:, :8], np.arange(8)[None], s1.ravel(), s1)
+    s2 = _contig_slots(2, 4, cached=8)[None]
+    smat = _contig_slots(2, 12)[None]
+    logits2, kv = _run(
+        params, kv, toks[:, 8:], np.arange(8, 12)[None], s2.ravel(), smat
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits2), np.asarray(full_logits[:, 8:]), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_batched_decode_isolation():
+    """Two sequences decoding in one batch see only their own pages."""
+    params = _params()
+    ta = np.array([3, 1, 4, 1, 5, 9, 2, 6])
+    tb = np.array([2, 7, 1, 8, 2, 8])
+
+    def solo(tokens, start_page):
+        kv = _kv()
+        t = len(tokens)
+        slots = _contig_slots(start_page, t)[None]
+        logits, _ = _run(
+            params, kv, tokens[None], np.arange(t)[None], slots.ravel(), slots
+        )
+        return np.asarray(logits[0, -1])
+
+    ref_a, ref_b = solo(ta, 1), solo(tb, 1)
+
+    # batch: prefill both into disjoint pages, then decode last token together
+    kv = _kv()
+    sa = _contig_slots(1, len(ta) - 1)[None]
+    _, kv = _run(params, kv, ta[None, :-1], np.arange(len(ta) - 1)[None], sa.ravel(), sa)
+    sb = _contig_slots(4, len(tb) - 1)[None]
+    _, kv = _run(params, kv, tb[None, :-1], np.arange(len(tb) - 1)[None], sb.ravel(), sb)
+
+    wa = _contig_slots(1, 1, cached=len(ta) - 1)
+    wb = _contig_slots(4, 1, cached=len(tb) - 1)
+    cmax = 2 * PAGE
+    smat = np.zeros((2, cmax), np.int32)
+    smat[0, : len(ta)] = _contig_slots(1, len(ta))
+    smat[1, : len(tb)] = _contig_slots(4, len(tb))
+    tokens = np.array([[ta[-1]], [tb[-1]]])
+    positions = np.array([[len(ta) - 1], [len(tb) - 1]])
+    logits, _ = _run(
+        params, kv, tokens, positions, np.concatenate([wa, wb]), smat
+    )
+    np.testing.assert_allclose(np.asarray(logits[0, 0]), ref_a, rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(logits[1, 0]), ref_b, rtol=2e-4, atol=2e-4)
+
+
+def test_matches_hf_transformers():
+    """Full-forward numeric oracle: HF LlamaForCausalLM with our weights."""
+    torch = pytest.importorskip("torch")
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    hf_cfg = LlamaConfig(
+        vocab_size=CFG.vocab_size,
+        hidden_size=CFG.hidden_size,
+        intermediate_size=CFG.intermediate_size,
+        num_hidden_layers=CFG.num_layers,
+        num_attention_heads=CFG.num_heads,
+        num_key_value_heads=CFG.num_kv_heads,
+        head_dim=CFG.head_dim,
+        rope_theta=CFG.rope_theta,
+        rms_norm_eps=CFG.rms_norm_eps,
+        max_position_embeddings=CFG.max_position_embeddings,
+        tie_word_embeddings=True,
+        attention_bias=False,
+    )
+    with torch.no_grad():
+        model = LlamaForCausalLM(hf_cfg).eval()
+        params = _params()
+        sd = model.state_dict()
+
+        def put(name, ours, transpose):
+            arr = np.asarray(ours, np.float32)
+            sd[name].copy_(torch.from_numpy(arr.T if transpose else arr))
+
+        put("model.embed_tokens.weight", params["embed"], False)
+        put("model.norm.weight", params["final_norm"], False)
+        for i, lp in enumerate(params["layers"]):
+            pre = f"model.layers.{i}."
+            put(pre + "input_layernorm.weight", lp["attn_norm"], False)
+            put(pre + "self_attn.q_proj.weight", lp["wq"], True)
+            put(pre + "self_attn.k_proj.weight", lp["wk"], True)
+            put(pre + "self_attn.v_proj.weight", lp["wv"], True)
+            put(pre + "self_attn.o_proj.weight", lp["wo"], True)
+            put(pre + "post_attention_layernorm.weight", lp["mlp_norm"], False)
+            put(pre + "mlp.gate_proj.weight", lp["w_gate"], True)
+            put(pre + "mlp.up_proj.weight", lp["w_up"], True)
+            put(pre + "mlp.down_proj.weight", lp["w_down"], True)
+
+        toks = np.random.RandomState(1).randint(1, 250, size=(1, 16))
+        hf_logits = model(torch.from_numpy(toks)).logits.numpy()
+
+    kv = _kv()
+    slots = _contig_slots(1, 16)[None]
+    ours, _ = _run(params, kv, toks, np.arange(16)[None], slots.ravel(), slots)
+    np.testing.assert_allclose(np.asarray(ours), hf_logits, rtol=2e-3, atol=2e-3)
+
+
+def test_llama31_rope_scaling_matches_hf():
+    """NTK-by-parts bands (llama3 rope_scaling) vs HF's reference init."""
+    torch = pytest.importorskip("torch")
+    from transformers import LlamaConfig
+    from transformers.modeling_rope_utils import ROPE_INIT_FUNCTIONS
+
+    from dynamo_tpu.ops.rope import rope_inv_freq
+
+    cfg = cfgmod.get_config("llama-3.1-8b")
+    hf_cfg = LlamaConfig(
+        hidden_size=cfg.hidden_size,
+        num_attention_heads=cfg.num_heads,
+        head_dim=cfg.head_dim,
+        rope_theta=cfg.rope_theta,
+        rope_scaling=dict(cfg.rope_scaling),
+        max_position_embeddings=cfg.max_position_embeddings,
+    )
+    inv, _ = ROPE_INIT_FUNCTIONS["llama3"](hf_cfg, "cpu")
+    np.testing.assert_allclose(rope_inv_freq(cfg), inv.numpy(), rtol=1e-6)
