@@ -1,0 +1,121 @@
+"""Serving benchmark on the local TPU chip — prints ONE JSON line.
+
+Protocol (scaled-down from the reference's genai-perf sweep, BASELINE.md:
+ISL 3000 / OSL 150, concurrency sweep): N concurrent requests with a fixed
+ISL/OSL through the full engine (continuous batching, paged KV, on-device
+sampling); measures steady-state decode throughput per chip plus p50
+TTFT/ITL.
+
+Baseline for `vs_baseline`: the north star is tokens/sec/chip parity with
+vLLM on H100 for Llama-3.1-8B (BASELINE.json). We take 2000 tok/s/GPU as
+the parity bar for 8B-class decode throughput and scale it by relative
+parameter count when a smaller preset is benched (smaller chips can't hold
+8B in bf16), so the ratio stays comparable across rounds and chip types.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import time
+
+import numpy as np
+
+PARITY_8B_TOKS_PER_CHIP = 2000.0
+_8B_PARAMS = 8.03e9
+
+ISL = int(os.environ.get("BENCH_ISL", "512"))
+OSL = int(os.environ.get("BENCH_OSL", "64"))
+CONCURRENCY = int(os.environ.get("BENCH_CONCURRENCY", "8"))
+
+
+def main() -> None:
+    import jax
+
+    from dynamo_tpu.engine import EngineConfig, JaxEngine
+    from dynamo_tpu.llm.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+    from dynamo_tpu.models import llama
+    from dynamo_tpu.runtime.pipeline.context import Context
+
+    import __graft_entry__
+
+    cfg = __graft_entry__._pick_config()
+    n_chips = len(jax.local_devices())
+
+    engine = JaxEngine(
+        EngineConfig(
+            model=cfg,
+            dtype="bfloat16",
+            page_size=16,
+            max_batch_size=CONCURRENCY,
+            max_model_len=ISL + OSL + 32,
+            prefill_chunk=ISL,
+        )
+    )
+    n_params = llama.param_count(engine.params)
+
+    rng = np.random.RandomState(0)
+    prompts = [
+        rng.randint(1, cfg.vocab_size, size=ISL).tolist() for _ in range(CONCURRENCY)
+    ]
+
+    async def one(prompt, record):
+        pre = PreprocessedRequest(
+            token_ids=prompt,
+            stop_conditions=StopConditions(max_tokens=OSL, ignore_eos=True),
+            sampling_options=SamplingOptions(greedy=True),
+        )
+        t0 = time.perf_counter()
+        ticks = []
+        async for frame in await engine.generate(Context(pre.to_dict())):
+            if frame.get("token_ids"):
+                ticks.append(time.perf_counter())
+        record["ttft"] = ticks[0] - t0
+        record["itl"] = np.diff(ticks).tolist() if len(ticks) > 1 else []
+        record["tokens"] = len(ticks)
+
+    async def run():
+        # warmup: compile prefill + decode shapes
+        warm = {}
+        await one(prompts[0][:ISL], warm)
+        t0 = time.perf_counter()
+        records = [dict() for _ in prompts]
+        await asyncio.gather(*(one(p, r) for p, r in zip(prompts, records)))
+        wall = time.perf_counter() - t0
+        return records, wall
+
+    records, wall = asyncio.run(run())
+    total_tokens = sum(r["tokens"] for r in records)
+    toks_per_sec_chip = total_tokens / wall / n_chips
+    ttft_p50 = float(np.percentile([r["ttft"] for r in records], 50))
+    itls = [x for r in records for x in r["itl"]]
+    itl_p50 = float(np.percentile(itls, 50)) if itls else 0.0
+
+    target = PARITY_8B_TOKS_PER_CHIP * (_8B_PARAMS / n_params)
+    print(
+        json.dumps(
+            {
+                "metric": f"{cfg.name} serving decode throughput "
+                f"(ISL={ISL} OSL={OSL} conc={CONCURRENCY})",
+                "value": round(toks_per_sec_chip, 2),
+                "unit": "tokens/sec/chip",
+                "vs_baseline": round(toks_per_sec_chip / target, 4),
+                "extra": {
+                    "p50_ttft_s": round(ttft_p50, 4),
+                    "p50_itl_s": round(itl_p50 * 1000, 3) / 1000,
+                    "chips": n_chips,
+                    "params": n_params,
+                    "parity_target_toks_per_chip": round(target, 1),
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

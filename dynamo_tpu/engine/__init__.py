@@ -1,0 +1,14 @@
+"""The native TPU inference engine: continuous batching over a paged KV
+cache on a JAX mesh.
+
+This subsystem replaces what the reference gets from vLLM/sglang plus its
+vLLM fork patch (reference: lib/engines/*, SURVEY.md §2.6): the scheduler,
+paged-KV block allocator with prefix caching and KV events, and the
+prefill/decode execution loop — designed XLA-first (static bucketed shapes,
+donated cache buffers, sampling on device).
+"""
+
+from dynamo_tpu.engine.config import EngineConfig
+from dynamo_tpu.engine.engine import JaxEngine
+
+__all__ = ["EngineConfig", "JaxEngine"]

@@ -1,0 +1,187 @@
+"""Paged-KV allocator with prefix caching and KV event emission.
+
+TPU-native equivalent of the reference's block machinery, which lives in
+its vLLM fork patch (prefix-caching block allocator + KVCacheEventManager,
+reference: container/deps/vllm/vllm_v0.7.2-dynamo-kv-disagg-patch.patch:426-935)
+and the CUDA-side reuse pool (reference: lib/llm/src/kv/reuse.rs:50-638).
+Single-threaded by design — the engine loop is the only caller, mirroring
+the reference's progress-engine pattern instead of locks (SURVEY.md §5
+race-detection note).
+
+Pages are identified by the chained **sequence hash** of the tokens they
+hold (dynamo_tpu/llm/tokens.py). A page is:
+
+- **free**: on the free list, contents dead;
+- **active**: referenced by >=1 sequences (refs > 0);
+- **cached**: refs == 0 but contents indexed by sequence hash — reusable by
+  `match_prefix`, evictable in LRU order when the free list runs dry.
+
+Every register/evict emits a KV event (stored/removed) through `on_event` —
+the feed for the KV-aware router (reference: kv_router/protocols.rs:58-121).
+Page 0 is the trash page: never allocated, padded writes land there.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict, deque
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+
+@dataclass
+class PageMeta:
+    refs: int = 0
+    sequence_hash: Optional[int] = None  # set once contents are a full hashed block
+    local_hash: Optional[int] = None
+    parent_hash: Optional[int] = None
+
+
+def stored_event(blocks: list[tuple[int, int, int]], parent_hash: Optional[int]) -> dict:
+    """blocks: [(sequence_hash, local_hash, page_id)]."""
+    return {
+        "type": "stored",
+        "parent_hash": parent_hash,
+        "blocks": [
+            {"block_hash": sh, "tokens_hash": lh, "page_id": pid}
+            for sh, lh, pid in blocks
+        ],
+    }
+
+
+def removed_event(hashes: list[int]) -> dict:
+    return {"type": "removed", "block_hashes": hashes}
+
+
+class PageAllocator:
+    def __init__(
+        self,
+        num_pages: int,
+        page_size: int,
+        on_event: Optional[Callable[[dict], None]] = None,
+    ):
+        if num_pages < 2:
+            raise ValueError("need at least 2 pages (page 0 is reserved)")
+        self.page_size = page_size
+        self.num_pages = num_pages
+        self.on_event = on_event
+        self._free: deque[int] = deque(range(1, num_pages))
+        self._meta: dict[int, PageMeta] = {}
+        self._by_hash: dict[int, int] = {}  # sequence_hash -> page_id
+        self._lru: OrderedDict[int, int] = OrderedDict()  # seq_hash -> page_id, refs==0
+        # counters for metrics / hit-rate
+        self.lookups = 0
+        self.hits = 0
+
+    # ---- queries ------------------------------------------------------
+
+    @property
+    def num_free(self) -> int:
+        """Pages obtainable right now (free list + evictable cached)."""
+        return len(self._free) + len(self._lru)
+
+    @property
+    def num_active(self) -> int:
+        return len(self._meta)
+
+    def usage(self) -> float:
+        usable = self.num_pages - 1
+        return (usable - len(self._free) - len(self._lru)) / usable if usable else 0.0
+
+    def hit_rate(self) -> float:
+        return self.hits / self.lookups if self.lookups else 0.0
+
+    # ---- prefix cache -------------------------------------------------
+
+    def match_prefix(self, sequence_hashes: list[int]) -> list[int]:
+        """Longest cached prefix: returns page ids (ref'd) for the leading
+        run of hashes present in the cache."""
+        pages: list[int] = []
+        for h in sequence_hashes:
+            self.lookups += 1
+            pid = self._by_hash.get(h)
+            if pid is None:
+                break
+            self.hits += 1
+            meta = self._meta[pid]
+            if meta.refs == 0:
+                self._lru.pop(h, None)
+            meta.refs += 1
+            pages.append(pid)
+        return pages
+
+    # ---- allocation ---------------------------------------------------
+
+    def allocate(self, n: int) -> Optional[list[int]]:
+        """n fresh pages (refs=1 each), evicting LRU cached pages if needed.
+        Returns None (no side effects) if impossible."""
+        if n > self.num_free:
+            return None
+        evicted: list[int] = []
+        while len(self._free) < n:
+            h, pid = self._lru.popitem(last=False)
+            meta = self._meta.pop(pid)
+            del self._by_hash[h]
+            evicted.append(meta.sequence_hash)
+            self._free.append(pid)
+        if evicted and self.on_event:
+            self.on_event(removed_event(evicted))
+        pages = [self._free.popleft() for _ in range(n)]
+        for pid in pages:
+            self._meta[pid] = PageMeta(refs=1)
+        return pages
+
+    def register(
+        self,
+        page_ids: list[int],
+        blocks: list[tuple[int, int]],  # (sequence_hash, local_hash) per page
+        parent_hash: Optional[int],
+    ) -> None:
+        """Mark pages as holding completed, hashed blocks (emits `stored`).
+        If a hash is already cached for another page (two sequences computed
+        the same block), the new page keeps working storage but the index
+        keeps the first page."""
+        stored: list[tuple[int, int, int]] = []
+        event_parent: Optional[int] = None
+        for pid, (sh, lh) in zip(page_ids, blocks):
+            meta = self._meta[pid]
+            if meta.sequence_hash is not None:
+                parent_hash = meta.sequence_hash
+                continue  # already registered (shared prefix page)
+            meta.sequence_hash, meta.local_hash, meta.parent_hash = sh, lh, parent_hash
+            if sh not in self._by_hash:
+                self._by_hash[sh] = pid
+                if not stored:
+                    event_parent = parent_hash
+                stored.append((sh, lh, pid))
+            parent_hash = sh
+        if stored and self.on_event:
+            self.on_event(stored_event(stored, parent_hash=event_parent))
+
+    def release(self, page_ids: list[int]) -> None:
+        """Drop one reference per page. Hashed pages at refs==0 stay cached
+        (LRU-evictable); unhashed pages free immediately."""
+        for pid in page_ids:
+            meta = self._meta.get(pid)
+            if meta is None:
+                continue
+            meta.refs -= 1
+            if meta.refs > 0:
+                continue
+            if meta.sequence_hash is not None and self._by_hash.get(meta.sequence_hash) == pid:
+                self._lru[meta.sequence_hash] = pid
+            else:
+                del self._meta[pid]
+                self._free.append(pid)
+
+    def clear_cache(self) -> None:
+        """Drop all refs==0 cached pages (emits removed)."""
+        if not self._lru:
+            return
+        hashes = list(self._lru.keys())
+        for h, pid in self._lru.items():
+            del self._by_hash[h]
+            del self._meta[pid]
+            self._free.append(pid)
+        self._lru.clear()
+        if self.on_event:
+            self.on_event(removed_event(hashes))

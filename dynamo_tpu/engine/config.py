@@ -1,0 +1,45 @@
+"""Engine configuration."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Union
+
+from dynamo_tpu.models.config import ModelConfig, get_config
+from dynamo_tpu.parallel.mesh import MeshConfig
+
+
+@dataclass
+class EngineConfig:
+    model: Union[str, ModelConfig] = "tiny"
+    checkpoint_dir: Optional[str] = None  # HF safetensors dir; None = random init
+    mesh: MeshConfig = field(default_factory=MeshConfig)
+    dtype: str = "bfloat16"
+
+    page_size: int = 16           # tokens per KV page (block_size in KV events)
+    num_pages: Optional[int] = None  # total pages incl. trash page 0; None = auto from HBM
+    hbm_utilization: float = 0.85    # fraction of free HBM given to KV when auto-sizing
+
+    max_batch_size: int = 8       # decode slots
+    max_model_len: int = 2048     # context limit per sequence
+    prefill_chunk: int = 512      # longest single prefill call (longer prompts chunk)
+    seed: int = 0
+
+    def model_config(self) -> ModelConfig:
+        cfg = get_config(self.model) if isinstance(self.model, str) else self.model
+        return cfg if cfg.dtype == self.dtype else cfg.with_(dtype=self.dtype)
+
+    @property
+    def max_pages_per_seq(self) -> int:
+        return -(-self.max_model_len // self.page_size)
+
+    def prefill_buckets(self) -> list[int]:
+        """Power-of-two token buckets for prefill calls, ending at
+        prefill_chunk — each bucket is one compiled graph."""
+        buckets = []
+        b = max(self.page_size, 16)
+        while b < self.prefill_chunk:
+            buckets.append(b)
+            b *= 2
+        buckets.append(self.prefill_chunk)
+        return buckets

@@ -1,0 +1,478 @@
+"""JaxEngine: the continuous-batching execution loop.
+
+Replaces the reference's engine adapters + vLLM core (reference:
+lib/engines/vllm0_8/src/lib.rs, SURVEY.md §2.3) with a native loop designed
+for XLA's compile-once regime:
+
+- **two compiled step families**: bucketed prefill `[1, T_bucket]` and a
+  fixed-shape decode `[max_batch, 1]` — no dynamic shapes, ever;
+- the KV cache is **donated** through every step, so scatters update HBM
+  in place;
+- sampling runs on device inside the same jit (no logits on the host);
+- the host loop is single-threaded asyncio (the reference's
+  progress-engine-with-mailboxes pattern, SURVEY.md §5) and owns the
+  allocator, slots and queues.
+
+Uniform step invariant: a sequence always has KV computed for exactly
+`total_tokens - 1` positions when decoding (the newest sampled token is fed
+back and its KV written by the next step). Prefill — fresh or resumed after
+preemption — computes KV for every current token and samples the next, so
+admission and preemption-resume are the same code path.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from collections import deque
+from typing import AsyncIterator, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dynamo_tpu.engine.allocator import PageAllocator
+from dynamo_tpu.engine.config import EngineConfig
+from dynamo_tpu.engine.scheduler import Sequence
+from dynamo_tpu.llm.protocols.common import (
+    FINISH_REASON_CANCELLED,
+    FINISH_REASON_LENGTH,
+    EngineOutput,
+    PreprocessedRequest,
+)
+from dynamo_tpu.models import llama
+from dynamo_tpu.ops.sampling import sample_tokens
+from dynamo_tpu.parallel import mesh as meshmod
+from dynamo_tpu.runtime.pipeline.context import Context
+
+log = logging.getLogger("dynamo_tpu.engine")
+
+
+class JaxEngine:
+    """Paged continuous-batching engine over a jax Mesh.
+
+    Conforms to the pipeline engine protocol: `await generate(Context) ->
+    AsyncIterator[dict]` streaming EngineOutput dicts (token ids; the
+    detokenizing Backend sits downstream).
+    """
+
+    def __init__(self, config: EngineConfig, params=None, devices=None):
+        self.config = config
+        self.model_cfg = config.model_config()
+        self._dtype = jnp.bfloat16 if config.dtype == "bfloat16" else jnp.float32
+
+        self.mesh = meshmod.build_mesh(config.mesh, devices)
+        self._kv_sharding = meshmod.kv_cache_sharding(self.mesh)
+
+        if params is None:
+            if config.checkpoint_dir:
+                from dynamo_tpu.models.weights import load_params
+
+                params = load_params(
+                    config.checkpoint_dir, self.model_cfg, dtype=self._dtype
+                )
+                params = meshmod.shard_params(params, self.model_cfg, self.mesh)
+            else:
+                params = llama.init_params(
+                    self.model_cfg, jax.random.PRNGKey(config.seed), dtype=self._dtype
+                )
+                params = meshmod.shard_params(params, self.model_cfg, self.mesh)
+        self.params = params
+
+        self.num_pages = config.num_pages or self._auto_num_pages()
+        self.page_size = config.page_size
+        num_slots = self.num_pages * self.page_size
+        kv = llama.init_kv_cache(self.model_cfg, num_slots, dtype=self._dtype)
+        self.kv = llama.KVCache(
+            k=jax.device_put(kv.k, self._kv_sharding),
+            v=jax.device_put(kv.v, self._kv_sharding),
+        )
+
+        self._event_seq = 0
+        self._event_subscribers: list[Callable[[dict], None]] = []
+        self.allocator = PageAllocator(
+            self.num_pages, self.page_size, on_event=self._emit_event
+        )
+
+        self.waiting: deque[Sequence] = deque()
+        self.slots: list[Optional[Sequence]] = [None] * config.max_batch_size
+        self._wake = asyncio.Event()
+        self._loop_task: Optional[asyncio.Task] = None
+        self._closed = False
+        self._key = jax.random.PRNGKey(config.seed ^ 0x5EED)
+        self._step_count = 0
+
+        # slot-matrix width: whole context in token slots
+        self._smat_width = config.max_pages_per_seq * config.page_size
+
+        # one jitted step; jax retraces per (B, T, C) shape family
+        self._step_fn = jax.jit(self._model_step, donate_argnums=(1,))
+
+    # ------------------------------------------------------------------
+    # sizing
+
+    def _auto_num_pages(self) -> int:
+        cfg, m = self.config, self.model_cfg
+        tp = self.config.mesh.tp
+        page_bytes = (
+            m.num_layers * cfg.page_size * m.num_kv_heads * m.head_dim
+            * 2 * self._dtype.dtype.itemsize
+        ) // tp  # per-device bytes for one page's K+V
+        fallback = cfg.max_batch_size * cfg.max_pages_per_seq + 17
+        try:
+            stats = jax.local_devices()[0].memory_stats()
+            free = stats["bytes_limit"] * cfg.hbm_utilization - stats["bytes_in_use"]
+        except Exception:
+            return fallback
+        n = int(free // max(page_bytes, 1))
+        return max(n, 2) if n > 0 else fallback
+
+    # ------------------------------------------------------------------
+    # events / metrics
+
+    def subscribe_events(self, cb: Callable[[dict], None]) -> None:
+        """KV cache events (stored/removed) feed the KV-aware router
+        (reference: lib/llm/src/kv_router/publisher.rs)."""
+        self._event_subscribers.append(cb)
+
+    def _emit_event(self, event: dict) -> None:
+        event = {**event, "event_id": self._event_seq, "block_size": self.page_size}
+        self._event_seq += 1
+        for cb in self._event_subscribers:
+            try:
+                cb(event)
+            except Exception:
+                log.exception("kv event subscriber failed")
+
+    def metrics(self) -> dict:
+        """ForwardPassMetrics equivalent (reference:
+        lib/llm/src/kv_router/protocols.rs:43-54)."""
+        active = sum(1 for s in self.slots if s is not None)
+        usable = self.num_pages - 1
+        return {
+            "request_active_slots": active,
+            "request_total_slots": len(self.slots),
+            "kv_active_blocks": int(round(self.allocator.usage() * usable)),
+            "kv_total_blocks": usable,
+            "num_requests_waiting": len(self.waiting),
+            "gpu_cache_usage_perc": self.allocator.usage(),
+            "gpu_prefix_cache_hit_rate": self.allocator.hit_rate(),
+        }
+
+    # ------------------------------------------------------------------
+    # compiled steps
+
+    def _model_step(self, params, kv, tokens, positions, write_slots, slot_matrix,
+                    last_idx, temp, topk, topp, key):
+        hidden, kv = llama.forward(
+            params, self.model_cfg, tokens, positions, kv, write_slots, slot_matrix
+        )
+        last_h = jnp.take_along_axis(
+            hidden, last_idx[:, None, None].astype(jnp.int32), axis=1
+        )[:, 0]  # [B, D]
+        lg = llama.logits(params, self.model_cfg, last_h)
+        toks = sample_tokens(lg, key, temp, topk, topp)
+        return toks, kv
+
+    # ------------------------------------------------------------------
+    # engine protocol
+
+    async def generate(self, request: Context) -> AsyncIterator[dict]:
+        payload = request.payload
+        pre = (
+            PreprocessedRequest.from_dict(payload)
+            if isinstance(payload, dict)
+            else payload
+        )
+        if len(pre.token_ids) >= self.config.max_model_len:
+            raise ValueError(
+                f"prompt of {len(pre.token_ids)} tokens exceeds "
+                f"max_model_len={self.config.max_model_len}"
+            )
+        if len(pre.token_ids) == 0:
+            raise ValueError("empty prompt")
+        seq = Sequence.from_request(
+            request, pre, self.page_size, self.config.max_model_len
+        )
+        self.waiting.append(seq)
+        self._ensure_loop()
+        self._wake.set()
+
+        async def _gen() -> AsyncIterator[dict]:
+            while True:
+                item = await seq.out_queue.get()
+                yield item
+                if item.get("finish_reason"):
+                    return
+
+        return _gen()
+
+    def _ensure_loop(self) -> None:
+        if self._loop_task is None or self._loop_task.done():
+            self._loop_task = asyncio.get_running_loop().create_task(self._loop())
+
+    async def close(self) -> None:
+        self._closed = True
+        self._wake.set()
+        if self._loop_task:
+            try:
+                await self._loop_task
+            except asyncio.CancelledError:
+                pass
+        for seq in list(self.waiting) + [s for s in self.slots if s]:
+            seq.out_queue.put_nowait(
+                EngineOutput.final(FINISH_REASON_CANCELLED).to_dict()
+            )
+
+    # ------------------------------------------------------------------
+    # main loop
+
+    async def _loop(self) -> None:
+        try:
+            while not self._closed:
+                progressed = False
+                progressed |= await self._admit()
+                if any(s is not None for s in self.slots):
+                    await self._decode_once()
+                    progressed = True
+                if not progressed:
+                    self._wake.clear()
+                    if self._closed:
+                        return
+                    await self._wake.wait()
+        except Exception:
+            log.exception("engine loop crashed; failing all requests")
+            for seq in list(self.waiting) + [s for s in self.slots if s]:
+                seq.out_queue.put_nowait(EngineOutput.final("error").to_dict())
+            self.waiting.clear()
+            self.slots = [None] * len(self.slots)
+            raise
+
+    # ---- admission ----------------------------------------------------
+
+    def _free_slot(self) -> Optional[int]:
+        for i, s in enumerate(self.slots):
+            if s is None:
+                return i
+        return None
+
+    async def _admit(self) -> bool:
+        progressed = False
+        while self.waiting:
+            slot = self._free_slot()
+            if slot is None:
+                break
+            seq = self.waiting[0]
+            if seq.ctx.is_stopped():
+                self.waiting.popleft()
+                seq.out_queue.put_nowait(
+                    EngineOutput.final(FINISH_REASON_CANCELLED).to_dict()
+                )
+                progressed = True
+                continue
+            if seq.max_new_tokens <= 0:
+                self.waiting.popleft()
+                seq.out_queue.put_nowait(
+                    EngineOutput.final(FINISH_REASON_LENGTH).to_dict()
+                )
+                progressed = True
+                continue
+            if not self._reserve_pages(seq):
+                break  # out of pages; wait for something to finish
+            self.waiting.popleft()
+            seq.slot = slot
+            self.slots[slot] = seq
+            await self._run_prefill(seq)
+            progressed = True
+        return progressed
+
+    def _reserve_pages(self, seq: Sequence) -> bool:
+        """Prefix-match then allocate pages covering all current tokens."""
+        t = seq.total_tokens
+        matched = self.allocator.match_prefix(seq.blocks.sequence_hashes())
+        if len(matched) * self.page_size >= t:
+            # fully cached: recompute the last page so there is >=1 query
+            self.allocator.release([matched[-1]])
+            matched = matched[:-1]
+        need = -(-t // self.page_size) - len(matched)
+        fresh = self.allocator.allocate(need) if need else []
+        if fresh is None:
+            self.allocator.release(matched)
+            return False
+        seq.page_ids = matched + fresh
+        seq.num_cached = len(matched) * self.page_size
+        seq.num_computed = seq.num_cached
+        seq.registered_pages = len(matched)
+        return True
+
+    # ---- prefill ------------------------------------------------------
+
+    def _bucket_for(self, n: int) -> int:
+        for b in self.config.prefill_buckets():
+            if n <= b:
+                return b
+        return self.config.prefill_chunk
+
+    def _slot_matrix_row(self, seq: Sequence) -> np.ndarray:
+        table = np.zeros(self.config.max_pages_per_seq, np.int32)
+        table[: len(seq.page_ids)] = seq.page_ids
+        return (
+            table[:, None] * self.page_size + np.arange(self.page_size, dtype=np.int32)
+        ).reshape(-1)
+
+    def _write_slot(self, seq: Sequence, pos: int) -> int:
+        return seq.page_ids[pos // self.page_size] * self.page_size + pos % self.page_size
+
+    async def _run_prefill(self, seq: Sequence) -> None:
+        """Compute KV for tokens [num_computed, T), sample the next token
+        from position T-1, emit it. Chunked for long prompts."""
+        tokens = seq.tokens
+        t = len(tokens)
+        smat = self._slot_matrix_row(seq)[None]
+        first_meta = {
+            "prefix_cached_tokens": seq.num_cached,
+            "prompt_tokens": seq.prompt_len,
+        }
+        sampled: Optional[int] = None
+        while seq.num_computed < t:
+            start = seq.num_computed
+            chunk = min(t - start, self.config.prefill_chunk)
+            bucket = self._bucket_for(chunk)
+            tok_arr = np.zeros((1, bucket), np.int32)
+            pos_arr = np.zeros((1, bucket), np.int32)
+            wslots = np.zeros(bucket, np.int32)
+            tok_arr[0, :chunk] = tokens[start : start + chunk]
+            pos_arr[0, :chunk] = np.arange(start, start + chunk)
+            for i in range(chunk):
+                wslots[i] = self._write_slot(seq, start + i)
+            self._key, sub = jax.random.split(self._key)
+            toks, self.kv = self._step_fn(
+                self.params, self.kv,
+                jnp.asarray(tok_arr), jnp.asarray(pos_arr), jnp.asarray(wslots),
+                jnp.asarray(smat), jnp.asarray([chunk - 1]),
+                jnp.asarray([seq.temperature], jnp.float32),
+                jnp.asarray([seq.top_k], jnp.int32),
+                jnp.asarray([seq.top_p], jnp.float32),
+                sub,
+            )
+            seq.num_computed += chunk
+            self._register_full_pages(seq)
+            sampled = toks
+            await asyncio.sleep(0)  # let other tasks breathe between chunks
+        out = await asyncio.to_thread(np.asarray, sampled)
+        self._append_token(seq, int(out[0]), extra_meta=first_meta)
+
+    # ---- decode -------------------------------------------------------
+
+    async def _decode_once(self) -> None:
+        b = len(self.slots)
+        # ensure every active sequence has a page for its next position
+        for seq in [s for s in self.slots if s is not None]:
+            if seq.slot < 0 or self.slots[seq.slot] is not seq:
+                continue  # preempted by an earlier victim pick this pass
+            if seq.ctx.is_stopped():
+                self._finish(seq, FINISH_REASON_CANCELLED)
+                continue
+            if not self._ensure_page(seq):
+                return  # seq itself was preempted; retry next loop
+        active = [(i, s) for i, s in enumerate(self.slots) if s is not None]
+        if not active:
+            return
+
+        tokens = np.zeros(b, np.int32)
+        positions = np.zeros(b, np.int32)
+        wslots = np.zeros(b, np.int32)
+        smat = np.zeros((b, self._smat_width), np.int32)
+        temp = np.zeros(b, np.float32)
+        topk = np.zeros(b, np.int32)
+        topp = np.ones(b, np.float32)
+        for i, seq in active:
+            p = seq.num_computed
+            tokens[i] = seq.last_token
+            positions[i] = p
+            wslots[i] = self._write_slot(seq, p)
+            smat[i] = self._slot_matrix_row(seq)
+            temp[i] = seq.temperature
+            topk[i] = seq.top_k
+            topp[i] = seq.top_p
+
+        self._key, sub = jax.random.split(self._key)
+        toks, self.kv = self._step_fn(
+            self.params, self.kv,
+            jnp.asarray(tokens[:, None]), jnp.asarray(positions[:, None]),
+            jnp.asarray(wslots), jnp.asarray(smat),
+            jnp.asarray(positions * 0),  # T=1: last_idx is always 0
+            jnp.asarray(temp), jnp.asarray(topk), jnp.asarray(topp),
+            sub,
+        )
+        self._step_count += 1
+        out = await asyncio.to_thread(np.asarray, toks)
+        for i, seq in active:
+            if self.slots[i] is not seq:
+                continue  # finished/preempted mid-step
+            seq.num_computed += 1
+            self._register_full_pages(seq)
+            self._append_token(seq, int(out[i]))
+
+    def _ensure_page(self, seq: Sequence) -> bool:
+        p = seq.num_computed
+        while p // self.page_size >= len(seq.page_ids):
+            got = self.allocator.allocate(1)
+            if got is not None:
+                seq.page_ids.extend(got)
+                return True
+            victim = max(
+                (s for s in self.slots if s is not None), key=lambda s: s.seq_id
+            )
+            self._preempt(victim)
+            if victim is seq:
+                return False
+        return True
+
+    def _preempt(self, seq: Sequence) -> None:
+        log.info("preempting seq %s (out of KV pages)", seq.seq_id)
+        self._register_full_pages(seq)
+        self.allocator.release(seq.page_ids)
+        self.slots[seq.slot] = None
+        seq.slot = -1
+        seq.page_ids = []
+        seq.num_cached = 0
+        seq.num_computed = 0
+        seq.registered_pages = 0
+        self.waiting.appendleft(seq)
+
+    # ---- bookkeeping --------------------------------------------------
+
+    def _register_full_pages(self, seq: Sequence) -> None:
+        full = seq.num_computed // self.page_size
+        start = seq.registered_pages
+        if full <= start:
+            return
+        blocks = seq.blocks.blocks[start:full]
+        self.allocator.register(
+            seq.page_ids[start:full],
+            [(blk.sequence_hash, blk.local_hash) for blk in blocks],
+            parent_hash=blocks[0].parent_sequence_hash if blocks else None,
+        )
+        seq.registered_pages = full
+
+    def _append_token(self, seq: Sequence, token: int, extra_meta: Optional[dict] = None) -> None:
+        seq.blocks.extend([token])
+        seq.generated += 1
+        frame = EngineOutput(token_ids=[token])
+        if extra_meta:
+            frame.meta = extra_meta
+        seq.out_queue.put_nowait(frame.to_dict())
+        reason = seq.check_finish(token)
+        if reason:
+            self._finish(seq, reason)
+
+    def _finish(self, seq: Sequence, reason: str) -> None:
+        self._register_full_pages(seq)
+        self.allocator.release(seq.page_ids)
+        if seq.slot >= 0:
+            self.slots[seq.slot] = None
+            seq.slot = -1
+        seq.finish = reason
+        seq.out_queue.put_nowait(EngineOutput.final(reason).to_dict())
+        self._wake.set()

@@ -1,0 +1,107 @@
+"""Sequence state and admission/preemption policy for continuous batching.
+
+The reference inherits scheduling from vLLM (its fork patch adds
+remote-prefill-aware scheduling, reference: patch:334-935); here the
+scheduler is native and deliberately simple and single-threaded (the engine
+loop is the only caller — the reference's progress-engine pattern,
+SURVEY.md §5):
+
+- FIFO admission into fixed decode **slots** (static batch shape for XLA);
+- prompt pages allocated up front (after prefix-cache match), decode pages
+  grown one at a time;
+- when a decode-time page allocation fails, the most-recently admitted
+  sequence is preempted: pages released, sequence requeued at the front —
+  its re-prefill usually rides the prefix cache.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+from dataclasses import dataclass, field
+from typing import Optional
+
+from dynamo_tpu.llm.protocols.common import (
+    FINISH_REASON_CANCELLED,
+    FINISH_REASON_EOS,
+    FINISH_REASON_LENGTH,
+    PreprocessedRequest,
+)
+from dynamo_tpu.llm.tokens import TokenBlockSequence
+from dynamo_tpu.runtime.pipeline.context import Context
+
+_seq_counter = itertools.count()
+
+
+@dataclass
+class Sequence:
+    ctx: Context
+    pre: PreprocessedRequest
+    blocks: TokenBlockSequence          # prompt + sampled tokens, hashed per page
+    out_queue: asyncio.Queue = field(default_factory=asyncio.Queue)
+    seq_id: int = field(default_factory=lambda: next(_seq_counter))
+
+    prompt_len: int = 0
+    page_ids: list[int] = field(default_factory=list)
+    num_cached: int = 0        # prefix-cache tokens reused at admission
+    num_computed: int = 0      # tokens whose KV is valid in pages
+    registered_pages: int = 0  # leading pages whose hashes are registered
+    slot: int = -1
+    generated: int = 0
+    finish: Optional[str] = None
+
+    # per-request sampling (resolved once at admission)
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    max_new_tokens: int = 0
+    eos_ids: frozenset[int] = frozenset()
+    ignore_eos: bool = False
+
+    @classmethod
+    def from_request(
+        cls, ctx: Context, pre: PreprocessedRequest, page_size: int, max_model_len: int
+    ) -> "Sequence":
+        seq = cls(
+            ctx=ctx,
+            pre=pre,
+            blocks=TokenBlockSequence(pre.token_ids, page_size),
+            prompt_len=len(pre.token_ids),
+        )
+        so = pre.sampling_options
+        seq.temperature = 0.0 if so.greedy else float(so.temperature or 0.0)
+        seq.top_k = int(so.top_k or 0)
+        seq.top_p = float(so.top_p if so.top_p is not None else 1.0)
+        budget = max_model_len - seq.prompt_len
+        mt = pre.stop_conditions.max_tokens
+        seq.max_new_tokens = max(0, min(budget, mt) if mt is not None else budget)
+        seq.eos_ids = frozenset(
+            list(pre.eos_token_ids) + list(pre.stop_conditions.stop_token_ids)
+        )
+        seq.ignore_eos = pre.stop_conditions.ignore_eos
+        return seq
+
+    @property
+    def tokens(self) -> list[int]:
+        return self.blocks.all_tokens()
+
+    @property
+    def total_tokens(self) -> int:
+        return self.blocks.total_tokens
+
+    @property
+    def last_token(self) -> int:
+        if self.blocks.partial:
+            return self.blocks.partial[-1]
+        return self.blocks.blocks[-1].tokens[-1]
+
+    def check_finish(self, new_token: int) -> Optional[str]:
+        """Engine-level stop: eos/stop ids and token budget (stop *strings*
+        are the detokenizing backend's job downstream)."""
+        if self.ctx.is_stopped():
+            return FINISH_REASON_CANCELLED
+        if not self.ignore_eos and new_token in self.eos_ids:
+            return FINISH_REASON_EOS
+        if self.generated >= self.max_new_tokens:
+            return FINISH_REASON_LENGTH
+        return None

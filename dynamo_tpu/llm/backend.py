@@ -19,6 +19,7 @@ from typing import AsyncIterator, Optional
 from dynamo_tpu.llm.protocols.common import (
     FINISH_REASON_CANCELLED,
     FINISH_REASON_EOS,
+    FINISH_REASON_ERROR,
     FINISH_REASON_LENGTH,
     EngineOutput,
     PreprocessedRequest,
@@ -81,10 +82,10 @@ class StopSequenceDecoder:
         past_min = self._generated > self._min_tokens
         if not self._ignore_eos and past_min and token_id in self._eos_ids:
             self.finish_reason = FINISH_REASON_EOS
-            return self._flush_jail(truncate_at=None)
+            return self.flush()
         if past_min and token_id in self._stop_ids:
             self.finish_reason = FINISH_REASON_EOS
-            return self._flush_jail(truncate_at=None)
+            return self.flush()
 
         piece = self._decode.step(token_id)
         released: Optional[str] = None
@@ -114,8 +115,9 @@ class StopSequenceDecoder:
             return released or None
         return released
 
-    def _flush_jail(self, truncate_at: Optional[int]) -> Optional[str]:
-        text = self._jail if truncate_at is None else self._jail[:truncate_at]
+    def flush(self) -> Optional[str]:
+        """Release all held-back text (stream ending for any reason)."""
+        text = self._jail
         self._jail = ""
         return text or None
 
@@ -149,11 +151,18 @@ class Backend(Operator):
         upstream = await next_engine.generate(request.map(pre.to_dict()))
 
         async def _out() -> AsyncIterator[dict]:
+            # token ids consumed but not yet emitted (their text is still held
+            # by the incremental detokenizer) — attached to the next frame so
+            # usage accounting downstream sees every generated token
+            pending_ids: list[int] = []
             async for raw in upstream:
                 out = EngineOutput.from_dict(raw) if isinstance(raw, dict) else raw
                 if request.is_stopped() and not decoder.finished:
                     decoder.finish_reason = FINISH_REASON_CANCELLED
-                    yield EngineOutput.final(FINISH_REASON_CANCELLED).to_dict()
+                    yield EngineOutput(
+                        token_ids=pending_ids,
+                        finish_reason=FINISH_REASON_CANCELLED,
+                    ).to_dict()
                     return
                 text_parts: list[str] = []
                 consumed = 0
@@ -164,15 +173,17 @@ class Backend(Operator):
                         text_parts.append(piece)
                     if decoder.finished:
                         break
+                # only the consumed prefix: tokens past a mid-chunk stop must
+                # not leak into usage accounting downstream
+                pending_ids.extend(out.token_ids[:consumed])
                 if text_parts or decoder.finished:
-                    # only the consumed prefix: tokens past a mid-chunk stop
-                    # must not leak into usage accounting downstream
                     yield EngineOutput(
-                        token_ids=out.token_ids[:consumed],
+                        token_ids=pending_ids,
                         text="".join(text_parts) or None,
                         finish_reason=decoder.finish_reason,
                         meta=out.meta,
                     ).to_dict()
+                    pending_ids = []
                 if decoder.finished:
                     # tell the engine to stop producing (remote: stop frame)
                     request.stop_generating()
@@ -180,10 +191,20 @@ class Backend(Operator):
                 if out.finish_reason:
                     # engine finished on its own (its own length/stop logic):
                     # release any text held back as a partial stop-string match
-                    tail = decoder._flush_jail(None)
                     yield EngineOutput(
-                        text=tail, finish_reason=out.finish_reason
+                        token_ids=pending_ids,
+                        text=decoder.flush(),
+                        finish_reason=out.finish_reason,
+                        meta=out.meta,
                     ).to_dict()
                     return
+            if not decoder.finished:
+                # upstream ended without a finish frame (truncated/crashed
+                # stream): release held text, surface the abnormal end
+                yield EngineOutput(
+                    token_ids=pending_ids,
+                    text=decoder.flush(),
+                    finish_reason=FINISH_REASON_ERROR,
+                ).to_dict()
 
         return _out()

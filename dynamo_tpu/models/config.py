@@ -101,6 +101,9 @@ TINY = _preset(ModelConfig(
     tie_word_embeddings=True,
 ))
 
+# Llama-3.2 checkpoints were trained with rope factor 32 (not 3.1's 8).
+_LLAMA32_SCALING = {**_LLAMA31_SCALING, "factor": 32.0}
+
 # A ~1.2B debug/bench config (fits any single TPU chip in bf16).
 _preset(ModelConfig(
     name="llama-3.2-1b",
@@ -111,7 +114,7 @@ _preset(ModelConfig(
     num_heads=32,
     num_kv_heads=8,
     head_dim=64,
-    rope_scaling=_LLAMA31_SCALING,
+    rope_scaling=_LLAMA32_SCALING,
     tie_word_embeddings=True,
 ))
 
@@ -124,7 +127,7 @@ _preset(ModelConfig(
     num_heads=24,
     num_kv_heads=8,
     head_dim=128,
-    rope_scaling=_LLAMA31_SCALING,
+    rope_scaling=_LLAMA32_SCALING,
     tie_word_embeddings=True,
 ))
 

@@ -1,0 +1,136 @@
+"""Device mesh construction and model shardings.
+
+Replaces the reference's `--tensor-parallel-size` passthrough + NCCL
+(reference: launch/dynamo-run/src/flags.rs:67, lib/engines/sglang/src/lib.rs:64-73)
+with native mesh-axis shardings. One mesh carries every axis:
+
+    axes (dp, pp, ep, sp, tp)  —  tp innermost so TP collectives ride the
+                                  fastest ICI links; dp outermost so replicas
+                                  can span hosts/DCN.
+
+- **tp**: megatron-style column/row parallel linear layers; KV heads sharded
+  so the paged-KV path needs no collectives.
+- **sp**: sequence (context) parallel — long-prefill activations sharded
+  over the token axis (ring/all-gather attention lives in ops/).
+- **pp**: layer-sharded pipeline v1 — layer weights live on their stage;
+  XLA moves the activation stream between stages.
+- **ep**: expert parallel axis for MoE models (axis exists on every mesh so
+  graphs are portable; size 1 for dense models).
+- **dp**: engine-internal data parallel over decode slots / prefill batch.
+
+GSPMD does the rest: we annotate params + KV + a few activations and XLA
+inserts all-gathers/reduce-scatters/psums over ICI.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dynamo_tpu.models.config import ModelConfig
+
+AXES = ("dp", "pp", "ep", "sp", "tp")
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    tp: int = 1
+    pp: int = 1
+    sp: int = 1
+    ep: int = 1
+    dp: int = 1
+
+    @property
+    def num_devices(self) -> int:
+        return self.tp * self.pp * self.sp * self.ep * self.dp
+
+    @classmethod
+    def for_devices(cls, n: int, tp: Optional[int] = None) -> "MeshConfig":
+        """Default layout: all-TP up to 8 (one v5e host), dp beyond."""
+        if tp is None:
+            tp = math.gcd(n, 8)
+        if n % tp:
+            raise ValueError(f"tp={tp} does not divide {n} devices")
+        return cls(tp=tp, dp=n // tp)
+
+
+def build_mesh(cfg: MeshConfig, devices=None) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    if len(devices) < cfg.num_devices:
+        raise ValueError(
+            f"mesh {cfg} needs {cfg.num_devices} devices, have {len(devices)}"
+        )
+    arr = np.asarray(devices[: cfg.num_devices]).reshape(
+        cfg.dp, cfg.pp, cfg.ep, cfg.sp, cfg.tp
+    )
+    return Mesh(arr, AXES)
+
+
+def param_shardings(cfg: ModelConfig, mesh: Mesh) -> dict:
+    """NamedSharding pytree matching `llama.init_params` structure.
+
+    Column-parallel (out-dim over tp): wq/wk/wv, w_gate/w_up;
+    row-parallel (in-dim over tp): wo, w_down; vocab over tp for
+    embed/lm_head; norms replicated. Layer weights additionally live on
+    their pipeline stage via the leading per-layer list — pp shards
+    nothing inside a layer, stages are assigned by the engine splitting
+    the layer list (v1: pp=1 in-engine; cross-stage serving composes
+    engines).
+    """
+
+    def ns(*spec):
+        return NamedSharding(mesh, P(*spec))
+
+    layer = {
+        "attn_norm": ns(),
+        "wq": ns(None, "tp"),
+        "wk": ns(None, "tp"),
+        "wv": ns(None, "tp"),
+        "wo": ns("tp", None),
+        "mlp_norm": ns(),
+        "w_gate": ns(None, "tp"),
+        "w_up": ns(None, "tp"),
+        "w_down": ns("tp", None),
+    }
+    if cfg.attn_bias:
+        layer["bq"] = ns("tp")
+        layer["bk"] = ns("tp")
+        layer["bv"] = ns("tp")
+
+    out = {
+        "embed": ns("tp", None),  # vocab-sharded; lookup all-gathers over tp
+        "layers": [dict(layer) for _ in range(cfg.num_layers)],
+        "final_norm": ns(),
+    }
+    if not cfg.tie_word_embeddings:
+        out["lm_head"] = ns(None, "tp")
+    return out
+
+
+def kv_cache_sharding(mesh: Mesh) -> NamedSharding:
+    """KV pools [L, N_slots, K, Hd]: KV heads over tp — gathers/scatters
+    stay shard-local, no collectives on the KV path."""
+    return NamedSharding(mesh, P(None, None, "tp", None))
+
+
+def token_sharding(mesh: Mesh) -> NamedSharding:
+    """Token/position/slot arrays [B, T]: batch over dp, sequence over sp."""
+    return NamedSharding(mesh, P("dp", "sp"))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_params(params, cfg: ModelConfig, mesh: Mesh):
+    """device_put the param pytree against its shardings."""
+    shardings = param_shardings(cfg, mesh)
+    return jax.tree.map(
+        lambda arr, s: jax.device_put(arr, s), params, shardings,
+        is_leaf=lambda x: not isinstance(x, (dict, list)),
+    )

@@ -1,0 +1,219 @@
+"""Engine e2e tests (CPU, tiny model): continuous batching, prefix cache,
+preemption, KV events, and the full HTTP-shaped pipeline.
+
+Oracle: the jitted engine under concurrency must reproduce the single-step
+manual forward loop (greedy), mirroring the reference's strategy of testing
+distributed graphs against echo/counting engines (SURVEY.md §4) — except our
+engine is real, so the oracle is the model itself.
+"""
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dynamo_tpu.engine import EngineConfig, JaxEngine
+from dynamo_tpu.llm.protocols.common import (
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.models import config as cfgmod, llama
+from dynamo_tpu.runtime.pipeline.context import Context
+
+CFG = cfgmod.get_config("tiny")
+
+
+def make_engine(**kw) -> JaxEngine:
+    defaults = dict(
+        model=CFG,
+        dtype="float32",
+        page_size=8,
+        num_pages=64,
+        max_batch_size=4,
+        max_model_len=128,
+        prefill_chunk=32,
+        seed=0,
+    )
+    defaults.update(kw)
+    return JaxEngine(EngineConfig(**defaults))
+
+
+def greedy_request(prompt, max_tokens=8, **stop_kw) -> PreprocessedRequest:
+    return PreprocessedRequest(
+        token_ids=list(prompt),
+        stop_conditions=StopConditions(max_tokens=max_tokens, **stop_kw),
+        sampling_options=SamplingOptions(greedy=True),
+    )
+
+
+async def collect(engine, pre):
+    frames = [f async for f in await engine.generate(Context(pre.to_dict()))]
+    tokens = [t for f in frames for t in f.get("token_ids") or []]
+    finish = frames[-1].get("finish_reason")
+    return tokens, finish, frames
+
+
+def manual_greedy(prompt, n):
+    """Reference loop: direct forward calls, one token at a time."""
+    params = llama.init_params(CFG, jax.random.PRNGKey(0), dtype=jnp.float32)
+    kv = llama.init_kv_cache(CFG, 512, dtype=jnp.float32)
+    toks = list(prompt)
+    out = []
+    for step in range(n):
+        t = len(toks)
+        if step == 0:
+            tok_in = np.asarray([toks], np.int32)
+            pos = np.arange(t)[None]
+            wslots = np.arange(8, 8 + t)
+        else:
+            tok_in = np.asarray([[toks[-1]]], np.int32)
+            pos = np.asarray([[t - 1]])
+            wslots = np.asarray([8 + t - 1])
+        smat = np.arange(8, 8 + t)[None]
+        hidden, kv = llama.forward(
+            params, CFG.with_(dtype="float32"), jnp.asarray(tok_in),
+            jnp.asarray(pos, jnp.int32), kv,
+            jnp.asarray(wslots, jnp.int32), jnp.asarray(smat, jnp.int32),
+        )
+        lg = llama.logits(params, CFG, hidden[:, -1])
+        nxt = int(jnp.argmax(lg[0]))
+        toks.append(nxt)
+        out.append(nxt)
+    return out
+
+
+async def test_single_request_matches_manual_loop():
+    engine = make_engine()
+    prompt = [5, 17, 42, 9, 88]
+    tokens, finish, _ = await collect(engine, greedy_request(prompt, max_tokens=6))
+    assert finish == "length"
+    assert tokens == manual_greedy(prompt, 6)
+    await engine.close()
+
+
+async def test_concurrent_requests_batch_and_isolate():
+    engine = make_engine()
+    prompts = [[5, 17, 42], [9, 88, 3, 21], [60, 14], [7, 7, 7, 7, 7]]
+    expected = [manual_greedy(p, 5) for p in prompts]
+    results = await asyncio.gather(
+        *(collect(engine, greedy_request(p, max_tokens=5)) for p in prompts)
+    )
+    for (tokens, finish, _), exp in zip(results, expected):
+        assert finish == "length"
+        assert tokens == exp
+    await engine.close()
+
+
+async def test_prefix_cache_hit_and_events():
+    events = []
+    engine = make_engine()
+    engine.subscribe_events(events.append)
+    prompt = list(range(10, 30))  # 20 tokens = 2 full pages + tail
+    t1, _, frames1 = await collect(engine, greedy_request(prompt, max_tokens=4))
+    assert frames1[0]["meta"]["prefix_cached_tokens"] == 0
+    stored = [e for e in events if e["type"] == "stored"]
+    assert stored and all("block_hash" in b for e in stored for b in e["blocks"])
+
+    # same prompt again: the two full prompt pages must be reused
+    t2, _, frames2 = await collect(engine, greedy_request(prompt, max_tokens=4))
+    assert frames2[0]["meta"]["prefix_cached_tokens"] == 16
+    assert t2 == t1
+    m = engine.metrics()
+    assert m["gpu_prefix_cache_hit_rate"] > 0
+    await engine.close()
+
+
+async def test_eos_stop():
+    engine = make_engine()
+    prompt = [5, 17, 42, 9, 88]
+    first = manual_greedy(prompt, 1)[0]
+    pre = greedy_request(prompt, max_tokens=16, stop_token_ids=[first])
+    tokens, finish, _ = await collect(engine, pre)
+    assert finish == "stop"
+    assert tokens == [first]  # eos emitted then stop
+    await engine.close()
+
+
+async def test_preemption_under_page_pressure():
+    # 15 usable pages, two long-running sequences => someone gets preempted
+    engine = make_engine(num_pages=16, max_model_len=96, max_batch_size=2)
+    prompts = [list(range(20, 52)), list(range(60, 92))]  # 32 tokens each
+    expected = [manual_greedy(p, 24) for p in prompts]
+    results = await asyncio.gather(
+        *(collect(engine, greedy_request(p, max_tokens=24)) for p in prompts)
+    )
+    for (tokens, finish, _), exp in zip(results, expected):
+        assert finish == "length"
+        assert tokens == exp
+    await engine.close()
+
+
+async def test_cancellation_mid_stream():
+    engine = make_engine()
+    ctx = Context(greedy_request([5, 17, 42], max_tokens=100).to_dict())
+    stream = await engine.generate(ctx)
+    got = 0
+    async for frame in stream:
+        got += 1
+        if got == 3:
+            ctx.stop_generating()
+        if frame.get("finish_reason"):
+            assert frame["finish_reason"] == "cancelled"
+            break
+    assert got >= 3
+    await engine.close()
+
+
+async def test_waiting_queue_when_slots_full():
+    engine = make_engine(max_batch_size=2)
+    prompts = [[i, i + 1, i + 2] for i in range(5, 45, 8)]  # 5 requests, 2 slots
+    results = await asyncio.gather(
+        *(collect(engine, greedy_request(p, max_tokens=4)) for p in prompts)
+    )
+    for p, (tokens, finish, _) in zip(prompts, results):
+        assert finish == "length"
+        assert tokens == manual_greedy(p, 4)
+    await engine.close()
+
+
+async def test_prompt_too_long_rejected():
+    engine = make_engine(max_model_len=32)
+    try:
+        await engine.generate(Context(greedy_request(list(range(40))).to_dict()))
+        raise AssertionError("expected ValueError")
+    except ValueError:
+        pass
+    await engine.close()
+
+
+async def test_full_pipeline_http_shape():
+    """preprocessor -> backend -> JaxEngine, chat-completion shaped."""
+    from dynamo_tpu.llm.backend import Backend
+    from dynamo_tpu.llm.model_card import ModelDeploymentCard
+    from dynamo_tpu.llm.preprocessor import OpenAIPreprocessor
+    from dynamo_tpu.llm.protocols.openai import ChatCompletionRequest
+    from dynamo_tpu.runtime.pipeline.engine import link
+
+    from .fixtures import tiny_model_dir
+
+    card = ModelDeploymentCard.from_local_path(tiny_model_dir(), name="tiny")
+    engine = make_engine(model=CFG.with_(vocab_size=512), max_model_len=256)
+    pipeline = link(OpenAIPreprocessor(card), Backend.from_card(card), engine)
+    req = ChatCompletionRequest.from_body(
+        {
+            "model": "tiny",
+            "messages": [{"role": "user", "content": "the quick brown fox"}],
+            "max_tokens": 8,
+        }
+    )
+    chunks = [c async for c in await pipeline.generate(Context(req))]
+    assert chunks, "no output"
+    finishes = [
+        c["choices"][0].get("finish_reason")
+        for c in chunks
+        if c.get("choices")
+    ]
+    assert any(f in ("length", "stop") for f in finishes)
+    await engine.close()

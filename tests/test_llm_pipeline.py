@@ -241,3 +241,34 @@ async def test_backend_truncates_tokens_at_mid_chunk_stop():
     assert emitted < len(ids)  # tail after the stop point not counted
     text = "".join(o.get("text") or "" for o in out)
     assert text == "one "
+
+
+async def test_backend_truncated_stream_flushes_and_errors():
+    """Upstream ending without a finish frame must release jailed text and
+    surface finish_reason=error."""
+    tok = HuggingFaceTokenizer.from_file(tiny_model_dir())
+    ids = tok.encode("hello world ST")
+
+    class TruncatedEngine:
+        async def generate(self, request):
+            async def _gen():
+                for tid in ids:
+                    yield {"token_ids": [tid]}
+                # no final frame: crashed/truncated remote stream
+
+            return _gen()
+
+    backend = Backend(tok)
+    from dynamo_tpu.llm.protocols.common import PreprocessedRequest, StopConditions
+
+    pre = PreprocessedRequest(
+        token_ids=[1], stop_conditions=StopConditions(stop=["STOP"])
+    )
+    out = [
+        o
+        async for o in await backend.generate(Context(pre.to_dict()), TruncatedEngine())
+    ]
+    assert out[-1]["finish_reason"] == "error"
+    text = "".join(o.get("text") or "" for o in out)
+    assert text == "hello world ST"
+    assert sum(len(o.get("token_ids") or []) for o in out) == len(ids)
