@@ -189,6 +189,14 @@ class JaxEngine:
                 f"prompt of {len(pre.token_ids)} tokens exceeds "
                 f"max_model_len={self.config.max_model_len}"
             )
+        # a prompt needing more pages than the pool can ever supply would
+        # hang admission forever (and head-of-line block the queue)
+        usable_tokens = (self.num_pages - 1) * self.page_size
+        if len(pre.token_ids) + 1 > usable_tokens:
+            raise ValueError(
+                f"prompt of {len(pre.token_ids)} tokens cannot fit the KV pool "
+                f"({self.num_pages - 1} pages x {self.page_size} tokens)"
+            )
         if len(pre.token_ids) == 0:
             raise ValueError("empty prompt")
         seq = Sequence.from_request(

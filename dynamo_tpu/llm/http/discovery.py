@@ -90,11 +90,14 @@ class RouterEngine:
     a pipeline sink). Mode may be random/round_robin, or kv when a
     KvPushRouter is installed."""
 
-    def __init__(self, client, mode: str = "round_robin"):
+    def __init__(self, client, mode: str = "round_robin", kv_router=None):
         self.client = client
         self.mode = mode
+        self.kv_router = kv_router
 
     async def generate(self, request):
+        if self.kv_router is not None:
+            return await self.kv_router.generate(request.payload, context=request)
         return await self.client.generate(
             request.payload, context=request, mode=self.mode
         )
@@ -114,6 +117,7 @@ class ModelWatcher:
         self._entries: dict[str, set[str]] = {}
         self._model_names: dict[str, str] = {}  # service_name -> public name
         self._clients: dict[str, object] = {}
+        self._kv_routers: dict[str, object] = {}  # service -> KvPushRouter (mode kv)
         self.pipeline_factory = self._default_pipeline
 
     async def start(self) -> None:
@@ -128,6 +132,8 @@ class ModelWatcher:
             self._task = None
         if self._watch:
             await self._watch.cancel()
+        for router in self._kv_routers.values():
+            await router.router.close()
         for client in self._clients.values():
             await client.close()
 
@@ -164,6 +170,13 @@ class ModelWatcher:
         )
         client = await ep.client()
         self._clients[service] = client
+        if self.router_mode == "kv":
+            from dynamo_tpu.llm.kv_router import KvPushRouter
+
+            router = await KvPushRouter.create(
+                ep.component, client, block_size=card.kv_cache_block_size
+            )
+            self._kv_routers[service] = router
         pipeline = self._build(entry, card, client)
         self.manager.add_chat_model(entry.name, pipeline)
         self.manager.add_completion_model(entry.name, pipeline)
@@ -174,7 +187,12 @@ class ModelWatcher:
         if entry.model_type == MODEL_TYPE_BACKEND:
             return self.pipeline_factory(entry, card, client)
         # chat/completion model types: worker does its own pre/post
-        return RouterEngine(client, self.router_mode)
+        return self._router_engine(entry.service_name, client)
+
+    def _router_engine(self, service: str, client) -> RouterEngine:
+        return RouterEngine(
+            client, self.router_mode, kv_router=self._kv_routers.get(service)
+        )
 
     def _default_pipeline(self, entry, card, client):
         from dynamo_tpu.llm.tokenizer import HuggingFaceTokenizer
@@ -184,7 +202,7 @@ class ModelWatcher:
         return link(
             OpenAIPreprocessor(card, tokenizer=tokenizer),
             Backend(tokenizer),
-            RouterEngine(client, self.router_mode),
+            self._router_engine(entry.service_name, client),
         )
 
     async def _on_delete(self, key: str) -> None:
@@ -198,6 +216,9 @@ class ModelWatcher:
         self._entries.pop(service, None)
         name = self._model_names.pop(service, service)
         self.manager.remove_model(name)
+        kv_router = self._kv_routers.pop(service, None)
+        if kv_router is not None:
+            await kv_router.router.close()
         client = self._clients.pop(service, None)
         if client is not None:
             await client.close()

@@ -1,0 +1,171 @@
+"""KV-cache-aware routing (reference: lib/llm/src/kv_router.rs:67-169).
+
+`KvRouter` ties the pieces: a radix indexer fed by worker `kv_events`, a
+metrics aggregator scraping worker load, and the scheduler's logit formula.
+`KvPushRouter` plugs it into the runtime client as routing mode "kv": each
+request's token ids are block-hashed, matched, scheduled, and sent direct
+to the chosen worker. Worker death (lease expiry -> instance-down) purges
+the worker from the index.
+"""
+
+from __future__ import annotations
+
+from typing import Any, AsyncIterator, Optional
+
+from dynamo_tpu.llm.kv_router.indexer import KvIndexer, OverlapScores, RadixTree
+from dynamo_tpu.llm.kv_router.metrics_aggregator import (
+    KvMetricsAggregator,
+    ProcessedEndpoints,
+)
+from dynamo_tpu.llm.kv_router.protocols import (
+    ForwardPassMetrics,
+    KvCacheEvent,
+    RouterEvent,
+    RouterRequest,
+    RouterResponse,
+)
+from dynamo_tpu.llm.kv_router.publisher import KvEventPublisher, KvMetricsPublisher
+from dynamo_tpu.llm.kv_router.scheduler import (
+    DefaultWorkerSelector,
+    KvScheduler,
+    SchedulingDecision,
+    WorkerSelector,
+)
+from dynamo_tpu.llm.tokens import compute_block_hashes
+from dynamo_tpu.runtime.client import Client, PushRouter
+from dynamo_tpu.runtime.pipeline.context import Context
+
+__all__ = [
+    "KvRouter",
+    "KvPushRouter",
+    "KvIndexer",
+    "RadixTree",
+    "OverlapScores",
+    "KvScheduler",
+    "DefaultWorkerSelector",
+    "WorkerSelector",
+    "SchedulingDecision",
+    "KvEventPublisher",
+    "KvMetricsPublisher",
+    "KvMetricsAggregator",
+    "ProcessedEndpoints",
+    "ForwardPassMetrics",
+    "RouterEvent",
+    "KvCacheEvent",
+    "RouterRequest",
+    "RouterResponse",
+]
+
+
+class KvRouter:
+    """Indexer + aggregator + scheduler for one worker component."""
+
+    def __init__(
+        self,
+        component,
+        client: Client,
+        block_size: int = 16,
+        selector: Optional[WorkerSelector] = None,
+        poll_interval: float = 1.0,
+    ):
+        self.component = component
+        self.client = client
+        self.block_size = block_size
+        self.indexer = KvIndexer(component, block_size)
+        self.aggregator = KvMetricsAggregator(client, poll_interval)
+        self.scheduler = KvScheduler(
+            component=component, selector=selector, block_size=block_size
+        )
+        self._started = False
+
+    async def start(self) -> "KvRouter":
+        if self._started:
+            return self
+        await self.indexer.start()
+        await self.aggregator.start()
+        self.component._drt.on_instance_down(self._on_instance_down)
+        self._started = True
+        return self
+
+    def _on_instance_down(self, endpoint_id, worker_id: int) -> None:
+        if endpoint_id.subject.startswith(
+            f"{self.component.namespace.name}.{self.component.name}."
+        ):
+            self.indexer.remove_worker(worker_id)
+
+    async def schedule(self, token_ids: list[int]) -> SchedulingDecision:
+        """Pick the worker for these tokens (reference:
+        kv_router.rs:129-141 `schedule`)."""
+        overlaps = self.indexer.find_matches(
+            compute_block_hashes(token_ids, self.block_size)
+        )
+        workers = self.aggregator.endpoints_for(self.client.instance_ids())
+        decision = await self.scheduler.schedule(
+            workers, overlaps, isl_tokens=len(token_ids)
+        )
+        if decision is None:
+            from dynamo_tpu.runtime.client import NoInstancesError
+
+            raise NoInstancesError(
+                f"no live instances of {self.client.endpoint_id.subject}"
+            )
+        return decision
+
+    # --- router-as-engine (reference: kv_router.rs:144-169) -------------
+
+    async def generate(self, request: Context) -> AsyncIterator[dict]:
+        payload = request.payload
+        token_ids = payload["token_ids"] if isinstance(payload, dict) else payload.token_ids
+        decision = await self.schedule(token_ids)
+
+        async def _one() -> AsyncIterator[dict]:
+            yield RouterResponse(
+                worker_id=decision.worker_id,
+                overlap_blocks=decision.overlap_blocks,
+            ).to_dict()
+
+        return _one()
+
+    async def close(self) -> None:
+        await self.indexer.close()
+        await self.aggregator.close()
+
+
+class KvPushRouter(PushRouter):
+    """PushRouter in mode "kv": schedule per request, then route direct
+    (reference: PushRouter KV mode + examples/llm/components/kv_router.py)."""
+
+    def __init__(self, client: Client, router: KvRouter):
+        super().__init__(client, mode="kv")
+        self.router = router
+
+    @classmethod
+    async def create(
+        cls,
+        component,
+        client: Client,
+        block_size: int = 16,
+        selector: Optional[WorkerSelector] = None,
+    ) -> "KvPushRouter":
+        router = KvRouter(component, client, block_size=block_size, selector=selector)
+        await router.start()
+        return cls(client, router)
+
+    async def generate(
+        self, payload: Any, context: Optional[Context] = None
+    ) -> AsyncIterator[Any]:
+        token_ids = (
+            payload.get("token_ids")
+            if isinstance(payload, dict)
+            else getattr(payload, "token_ids", None)
+        )
+        if not token_ids:
+            # no token-level view (chat/completion-type models do their own
+            # preprocessing): KV affinity is unknowable, load-balance instead
+            return await self.client.generate(
+                payload, context=context, mode="round_robin"
+            )
+        decision = await self.router.schedule(list(token_ids))
+        return await self.client.generate(
+            payload, context=context, mode="direct", instance_id=decision.worker_id
+        )

@@ -1,0 +1,74 @@
+"""Router-side metrics aggregation.
+
+Background scrape of every live instance's stats handler into a
+`ProcessedEndpoints` snapshot (reference:
+lib/llm/src/kv_router/metrics_aggregator.rs:26-51, scoring.rs:24): the
+scheduler reads the latest snapshot; staleness between polls is acceptable
+by design (same as the reference's watch-channel model).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import statistics
+from dataclasses import dataclass, field
+from typing import Optional
+
+from dynamo_tpu.llm.kv_router.protocols import ForwardPassMetrics
+
+
+@dataclass
+class ProcessedEndpoints:
+    endpoints: dict[int, ForwardPassMetrics] = field(default_factory=dict)
+
+    @property
+    def worker_ids(self) -> list[int]:
+        return sorted(self.endpoints.keys())
+
+    @property
+    def load_avg(self) -> float:
+        loads = [m.kv_active_blocks for m in self.endpoints.values()]
+        return statistics.fmean(loads) if loads else 0.0
+
+    @property
+    def load_std(self) -> float:
+        loads = [m.kv_active_blocks for m in self.endpoints.values()]
+        return statistics.pstdev(loads) if len(loads) > 1 else 0.0
+
+
+class KvMetricsAggregator:
+    def __init__(self, client, poll_interval: float = 1.0):
+        self.client = client  # runtime Client of the workers' endpoint
+        self.poll_interval = poll_interval
+        self.current = ProcessedEndpoints()
+        self._task: Optional[asyncio.Task] = None
+
+    async def start(self) -> None:
+        await self._scrape_once()
+        self._task = asyncio.create_task(self._poll())
+
+    async def _poll(self) -> None:
+        while True:
+            await asyncio.sleep(self.poll_interval)
+            await self._scrape_once()
+
+    async def _scrape_once(self) -> None:
+        stats = await self.client.scrape_stats()
+        self.current = ProcessedEndpoints(
+            endpoints={
+                wid: ForwardPassMetrics.from_dict(s) for wid, s in stats.items()
+            }
+        )
+
+    def endpoints_for(self, worker_ids: list[int]) -> dict[int, ForwardPassMetrics]:
+        """Metrics for the given live workers; workers missing from the last
+        scrape get default (zero-load) metrics so new instances are
+        immediately routable."""
+        return {
+            wid: self.current.endpoints.get(wid, ForwardPassMetrics())
+            for wid in worker_ids
+        }
+
+    async def close(self) -> None:
+        if self._task:
+            self._task.cancel()

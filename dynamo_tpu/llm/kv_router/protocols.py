@@ -1,0 +1,127 @@
+"""KV routing plane protocols.
+
+Mirrors the reference's event/metric shapes (reference:
+lib/llm/src/kv_router/protocols.rs:43-121): `RouterEvent` wraps a worker's
+KV-cache event (stored/removed, parent-linked chained block hashes);
+`ForwardPassMetrics` is the per-worker load snapshot the scheduler weighs.
+Everything is plain dicts on the wire (msgpack via the hub event plane);
+these dataclasses are the typed views.
+"""
+
+from __future__ import annotations
+
+from dataclasses import asdict, dataclass, field
+from typing import Optional
+
+KV_EVENT_SUBJECT = "kv_events"
+KV_HIT_RATE_SUBJECT = "kv-hit-rate"
+LOAD_METRICS_ENDPOINT = "load_metrics"
+
+
+@dataclass
+class StoredBlock:
+    block_hash: int          # chained sequence hash (identity in prefix context)
+    tokens_hash: int         # local hash of the block's tokens
+    page_id: int = 0         # worker-local page (informational)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "StoredBlock":
+        return cls(
+            block_hash=d["block_hash"],
+            tokens_hash=d.get("tokens_hash", 0),
+            page_id=d.get("page_id", 0),
+        )
+
+
+@dataclass
+class KvCacheEvent:
+    """type: "stored" | "removed" (reference: KvCacheEventData)."""
+
+    type: str
+    event_id: int = 0
+    parent_hash: Optional[int] = None
+    blocks: list[StoredBlock] = field(default_factory=list)   # stored
+    block_hashes: list[int] = field(default_factory=list)      # removed
+    block_size: int = 0
+
+    def to_dict(self) -> dict:
+        return asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "KvCacheEvent":
+        return cls(
+            type=d["type"],
+            event_id=d.get("event_id", 0),
+            parent_hash=d.get("parent_hash"),
+            blocks=[StoredBlock.from_dict(b) for b in d.get("blocks") or []],
+            block_hashes=list(d.get("block_hashes") or []),
+            block_size=d.get("block_size", 0),
+        )
+
+
+@dataclass
+class RouterEvent:
+    """reference: RouterEvent{worker_id, KvCacheEvent}."""
+
+    worker_id: int
+    event: KvCacheEvent
+
+    def to_dict(self) -> dict:
+        return {"worker_id": self.worker_id, "event": self.event.to_dict()}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "RouterEvent":
+        return cls(worker_id=d["worker_id"], event=KvCacheEvent.from_dict(d["event"]))
+
+
+@dataclass
+class ForwardPassMetrics:
+    """reference: protocols.rs:43-54."""
+
+    request_active_slots: int = 0
+    request_total_slots: int = 0
+    kv_active_blocks: int = 0
+    kv_total_blocks: int = 0
+    num_requests_waiting: int = 0
+    gpu_cache_usage_perc: float = 0.0
+    gpu_prefix_cache_hit_rate: float = 0.0
+    data_parallel_rank: int = 0
+
+    def to_dict(self) -> dict:
+        return asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ForwardPassMetrics":
+        known = {f: d.get(f) for f in cls.__dataclass_fields__ if f in d}
+        return cls(**known)
+
+
+@dataclass
+class KVHitRateEvent:
+    """Emitted per routing decision (reference: scheduler.rs:32)."""
+
+    worker_id: int
+    isl_blocks: int
+    overlap_blocks: int
+
+    def to_dict(self) -> dict:
+        return asdict(self)
+
+
+@dataclass
+class RouterRequest:
+    """Router-as-engine request (reference: kv_router.rs:144-169)."""
+
+    token_ids: list[int]
+
+    def to_dict(self) -> dict:
+        return {"token_ids": self.token_ids}
+
+
+@dataclass
+class RouterResponse:
+    worker_id: int
+    overlap_blocks: int = 0
+
+    def to_dict(self) -> dict:
+        return asdict(self)

@@ -1,0 +1,92 @@
+"""Worker-side KV plane publishers.
+
+- `KvEventPublisher` bridges the engine's synchronous KV-event callback
+  onto the hub event plane as RouterEvents (reference:
+  lib/llm/src/kv_router/publisher.rs:34-76 + the C-FFI path the vLLM patch
+  uses; here the engine is in-process so it is just a queue).
+- `KvMetricsPublisher` snapshots engine metrics as ForwardPassMetrics and
+  doubles as the endpoint stats handler scraped by aggregators (reference:
+  publisher.rs:78-139).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Callable, Optional
+
+import msgpack
+
+from dynamo_tpu.llm.kv_router.protocols import (
+    KV_EVENT_SUBJECT,
+    ForwardPassMetrics,
+    KvCacheEvent,
+    RouterEvent,
+)
+from dynamo_tpu.utils.logging import get_logger
+
+log = get_logger("dynamo_tpu.kv_router")
+
+
+class KvEventPublisher:
+    """Queue engine KV events (sync callback) and publish them in order on
+    the component's `kv_events` subject."""
+
+    def __init__(self, component, worker_id: int):
+        self.component = component
+        self.worker_id = worker_id
+        self._queue: asyncio.Queue[Optional[dict]] = asyncio.Queue()
+        self._task: Optional[asyncio.Task] = None
+
+    def start(self) -> None:
+        self._task = asyncio.get_running_loop().create_task(self._pump())
+
+    def attach(self, engine) -> "KvEventPublisher":
+        """Subscribe to a JaxEngine's allocator events."""
+        engine.subscribe_events(self.on_event)
+        return self
+
+    def on_event(self, event: dict) -> None:
+        """Synchronous callback from the engine's allocator."""
+        self._queue.put_nowait(event)
+
+    async def _pump(self) -> None:
+        while True:
+            event = await self._queue.get()
+            if event is None:
+                return
+            router_event = RouterEvent(
+                worker_id=self.worker_id, event=KvCacheEvent.from_dict(event)
+            )
+            try:
+                await self.component.publish(
+                    KV_EVENT_SUBJECT, msgpack.packb(router_event.to_dict())
+                )
+            except Exception:  # noqa: BLE001
+                log.exception("kv event publish failed")
+
+    async def close(self) -> None:
+        self._queue.put_nowait(None)
+        if self._task:
+            await self._task
+
+
+class KvMetricsPublisher:
+    """Latest ForwardPassMetrics snapshot + stats handler for scrapes."""
+
+    def __init__(self, source: Optional[Callable[[], dict]] = None):
+        self._source = source
+        self.current = ForwardPassMetrics()
+
+    @classmethod
+    def for_engine(cls, engine) -> "KvMetricsPublisher":
+        return cls(source=engine.metrics)
+
+    def publish(self, metrics: ForwardPassMetrics) -> None:
+        self.current = metrics
+
+    def stats_handler(self) -> dict:
+        """Wire into EndpointConfigBuilder.stats_handler — scraped via the
+        data plane (reference: NATS $SRV.STATS)."""
+        if self._source is not None:
+            self.current = ForwardPassMetrics.from_dict(self._source())
+        return self.current.to_dict()

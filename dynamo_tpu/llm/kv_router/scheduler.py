@@ -1,0 +1,114 @@
+"""KV-aware worker selection.
+
+Ports the reference's decision logic, not its plumbing (reference:
+lib/llm/src/kv_router/scheduler.rs:236-339): a pluggable `WorkerSelector`
+scores each candidate worker
+
+    logit = 2 * (overlap_blocks * block_size / isl_tokens)
+            - gpu_cache_usage_perc
+            - active_slots / total_slots
+
+(the exact formula at scheduler.rs:290) and the best logit wins, ties
+broken randomly. Every decision emits a KVHitRateEvent on the component's
+`kv-hit-rate` subject for the metrics plane.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass
+from typing import Optional, Protocol
+
+from dynamo_tpu.llm.kv_router.indexer import OverlapScores
+from dynamo_tpu.llm.kv_router.protocols import ForwardPassMetrics, KVHitRateEvent
+
+
+@dataclass
+class SchedulingDecision:
+    worker_id: int
+    overlap_blocks: int
+    logit: float
+
+
+class WorkerSelector(Protocol):
+    def select(
+        self,
+        workers: dict[int, ForwardPassMetrics],
+        overlaps: OverlapScores,
+        isl_tokens: int,
+        block_size: int,
+    ) -> Optional[SchedulingDecision]: ...
+
+
+class DefaultWorkerSelector:
+    def __init__(self, rng: Optional[random.Random] = None):
+        self._rng = rng or random.Random()
+
+    def select(
+        self,
+        workers: dict[int, ForwardPassMetrics],
+        overlaps: OverlapScores,
+        isl_tokens: int,
+        block_size: int,
+    ) -> Optional[SchedulingDecision]:
+        if not workers:
+            return None
+        best: list[tuple[int, int, float]] = []  # (worker, overlap, logit)
+        for wid, m in workers.items():
+            overlap = overlaps.scores.get(wid, 0)
+            score = 2.0 * (overlap * block_size / max(isl_tokens, 1))
+            usage = m.gpu_cache_usage_perc
+            slots = (
+                m.request_active_slots / m.request_total_slots
+                if m.request_total_slots
+                else 0.0
+            )
+            logit = score - usage - slots
+            if not best or logit > best[0][2] + 1e-9:
+                best = [(wid, overlap, logit)]
+            elif abs(logit - best[0][2]) <= 1e-9:
+                best.append((wid, overlap, logit))
+        wid, overlap, logit = self._rng.choice(best)
+        return SchedulingDecision(worker_id=wid, overlap_blocks=overlap, logit=logit)
+
+
+class KvScheduler:
+    """Selector + hit-rate emission (reference: scheduler.rs:181-339)."""
+
+    def __init__(
+        self,
+        component=None,
+        selector: Optional[WorkerSelector] = None,
+        block_size: int = 16,
+    ):
+        self.component = component
+        self.selector = selector or DefaultWorkerSelector()
+        self.block_size = block_size
+
+    async def schedule(
+        self,
+        workers: dict[int, ForwardPassMetrics],
+        overlaps: OverlapScores,
+        isl_tokens: int,
+    ) -> Optional[SchedulingDecision]:
+        decision = self.selector.select(
+            workers, overlaps, isl_tokens, self.block_size
+        )
+        if decision is not None and self.component is not None:
+            import asyncio
+
+            import msgpack
+
+            from dynamo_tpu.llm.kv_router.protocols import KV_HIT_RATE_SUBJECT
+
+            ev = KVHitRateEvent(
+                worker_id=decision.worker_id,
+                isl_blocks=-(-isl_tokens // self.block_size),
+                overlap_blocks=decision.overlap_blocks,
+            )
+            # fire-and-forget: telemetry must not add a hub RTT to TTFT
+            task = asyncio.create_task(
+                self.component.publish(KV_HIT_RATE_SUBJECT, msgpack.packb(ev.to_dict()))
+            )
+            task.add_done_callback(lambda t: t.exception())
+        return decision

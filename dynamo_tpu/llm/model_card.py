@@ -149,8 +149,12 @@ class ModelDeploymentCard:
             if data is None:
                 continue
             fpath = os.path.join(cache_dir, fname)
-            with open(fpath, "wb") as f:
+            # atomic: a crash mid-write must not leave a torn artifact for
+            # the next process to trip over
+            tmp = f"{fpath}.tmp.{os.getpid()}"
+            with open(tmp, "wb") as f:
                 f.write(data)
+            os.replace(tmp, fpath)
             card.artifacts[fname] = fpath
         card.model_path = cache_dir
         return card

@@ -44,7 +44,11 @@ class DistributedRuntime:
     ) -> "DistributedRuntime":
         self = cls(runtime or Runtime())
         self.hub = await HubClient.connect(hub_addr)
-        self.primary_lease = await self.hub.lease_grant(ttl=lease_ttl)
+        # threaded keepalive: a jit compile blocking this loop for longer
+        # than the TTL must not kill the worker's liveness
+        self.primary_lease = await self.hub.lease_grant(
+            ttl=lease_ttl, keepalive="thread"
+        )
         log.info(
             "distributed runtime up: hub=%s primary_lease=%#x",
             self.hub.addr,

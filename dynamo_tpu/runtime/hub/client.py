@@ -29,12 +29,110 @@ class HubError(RuntimeError):
     pass
 
 
+class KeepaliveThread:
+    """Secondary runtime for lease liveness.
+
+    The reference runs etcd/NATS background tasks on a second tokio runtime
+    precisely so foreground work cannot starve them (reference:
+    lib/runtime/src/runtime.rs:39-121 RuntimeType::secondary). The asyncio
+    equivalent failure is real: a jit compile (20-40 s on TPU) blocks the
+    main loop longer than the lease TTL and the hub declares the worker
+    dead. Keepalives therefore run on a dedicated daemon thread with its
+    own event loop and its own hub connection (leases are hub-global, so a
+    second connection may refresh them).
+    """
+
+    def __init__(self, addr: str):
+        import threading
+
+        self.addr = addr
+        self._leases: dict[int, float] = {}  # lease_id -> ttl
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._ready = threading.Event()
+        self._error: Optional[BaseException] = None
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="hub-keepalive"
+        )
+        self._thread.start()
+        if not self._ready.wait(10) or self._error is not None:
+            raise ConnectionError(
+                f"keepalive thread failed to connect to hub {addr}: {self._error}"
+            )
+
+    def add(self, lease_id: int, ttl: float) -> None:
+        with self._lock:
+            self._leases[lease_id] = ttl
+
+    def remove(self, lease_id: int) -> None:
+        with self._lock:
+            self._leases.pop(lease_id, None)
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _run(self) -> None:
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        try:
+            loop.run_until_complete(self._main())
+        finally:
+            loop.close()
+
+    async def _main(self) -> None:
+        try:
+            client = await HubClient.connect(self.addr)
+        except BaseException as e:  # noqa: BLE001 — surfaced to the ctor
+            self._error = e
+            self._ready.set()
+            return
+        self._ready.set()
+        try:
+            while not self._stop.is_set():
+                with self._lock:
+                    leases = dict(self._leases)
+                tick = min([ttl / 3.0 for ttl in leases.values()] or [1.0])
+                for lease_id in leases:
+                    try:
+                        ok = await client.request(
+                            "lease_keepalive", lease_id=lease_id
+                        )
+                        if not ok:
+                            log.warning("lease %#x no longer valid", lease_id)
+                            self.remove(lease_id)
+                    except HubError:
+                        log.warning("keepalive for %#x rejected", lease_id)
+                    except (ConnectionError, OSError):
+                        # the keepalive connection died while the worker is
+                        # healthy: reconnect or the lease expires spuriously
+                        await client.close()
+                        client = await self._reconnect()
+                        break
+                await asyncio.sleep(tick)
+        finally:
+            await client.close()
+
+    async def _reconnect(self) -> "HubClient":
+        delay = 0.2
+        while not self._stop.is_set():
+            try:
+                client = await HubClient.connect(self.addr)
+                log.info("keepalive connection re-established to %s", self.addr)
+                return client
+            except (ConnectionError, OSError):
+                await asyncio.sleep(delay)
+                delay = min(delay * 2, 2.0)
+        raise ConnectionError("keepalive thread stopped during reconnect")
+
+
 class Lease:
     """A granted lease with background keepalive.
 
-    Keepalives are sent at ttl/3; `revoke()` (or hub-side expiry after the
-    process dies) deletes every key attached to the lease — this is the
-    liveness primitive for service discovery (reference:
+    Keepalives are sent at ttl/3 — either as a task on the caller's loop or
+    (preferred for workers doing device work) on the client's shared
+    `KeepaliveThread`; `revoke()` (or hub-side expiry after the process
+    dies) deletes every key attached to the lease — this is the liveness
+    primitive for service discovery (reference:
     lib/runtime/src/transports/etcd.rs lease keep-alive; lease.rs).
     """
 
@@ -43,11 +141,18 @@ class Lease:
         self.lease_id = lease_id
         self.ttl = ttl
         self._task: Optional[asyncio.Task] = None
+        self._threaded = False
         self._revoked = False
 
     def start_keepalive(self) -> None:
         if self._task is None:
             self._task = asyncio.create_task(self._keepalive_loop())
+
+    def start_keepalive_threaded(self) -> None:
+        """Refresh this lease from the client's keepalive thread, immune to
+        event-loop stalls (jit compiles, device syncs)."""
+        self.client.keepalive_thread().add(self.lease_id, self.ttl)
+        self._threaded = True
 
     async def _keepalive_loop(self) -> None:
         try:
@@ -72,6 +177,10 @@ class Lease:
         if self._task:
             self._task.cancel()
             self._task = None
+        if self._threaded and self.client._keepalive_thread is not None:
+            # existing thread only: after close() the lazy getter would spawn
+            # a fresh thread+connection just to forget a dead lease
+            self.client._keepalive_thread.remove(self.lease_id)
         try:
             await self.client.request("lease_revoke", lease_id=self.lease_id)
         except (ConnectionError, HubError):
@@ -158,6 +267,7 @@ class HubClient:
         self._pushes: dict[int, asyncio.Queue] = {}
         self._recv_task: Optional[asyncio.Task] = None
         self._closed = False
+        self._keepalive_thread: Optional[KeepaliveThread] = None
         self.addr = ""
 
     # ------------------------------------------------------------- lifecycle
@@ -171,8 +281,17 @@ class HubClient:
         self._recv_task = asyncio.create_task(self._recv_loop())
         return self
 
+    def keepalive_thread(self) -> KeepaliveThread:
+        """Shared secondary-runtime keepalive (created on first use)."""
+        if self._keepalive_thread is None:
+            self._keepalive_thread = KeepaliveThread(self.addr)
+        return self._keepalive_thread
+
     async def close(self) -> None:
         self._closed = True
+        if self._keepalive_thread is not None:
+            self._keepalive_thread.stop()
+            self._keepalive_thread = None
         if self._recv_task:
             self._recv_task.cancel()
             self._recv_task = None
@@ -263,10 +382,17 @@ class HubClient:
 
     # ---------------------------------------------------------------- leases
 
-    async def lease_grant(self, ttl: float = 10.0, keepalive: bool = True) -> Lease:
+    async def lease_grant(
+        self, ttl: float = 10.0, keepalive: bool | str = True
+    ) -> Lease:
+        """keepalive: True = task on this loop; "thread" = secondary
+        keepalive runtime (survives event-loop stalls from jit compiles);
+        False = caller manages."""
         r = await self.request("lease_grant", ttl=ttl)
         lease = Lease(self, r["lease_id"], r["ttl"])
-        if keepalive:
+        if keepalive == "thread":
+            lease.start_keepalive_threaded()
+        elif keepalive:
             lease.start_keepalive()
         return lease
 

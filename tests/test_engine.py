@@ -217,3 +217,14 @@ async def test_full_pipeline_http_shape():
     ]
     assert any(f in ("length", "stop") for f in finishes)
     await engine.close()
+
+
+async def test_prompt_exceeding_kv_pool_rejected():
+    """A prompt that could never be paged must be rejected, not hang."""
+    engine = make_engine(num_pages=8, max_model_len=2000)
+    try:
+        await engine.generate(Context(greedy_request(list(range(2, 80))).to_dict()))
+        raise AssertionError("expected ValueError")
+    except ValueError as e:
+        assert "KV pool" in str(e)
+    await engine.close()

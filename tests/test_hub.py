@@ -213,3 +213,47 @@ async def test_concurrent_clients_many_ops():
             assert len(await c.kv_get_prefix("/load/")) == 160
         finally:
             await c.close()
+
+
+async def test_threaded_keepalive_survives_loop_stall():
+    """A worker blocking its event loop longer than the lease TTL (e.g. a
+    jit compile) must not lose its lease: keepalives run on the secondary
+    keepalive thread (reference: secondary tokio runtime, runtime.rs).
+    The hub runs as a separate process so only the client loop stalls."""
+    import socket
+    import subprocess
+    import sys
+    import time
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "dynamo_tpu.runtime.hub",
+         "--host", "127.0.0.1", "--port", str(port)],
+    )
+    try:
+        client = None
+        for _ in range(50):
+            try:
+                client = await HubClient.connect(f"127.0.0.1:{port}")
+                break
+            except OSError:
+                await asyncio.sleep(0.1)
+        assert client is not None, "hub subprocess did not come up"
+        try:
+            lease = await client.lease_grant(ttl=1.0, keepalive="thread")
+            await client.kv_put("/stall/key", b"x", lease=lease)
+            time.sleep(3.0)  # synchronous stall >> ttl
+            assert await lease.is_valid()
+            assert await client.kv_get("/stall/key") is not None
+
+            # in-loop keepalive for contrast: the same stall kills it
+            lease2 = await client.lease_grant(ttl=1.0, keepalive=True)
+            time.sleep(3.0)
+            assert not await lease2.is_valid()
+        finally:
+            await client.close()
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
