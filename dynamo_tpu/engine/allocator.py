@@ -109,6 +109,18 @@ class PageAllocator:
             pages.append(pid)
         return pages
 
+    def peek_prefix_tokens(self, token_ids: list[int]) -> int:
+        """Non-destructive longest-cached-prefix length in tokens (no
+        refcounts taken) — the disagg decision input."""
+        from dynamo_tpu.llm.tokens import compute_block_hashes
+
+        n = 0
+        for h in compute_block_hashes(token_ids, self.page_size):
+            if h not in self._by_hash:
+                break
+            n += 1
+        return n * self.page_size
+
     # ---- allocation ---------------------------------------------------
 
     def allocate(self, n: int) -> Optional[list[int]]:

@@ -36,6 +36,7 @@ from dynamo_tpu.engine.config import EngineConfig
 from dynamo_tpu.engine.scheduler import Sequence
 from dynamo_tpu.llm.protocols.common import (
     FINISH_REASON_CANCELLED,
+    FINISH_REASON_ERROR,
     FINISH_REASON_LENGTH,
     EngineOutput,
     PreprocessedRequest,
@@ -107,6 +108,16 @@ class JaxEngine:
 
         # one jitted step; jax retraces per (B, T, C) shape family
         self._step_fn = jax.jit(self._model_step, donate_argnums=(1,))
+        # disagg KV transfer: in-place scatter of received blocks / gather
+        # of computed blocks (reference: the NIXL read/write data plane,
+        # patch nixl.py — here device<->host staged, see llm/disagg)
+        self._inject_fn = jax.jit(
+            lambda kv, slots, nk, nv: llama.KVCache(
+                k=kv.k.at[:, slots].set(nk), v=kv.v.at[:, slots].set(nv)
+            ),
+            donate_argnums=(0,),
+        )
+        self._extract_fn = jax.jit(lambda kv, slots: (kv.k[:, slots], kv.v[:, slots]))
 
     # ------------------------------------------------------------------
     # sizing
@@ -177,7 +188,9 @@ class JaxEngine:
     # ------------------------------------------------------------------
     # engine protocol
 
-    async def generate(self, request: Context) -> AsyncIterator[dict]:
+    async def generate(
+        self, request: Context, _preloaded: Optional[tuple] = None
+    ) -> AsyncIterator[dict]:
         payload = request.payload
         pre = (
             PreprocessedRequest.from_dict(payload)
@@ -202,6 +215,7 @@ class JaxEngine:
         seq = Sequence.from_request(
             request, pre, self.page_size, self.config.max_model_len
         )
+        seq.preloaded = _preloaded
         self.waiting.append(seq)
         self._ensure_loop()
         self._wake.set()
@@ -214,6 +228,95 @@ class JaxEngine:
                     return
 
         return _gen()
+
+    async def generate_remote(
+        self,
+        request: Context,
+        first_token: int,
+        k_arr: np.ndarray,
+        v_arr: np.ndarray,
+    ) -> AsyncIterator[dict]:
+        """Decode-side disagg entry: like generate(), but the prompt's KV
+        (computed by a remote prefill worker) is injected instead of
+        computed, and `first_token` (sampled remotely) seeds decode."""
+        payload = request.payload
+        pre = (
+            PreprocessedRequest.from_dict(payload)
+            if isinstance(payload, dict)
+            else payload
+        )
+        m = self.model_cfg
+        want = (m.num_layers, len(pre.token_ids), m.num_kv_heads, m.head_dim)
+        for name, arr in (("k", k_arr), ("v", v_arr)):
+            if tuple(arr.shape) != want:
+                raise ValueError(
+                    f"remote {name} KV shape {tuple(arr.shape)} != expected {want}"
+                )
+        preloaded = (int(first_token), k_arr, v_arr)
+        return await self.generate(request, _preloaded=preloaded)
+
+    async def prefill_only(
+        self, pre: PreprocessedRequest, ctx: Optional[Context] = None
+    ) -> tuple[int, np.ndarray, np.ndarray]:
+        """Prefill-side disagg entry: compute the prompt's KV (+ first
+        token), extract it host-side, and keep the pages in the prefix
+        cache for future hits. Returns (first_token, k, v) with k/v shaped
+        [L, T, Kh, Hd]."""
+        ctx = ctx or Context(pre.to_dict())
+        usable_tokens = (self.num_pages - 1) * self.page_size
+        if len(pre.token_ids) + 1 > usable_tokens:
+            raise ValueError(
+                f"prompt of {len(pre.token_ids)} tokens cannot fit the KV pool "
+                f"({self.num_pages - 1} pages x {self.page_size} tokens)"
+            )
+        seq = Sequence.from_request(
+            ctx, pre, self.page_size, self.config.max_model_len
+        )
+        deadline = asyncio.get_running_loop().time() + 60.0
+        while not self._reserve_pages(seq):
+            if asyncio.get_running_loop().time() > deadline:
+                raise RuntimeError("prefill worker out of KV pages")
+            await asyncio.sleep(0.05)
+        try:
+            first_token = await self._prefill_forward(seq)
+            t = seq.num_computed
+            slots = np.asarray(
+                [self._write_slot(seq, p) for p in range(t)], np.int32
+            )
+            k, v = self._extract_fn(self.kv, jnp.asarray(slots))
+            k_host, v_host = await asyncio.to_thread(
+                lambda: (np.asarray(k), np.asarray(v))
+            )
+            return first_token, k_host, v_host
+        finally:
+            self.allocator.release(seq.page_ids)
+
+    async def _inject_preloaded(self, seq: Sequence) -> int:
+        """Scatter remotely-computed KV into the sequence's pages; returns
+        the remotely-sampled first token. Chunked by prefill buckets so the
+        jit shape family stays bounded."""
+        first_token, k_arr, v_arr = seq.preloaded
+        t = seq.total_tokens
+        start = seq.num_computed  # locally-cached prefix needs no injection
+        while start < t:
+            chunk = min(t - start, self.config.prefill_chunk)
+            bucket = self._bucket_for(chunk)
+            slots = np.zeros(bucket, np.int32)  # pad -> trash slot 0
+            for i in range(chunk):
+                slots[i] = self._write_slot(seq, start + i)
+            nk = np.zeros((k_arr.shape[0], bucket, *k_arr.shape[2:]), k_arr.dtype)
+            nv = np.zeros_like(nk)
+            nk[:, :chunk] = k_arr[:, start : start + chunk]
+            nv[:, :chunk] = v_arr[:, start : start + chunk]
+            self.kv = self._inject_fn(
+                self.kv, jnp.asarray(slots), jnp.asarray(nk), jnp.asarray(nv)
+            )
+            start += chunk
+            await asyncio.sleep(0)
+        seq.num_computed = t
+        self._register_full_pages(seq)
+        seq.preloaded = None
+        return first_token
 
     def _ensure_loop(self) -> None:
         if self._loop_task is None or self._loop_task.done():
@@ -290,7 +393,13 @@ class JaxEngine:
             self.waiting.popleft()
             seq.slot = slot
             self.slots[slot] = seq
-            await self._run_prefill(seq)
+            try:
+                await self._run_prefill(seq)
+            except Exception:
+                # contain per-sequence failures (e.g. a malformed remote KV
+                # payload): fail this request, keep the loop and batch alive
+                log.exception("prefill of seq %s failed", seq.seq_id)
+                self._finish(seq, FINISH_REASON_ERROR)
             progressed = True
         return progressed
 
@@ -334,14 +443,26 @@ class JaxEngine:
     async def _run_prefill(self, seq: Sequence) -> None:
         """Compute KV for tokens [num_computed, T), sample the next token
         from position T-1, emit it. Chunked for long prompts."""
-        tokens = seq.tokens
-        t = len(tokens)
-        smat = self._slot_matrix_row(seq)[None]
         first_meta = {
             "prefix_cached_tokens": seq.num_cached,
             "prompt_tokens": seq.prompt_len,
         }
-        sampled: Optional[int] = None
+        if seq.preloaded is not None:
+            # remote-prefilled (disagg): KV arrives instead of being computed
+            first_token = await self._inject_preloaded(seq)
+            first_meta["remote_prefill"] = True
+            self._append_token(seq, first_token, extra_meta=first_meta)
+            return
+        tok = await self._prefill_forward(seq)
+        self._append_token(seq, tok, extra_meta=first_meta)
+
+    async def _prefill_forward(self, seq: Sequence) -> int:
+        """Chunked prefill compute only: writes KV, returns the token
+        sampled at the final position (no emission/bookkeeping)."""
+        tokens = seq.tokens
+        t = len(tokens)
+        smat = self._slot_matrix_row(seq)[None]
+        sampled: Optional[jax.Array] = None
         while seq.num_computed < t:
             start = seq.num_computed
             chunk = min(t - start, self.config.prefill_chunk)
@@ -368,7 +489,7 @@ class JaxEngine:
             sampled = toks
             await asyncio.sleep(0)  # let other tasks breathe between chunks
         out = await asyncio.to_thread(np.asarray, sampled)
-        self._append_token(seq, int(out[0]), extra_meta=first_meta)
+        return int(out[0])
 
     # ---- decode -------------------------------------------------------
 

@@ -49,6 +49,9 @@ class Sequence:
     slot: int = -1
     generated: int = 0
     finish: Optional[str] = None
+    # disagg: (first_token, k [L,T,Kh,Hd], v) delivered by a remote prefill
+    # worker — admission injects this into pages instead of computing it
+    preloaded: Optional[tuple] = None
 
     # per-request sampling (resolved once at admission)
     temperature: float = 0.0

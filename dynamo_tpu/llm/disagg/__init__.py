@@ -1,0 +1,359 @@
+"""Disaggregated prefill/decode serving.
+
+TPU-native re-imagining of the reference's xPyD disaggregation (reference:
+docs/disagg_serving.md:14-117, examples/llm/components/{worker,prefill_worker}.py,
+vLLM patch remote_prefill.py + nixl.py):
+
+- the **decode worker** makes the local-vs-remote decision per request
+  (DisaggRouter threshold, live-reconfigurable) and enqueues a
+  RemotePrefillRequest on the hub prefill queue (JetStream equivalent);
+- any **prefill worker** competes on the queue, computes the prompt's KV +
+  first token (riding its own prefix cache), and streams the KV back to the
+  requesting decode worker's `disagg_ingest` endpoint in layer-group parts
+  (bounded frames; the NIXL-RDMA-write equivalent — on TPU there is no
+  one-sided RDMA between processes, so transfers are host-staged over the
+  data plane; a same-slice ICI path can slot in behind the same interface);
+- the decode worker injects the KV into its own pages (in-place jit
+  scatter) and the sequence joins the decode batch directly.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, AsyncIterator, Optional
+
+import msgpack
+import numpy as np
+
+from dynamo_tpu.llm.protocols.common import PreprocessedRequest
+from dynamo_tpu.runtime.pipeline.context import Context
+from dynamo_tpu.utils.logging import get_logger
+
+log = get_logger("dynamo_tpu.disagg")
+
+PREFILL_QUEUE_PREFIX = "prefill_queue."
+DISAGG_CONF_ROOT = "/public/components/disagg_router/models/"
+INGEST_ENDPOINT = "disagg_ingest"
+LAYERS_PER_PART = 8
+
+
+def _np_to_wire(arr: np.ndarray) -> dict:
+    return {
+        "dtype": str(arr.dtype),
+        "shape": list(arr.shape),
+        "data": arr.tobytes(),
+    }
+
+
+def _np_from_wire(d: dict) -> np.ndarray:
+    import ml_dtypes  # noqa: F401 — registers bfloat16 with numpy
+
+    dtype = np.dtype(d["dtype"]) if d["dtype"] != "bfloat16" else ml_dtypes.bfloat16
+    return np.frombuffer(d["data"], dtype=dtype).reshape(d["shape"])
+
+
+@dataclass
+class RemotePrefillRequest:
+    """reference: vLLM patch remote_prefill.py RemotePrefillRequest."""
+
+    request_id: str
+    pre: dict  # PreprocessedRequest.to_dict()
+    decode_address: str  # data-plane address of the decode worker
+    ingest_subject: str  # subject of its disagg_ingest endpoint
+
+    def pack(self) -> bytes:
+        return msgpack.packb(self.__dict__, use_bin_type=True)
+
+    @classmethod
+    def unpack(cls, raw: bytes) -> "RemotePrefillRequest":
+        return cls(**msgpack.unpackb(raw, raw=False))
+
+
+class PrefillQueue:
+    """Competing-consumer prefill queue over the hub (reference:
+    examples/llm/utils/nats_queue.py PrefillQueue on JetStream)."""
+
+    def __init__(self, hub, namespace: str, component: str):
+        self.hub = hub
+        self.name = f"{PREFILL_QUEUE_PREFIX}{namespace}.{component}"
+
+    async def push(self, req: RemotePrefillRequest) -> int:
+        return await self.hub.q_push(self.name, req.pack())
+
+    async def pop(self, timeout: Optional[float] = None) -> Optional[RemotePrefillRequest]:
+        raw = await self.hub.q_pop(self.name, block=True, timeout=timeout)
+        return RemotePrefillRequest.unpack(raw) if raw is not None else None
+
+    async def size(self) -> int:
+        return await self.hub.q_len(self.name)
+
+
+@dataclass
+class DisaggConfig:
+    """Live-tunable decision thresholds (reference: disagg_router.rs:24-35,
+    ConditionalDisagg{max_local_prefill_length, max_prefill_queue_size})."""
+
+    max_local_prefill_length: int = 128
+    max_prefill_queue_size: int = 16
+
+    def to_json(self) -> bytes:
+        import json
+
+        return json.dumps(self.__dict__).encode()
+
+    @classmethod
+    def from_json(cls, raw: bytes) -> "DisaggConfig":
+        import json
+
+        d = json.loads(raw)
+        return cls(**{k: d[k] for k in cls.__dataclass_fields__ if k in d})
+
+
+class DisaggRouter:
+    """Per-request local-vs-remote decision with hub-watched reconfig
+    (reference: disagg_router.rs:146-262; decision :232-245)."""
+
+    def __init__(self, drt=None, model: str = "default",
+                 config: Optional[DisaggConfig] = None):
+        self._drt = drt
+        self.model = model
+        self.config = config or DisaggConfig()
+        self._watch = None
+        self._task: Optional[asyncio.Task] = None
+
+    @property
+    def conf_key(self) -> str:
+        return f"{DISAGG_CONF_ROOT}{self.model}"
+
+    async def start(self) -> "DisaggRouter":
+        """Watch the hub key for live threshold updates."""
+        if self._drt is None:
+            return self
+        self._watch = await self._drt.hub.watch_prefix(self.conf_key)
+        for item in self._watch.snapshot:
+            self._apply(item["value"])
+        self._task = asyncio.create_task(self._pump())
+        return self
+
+    def _apply(self, raw: bytes) -> None:
+        try:
+            self.config = DisaggConfig.from_json(raw)
+            log.info("disagg thresholds updated: %s", self.config)
+        except Exception:  # noqa: BLE001
+            log.exception("bad disagg config ignored")
+
+    async def _pump(self) -> None:
+        async for ev in self._watch:
+            if ev["type"] == "put":
+                self._apply(ev["value"])
+
+    def prefill_remote(
+        self, prefill_len: int, prefix_hit_len: int, queue_size: int = 0
+    ) -> bool:
+        """(len - prefix_hit) > max_local AND the queue isn't drowning
+        (reference: disagg_router.rs:232-245)."""
+        return (
+            prefill_len - prefix_hit_len > self.config.max_local_prefill_length
+            and queue_size <= self.config.max_prefill_queue_size
+        )
+
+    async def close(self) -> None:
+        if self._task:
+            self._task.cancel()
+        if self._watch:
+            await self._watch.cancel()
+
+
+class PrefillHandler:
+    """Prefill-worker loop: pull from the queue, compute KV + first token,
+    stream the result to the decode worker (reference:
+    examples/llm/components/prefill_worker.py:118-183)."""
+
+    def __init__(self, drt, engine, namespace: str, component: str):
+        self.drt = drt
+        self.engine = engine
+        self.queue = PrefillQueue(drt.hub, namespace, component)
+        self._task: Optional[asyncio.Task] = None
+        self._stopping = False
+
+    def start(self) -> "PrefillHandler":
+        self._task = asyncio.get_running_loop().create_task(self._loop())
+        return self
+
+    async def _loop(self) -> None:
+        while not self._stopping:
+            # lease-validity gate between pulls (drain semantics on
+            # scale-down, reference: prefill_worker.py:145-160)
+            if not await self.drt.primary_lease.is_valid():
+                log.info("lease revoked; prefill handler draining")
+                return
+            try:
+                req = await self.queue.pop(timeout=1.0)
+            except Exception:  # noqa: BLE001 — hub hiccup: back off, retry
+                if self._stopping:
+                    return
+                await asyncio.sleep(0.2)
+                continue
+            if req is None:
+                continue
+            try:
+                await self._handle(req)
+            except Exception:  # noqa: BLE001
+                log.exception("remote prefill of %s failed", req.request_id)
+
+    async def _handle(self, req: RemotePrefillRequest) -> None:
+        pre = PreprocessedRequest.from_dict(req.pre)
+        first_token, k, v = await self.engine.prefill_only(pre)
+        num_layers = k.shape[0]
+        parts = [
+            (i, min(i + LAYERS_PER_PART, num_layers))
+            for i in range(0, num_layers, LAYERS_PER_PART)
+        ]
+        for idx, (lo, hi) in enumerate(parts):
+            payload = {
+                "request_id": req.request_id,
+                "part": idx,
+                "total_parts": len(parts),
+                "layer_lo": lo,
+                "first_token": int(first_token),
+                "k": _np_to_wire(k[lo:hi]),
+                "v": _np_to_wire(v[lo:hi]),
+            }
+            handle = await self.drt.data_plane_client.request(
+                req.decode_address,
+                req.ingest_subject,
+                msgpack.packb(payload, use_bin_type=True),
+            )
+            accepted = True
+            async for ack in handle:
+                accepted = msgpack.unpackb(ack, raw=False).get("ok", False)
+            if not accepted:
+                # decode side gave up (timeout/cancel): stop shipping parts
+                log.info("decode rejected KV for %s; aborting send", req.request_id)
+                return
+
+    async def stop(self) -> None:
+        self._stopping = True
+        if self._task:
+            self._task.cancel()
+
+
+class _PendingTransfer:
+    def __init__(self, total_parts: Optional[int] = None):
+        self.parts: dict[int, tuple[np.ndarray, np.ndarray]] = {}
+        self.total: Optional[int] = total_parts
+        self.first_token: Optional[int] = None
+        self.ready = asyncio.Event()
+
+
+class DisaggDecodeWorker:
+    """Decode-side orchestrator: an engine wrapper making the disagg
+    decision per request (reference: examples/llm/components/worker.py:180-229).
+
+    Serve this as the component's `generate` engine; call `attach()` once
+    to register the ingest endpoint on the same component.
+    """
+
+    def __init__(self, drt, engine, namespace: str, component: str,
+                 router: Optional[DisaggRouter] = None):
+        self.drt = drt
+        self.engine = engine
+        self.namespace = namespace
+        self.component = component
+        self.router = router or DisaggRouter()
+        self.queue = PrefillQueue(drt.hub, namespace, component)
+        self._pending: dict[str, _PendingTransfer] = {}
+        self._ingest_subject = f"{namespace}.{component}.{INGEST_ENDPOINT}"
+        # remote-prefill stats for planner/metrics
+        self.remote_prefills = 0
+        self.local_prefills = 0
+
+    async def attach(self) -> "DisaggDecodeWorker":
+        """Register the KV ingest endpoint (raw handler, same component)."""
+        await self.drt.ensure_data_plane()
+        self.drt.data_plane.register(self._ingest_subject, self._ingest)
+        await self.router.start()
+        return self
+
+    async def _ingest(self, ctx: Context) -> AsyncIterator[bytes]:
+        d = msgpack.unpackb(ctx.payload, raw=False)
+        rid = d["request_id"]
+        pending = self._pending.get(rid)
+        ok = pending is not None
+        if ok:
+            # only requests this worker is actively awaiting: late parts
+            # (post-timeout) or stray deliveries must not allocate anything
+            pending.total = d["total_parts"]
+            pending.first_token = d["first_token"]
+            pending.parts[d["part"]] = (
+                _np_from_wire(d["k"]), _np_from_wire(d["v"])
+            )
+            if len(pending.parts) == pending.total:
+                pending.ready.set()
+        else:
+            log.debug("dropping KV part for unknown request %s", rid)
+
+        async def _ack() -> AsyncIterator[bytes]:
+            yield msgpack.packb({"ok": ok})
+
+        return _ack()
+
+    async def generate(self, request: Context) -> AsyncIterator[dict]:
+        payload = request.payload
+        pre = (
+            PreprocessedRequest.from_dict(payload)
+            if isinstance(payload, dict)
+            else payload
+        )
+        decision = False
+        if not pre.disagg.get("force_local"):
+            prefix_hit = self.engine.allocator.peek_prefix_tokens(pre.token_ids)
+            # length test first: only remote-eligible requests pay the hub
+            # RTT for the queue-depth check
+            if self.router.prefill_remote(len(pre.token_ids), prefix_hit, 0):
+                try:
+                    qsize = await self.queue.size()
+                except Exception:  # noqa: BLE001
+                    qsize = 0
+                decision = self.router.prefill_remote(
+                    len(pre.token_ids), prefix_hit, qsize
+                )
+        if not decision:
+            self.local_prefills += 1
+            return await self.engine.generate(request.map(pre.to_dict()))
+        return await self._generate_remote(request, pre)
+
+    async def _generate_remote(
+        self, request: Context, pre: PreprocessedRequest
+    ) -> AsyncIterator[dict]:
+        self.remote_prefills += 1
+        rid = f"{request.id}-{uuid.uuid4().hex[:8]}"
+        pending = self._pending[rid] = _PendingTransfer()
+        req = RemotePrefillRequest(
+            request_id=rid,
+            pre=pre.to_dict(),
+            decode_address=self.drt.data_plane.address,
+            ingest_subject=self._ingest_subject,
+        )
+        await self.queue.push(req)
+        try:
+            await asyncio.wait_for(pending.ready.wait(), timeout=120.0)
+        except asyncio.TimeoutError:
+            self._pending.pop(rid, None)
+            log.warning("remote prefill %s timed out; falling back local", rid)
+            return await self.engine.generate(request.map(pre.to_dict()))
+        finally:
+            self._pending.pop(rid, None)
+        k = np.concatenate([pending.parts[i][0] for i in range(pending.total)])
+        v = np.concatenate([pending.parts[i][1] for i in range(pending.total)])
+        return await self.engine.generate_remote(
+            request.map(pre.to_dict()), pending.first_token, k, v
+        )
+
+    def stats(self) -> dict[str, Any]:
+        return {
+            "remote_prefills": self.remote_prefills,
+            "local_prefills": self.local_prefills,
+        }

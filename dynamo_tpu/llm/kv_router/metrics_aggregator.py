@@ -48,17 +48,27 @@ class KvMetricsAggregator:
         self._task = asyncio.create_task(self._poll())
 
     async def _poll(self) -> None:
+        import logging
+
         while True:
             await asyncio.sleep(self.poll_interval)
-            await self._scrape_once()
+            try:
+                await self._scrape_once()
+            except Exception:  # noqa: BLE001 — one bad scrape must not
+                # freeze routing metrics forever
+                logging.getLogger("dynamo_tpu.kv_router").exception(
+                    "metrics scrape failed; keeping last snapshot"
+                )
 
     async def _scrape_once(self) -> None:
         stats = await self.client.scrape_stats()
-        self.current = ProcessedEndpoints(
-            endpoints={
-                wid: ForwardPassMetrics.from_dict(s) for wid, s in stats.items()
-            }
-        )
+        endpoints = {}
+        for wid, s in stats.items():
+            try:
+                endpoints[wid] = ForwardPassMetrics.from_dict(s)
+            except Exception:  # noqa: BLE001 — skip one worker's bad stats
+                continue
+        self.current = ProcessedEndpoints(endpoints=endpoints)
 
     def endpoints_for(self, worker_ids: list[int]) -> dict[int, ForwardPassMetrics]:
         """Metrics for the given live workers; workers missing from the last

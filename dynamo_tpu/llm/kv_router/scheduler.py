@@ -110,5 +110,7 @@ class KvScheduler:
             task = asyncio.create_task(
                 self.component.publish(KV_HIT_RATE_SUBJECT, msgpack.packb(ev.to_dict()))
             )
-            task.add_done_callback(lambda t: t.exception())
+            task.add_done_callback(
+                lambda t: None if t.cancelled() else t.exception()
+            )
         return decision

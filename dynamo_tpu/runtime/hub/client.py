@@ -102,13 +102,17 @@ class KeepaliveThread:
                             self.remove(lease_id)
                     except HubError:
                         log.warning("keepalive for %#x rejected", lease_id)
-                    except (ConnectionError, OSError):
-                        # the keepalive connection died while the worker is
-                        # healthy: reconnect or the lease expires spuriously
+                    except Exception:  # noqa: BLE001 — ANY transport-level
+                        # failure must reconnect, never kill this thread:
+                        # dead keepalives silently expire healthy workers
+                        log.exception("keepalive connection failed; reconnecting")
                         await client.close()
                         client = await self._reconnect()
                         break
                 await asyncio.sleep(tick)
+        except BaseException:
+            log.exception("keepalive thread died — worker leases WILL expire")
+            raise
         finally:
             await client.close()
 
