@@ -28,6 +28,7 @@ _8B_PARAMS = 8.03e9
 ISL = int(os.environ.get("BENCH_ISL", "512"))
 OSL = int(os.environ.get("BENCH_OSL", "64"))
 CONCURRENCY = int(os.environ.get("BENCH_CONCURRENCY", "8"))
+DECODE_STEPS = int(os.environ.get("BENCH_DECODE_STEPS", "16"))
 
 
 def main() -> None:
@@ -55,6 +56,7 @@ def main() -> None:
             max_batch_size=CONCURRENCY,
             max_model_len=ISL + OSL + 32,
             prefill_chunk=ISL,
+            decode_steps=DECODE_STEPS,
         )
     )
     n_params = llama.param_count(engine.params)

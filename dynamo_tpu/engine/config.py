@@ -23,6 +23,9 @@ class EngineConfig:
     max_batch_size: int = 8       # decode slots
     max_model_len: int = 2048     # context limit per sequence
     prefill_chunk: int = 512      # longest single prefill call (longer prompts chunk)
+    decode_steps: int = 8         # decode steps per jit dispatch (lax.scan):
+    # amortizes host<->device round trips; finished sequences overshoot at
+    # most decode_steps-1 positions (discarded host-side)
     seed: int = 0
 
     def model_config(self) -> ModelConfig:

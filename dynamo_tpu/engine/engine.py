@@ -108,6 +108,8 @@ class JaxEngine:
 
         # one jitted step; jax retraces per (B, T, C) shape family
         self._step_fn = jax.jit(self._model_step, donate_argnums=(1,))
+        # multi-step decode: `decode_steps` iterations per dispatch
+        self._decode_fn = jax.jit(self._decode_multi, donate_argnums=(1,))
         # disagg KV transfer: in-place scatter of received blocks / gather
         # of computed blocks (reference: the NIXL read/write data plane,
         # patch nixl.py — here device<->host staged, see llm/disagg)
@@ -493,63 +495,104 @@ class JaxEngine:
 
     # ---- decode -------------------------------------------------------
 
+    def _decode_multi(self, params, kv, tokens, positions, block_tables,
+                      temp, topk, topp, key):
+        """`decode_steps` decode iterations in ONE dispatch (lax.scan with
+        on-device token feedback + slot computation) — the antidote to
+        per-token host round trips, which dominate wall clock when the
+        device is remote or fast. Returns sampled tokens [K, B]."""
+        s = self.page_size
+        b, w = block_tables.shape
+        smat = (
+            block_tables[:, :, None] * s + jnp.arange(s, dtype=jnp.int32)
+        ).reshape(b, -1)
+
+        def body(carry, _):
+            tokens, positions, kv, key = carry
+            key, sub = jax.random.split(key)
+            page_idx = jnp.minimum(positions // s, w - 1)
+            wslots = (
+                jnp.take_along_axis(block_tables, page_idx[:, None], axis=1)[:, 0] * s
+                + positions % s
+            )
+            # past a finished sequence's budget the scan keeps running: those
+            # writes must land in the trash page, never a valid slot
+            wslots = jnp.where(
+                positions < self.config.max_model_len, wslots, 0
+            ).astype(jnp.int32)
+            hidden, kv = llama.forward(
+                params, self.model_cfg, tokens[:, None], positions[:, None],
+                kv, wslots, smat,
+            )
+            lg = llama.logits(params, self.model_cfg, hidden[:, 0])
+            toks = sample_tokens(lg, sub, temp, topk, topp)
+            return (toks, positions + 1, kv, key), toks
+
+        (_, _, kv, _), out = jax.lax.scan(
+            body, (tokens, positions, kv, key), None,
+            length=self.config.decode_steps,
+        )
+        return out, kv
+
     async def _decode_once(self) -> None:
         b = len(self.slots)
-        # ensure every active sequence has a page for its next position
+        k_steps = self.config.decode_steps
+        # ensure every active sequence has pages for all positions this
+        # dispatch will write: [p, p + k_steps)
         for seq in [s for s in self.slots if s is not None]:
             if seq.slot < 0 or self.slots[seq.slot] is not seq:
                 continue  # preempted by an earlier victim pick this pass
             if seq.ctx.is_stopped():
                 self._finish(seq, FINISH_REASON_CANCELLED)
                 continue
-            if not self._ensure_page(seq):
+            upto = min(
+                seq.num_computed + k_steps - 1, self.config.max_model_len - 1
+            )
+            if not self._ensure_pages_through(seq, upto):
                 return  # seq itself was preempted; retry next loop
         active = [(i, s) for i, s in enumerate(self.slots) if s is not None]
         if not active:
             return
 
+        w = self.config.max_pages_per_seq
         tokens = np.zeros(b, np.int32)
         positions = np.zeros(b, np.int32)
-        wslots = np.zeros(b, np.int32)
-        smat = np.zeros((b, self._smat_width), np.int32)
+        tables = np.zeros((b, w), np.int32)
         temp = np.zeros(b, np.float32)
         topk = np.zeros(b, np.int32)
         topp = np.ones(b, np.float32)
         for i, seq in active:
-            p = seq.num_computed
             tokens[i] = seq.last_token
-            positions[i] = p
-            wslots[i] = self._write_slot(seq, p)
-            smat[i] = self._slot_matrix_row(seq)
+            positions[i] = seq.num_computed
+            tables[i, : len(seq.page_ids)] = seq.page_ids
             temp[i] = seq.temperature
             topk[i] = seq.top_k
             topp[i] = seq.top_p
 
         self._key, sub = jax.random.split(self._key)
-        toks, self.kv = self._step_fn(
+        toks, self.kv = self._decode_fn(
             self.params, self.kv,
-            jnp.asarray(tokens[:, None]), jnp.asarray(positions[:, None]),
-            jnp.asarray(wslots), jnp.asarray(smat),
-            jnp.asarray(positions * 0),  # T=1: last_idx is always 0
+            jnp.asarray(tokens), jnp.asarray(positions), jnp.asarray(tables),
             jnp.asarray(temp), jnp.asarray(topk), jnp.asarray(topp),
             sub,
         )
         self._step_count += 1
-        out = await asyncio.to_thread(np.asarray, toks)
-        for i, seq in active:
-            if self.slots[i] is not seq:
-                continue  # finished/preempted mid-step
-            seq.num_computed += 1
-            self._register_full_pages(seq)
-            self._append_token(seq, int(out[i]))
+        out = await asyncio.to_thread(np.asarray, toks)  # [K, B]
+        for step in range(out.shape[0]):
+            for i, seq in active:
+                if self.slots[i] is not seq:
+                    # finished earlier in this chunk: overshoot discarded
+                    continue
+                seq.num_computed += 1
+                self._register_full_pages(seq)
+                self._append_token(seq, int(out[step, i]))
 
-    def _ensure_page(self, seq: Sequence) -> bool:
-        p = seq.num_computed
-        while p // self.page_size >= len(seq.page_ids):
+    def _ensure_pages_through(self, seq: Sequence, upto_pos: int) -> bool:
+        while upto_pos // self.page_size >= len(seq.page_ids):
             got = self.allocator.allocate(1)
             if got is not None:
                 seq.page_ids.extend(got)
-                return True
+                continue
             victim = max(
                 (s for s in self.slots if s is not None), key=lambda s: s.seq_id
             )

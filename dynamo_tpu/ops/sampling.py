@@ -35,7 +35,15 @@ def sample_tokens(
     temp = jnp.where(is_greedy, 1.0, temperature)
     scaled = logits / temp[:, None]
 
-    cand_logits, cand_ids = jax.lax.top_k(scaled, min(CANDIDATES, v))  # sorted desc
+    # approx_max_k: TPU-native shortlist (exact top_k sorts the whole vocab
+    # on the VPU — measurably slow at 128k). recall_target=0.95 on a 64-wide
+    # shortlist is indistinguishable for sampling; greedy uses exact argmax.
+    if jax.default_backend() == "tpu" and v > 4096:
+        cand_logits, cand_ids = jax.lax.approx_max_k(
+            scaled, min(CANDIDATES, v), recall_target=0.95
+        )
+    else:
+        cand_logits, cand_ids = jax.lax.top_k(scaled, min(CANDIDATES, v))
     n = cand_logits.shape[-1]
     ranks = jnp.arange(n)
 

@@ -1,0 +1,327 @@
+"""`python -m dynamo_tpu.run` — the single-binary serving CLI.
+
+Equivalent of the reference's `dynamo-run` (reference:
+launch/dynamo-run/src/{main,lib,opt,flags}.rs): wire an input to an output.
+
+    in=http       OpenAI HTTP server
+    in=text       interactive chat REPL
+    in=stdin      one prompt from stdin, completion to stdout
+    in=batch:F    JSONL prompts file -> outputs + TTFT/ITL stats
+    in=dyn://...  worker mode: serve the engine on a distributed endpoint
+
+    out=jax       native TPU engine (requires --model-path)
+    out=echo_core / out=echo_full   CPU fake backends
+    out=dyn://... ingress mode: route to discovered remote workers
+
+Examples:
+    python -m dynamo_tpu.run in=http out=jax --model-path /models/llama
+    python -m dynamo_tpu.run in=http out=dyn://demo.backend.generate --hub H:P
+    python -m dynamo_tpu.run in=dyn://demo.backend.generate out=jax \
+        --model-path /models/llama --hub H:P [--disagg-mode decode|prefill]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+import time
+from typing import Optional
+
+from dynamo_tpu.utils.logging import configure_logging, get_logger
+
+log = get_logger("dynamo_tpu.run")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="dynamo_tpu.run", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    p.add_argument("io", nargs="+", help="in=... out=... (any order)")
+    p.add_argument("--model-path", help="local HF-style model dir")
+    p.add_argument("--model-name", help="public model name (default: dir name)")
+    p.add_argument("--hub", help="hub address host:port (distributed modes)")
+    p.add_argument("--http-host", default="0.0.0.0")
+    p.add_argument("--http-port", type=int, default=8080)
+    p.add_argument("--router-mode", default="round_robin",
+                   choices=["random", "round_robin", "kv"])
+    p.add_argument("--tensor-parallel-size", "--tp", type=int, default=1, dest="tp")
+    p.add_argument("--max-batch-size", type=int, default=8)
+    p.add_argument("--max-model-len", type=int, default=2048)
+    p.add_argument("--page-size", type=int, default=16)
+    p.add_argument("--num-pages", type=int, default=None)
+    p.add_argument("--prefill-chunk", type=int, default=512)
+    p.add_argument("--decode-steps", type=int, default=8)
+    p.add_argument("--dtype", default="bfloat16", choices=["bfloat16", "float32"])
+    p.add_argument("--extra-engine-args", help="JSON file of EngineConfig overrides")
+    p.add_argument("--disagg-mode", choices=["agg", "decode", "prefill"],
+                   default="agg", help="worker role in a disaggregated graph")
+    p.add_argument("--max-local-prefill-length", type=int, default=128)
+    p.add_argument("--max-tokens", type=int, default=256,
+                   help="default generation budget for text/stdin/batch inputs")
+    return p
+
+
+def parse_io(tokens: list[str]) -> tuple[str, str]:
+    inp, out = "http", "echo_full"
+    for t in tokens:
+        if t.startswith("in="):
+            inp = t[3:]
+        elif t.startswith("out="):
+            out = t[4:]
+        else:
+            raise SystemExit(f"unrecognized positional {t!r} (want in=/out=)")
+    return inp, out
+
+
+def build_engine_config_kwargs(args) -> dict:
+    from dynamo_tpu.parallel.mesh import MeshConfig
+
+    kw = dict(
+        mesh=MeshConfig(tp=args.tp),
+        dtype=args.dtype,
+        page_size=args.page_size,
+        num_pages=args.num_pages,
+        max_batch_size=args.max_batch_size,
+        max_model_len=args.max_model_len,
+        prefill_chunk=args.prefill_chunk,
+        decode_steps=args.decode_steps,
+    )
+    if args.extra_engine_args:
+        with open(args.extra_engine_args) as f:
+            kw.update(json.load(f))
+    return kw
+
+
+async def build_output(args, out: str, drt=None):
+    """Returns (pipeline_engine, card|None, jax_engine|None): something with
+    .generate(Context) serving OpenAI-shaped or token-shaped requests."""
+    from dynamo_tpu.llm.engines import EchoEngineCore, EchoEngineFull
+
+    if out == "echo_full":
+        return EchoEngineFull(), None, None
+    if out == "echo_core":
+        from dynamo_tpu.llm.backend import Backend
+        from dynamo_tpu.llm.local_model import LocalModel
+        from dynamo_tpu.llm.preprocessor import OpenAIPreprocessor
+        from dynamo_tpu.runtime.pipeline.engine import link
+
+        if not args.model_path:
+            raise SystemExit("out=echo_core needs --model-path (tokenizer)")
+        lm = LocalModel.prepare(args.model_path, name=args.model_name)
+        pipeline = link(
+            OpenAIPreprocessor(lm.card), Backend.from_card(lm.card), EchoEngineCore()
+        )
+        return pipeline, lm.card, None
+    if out == "jax":
+        from dynamo_tpu.llm.backend import Backend
+        from dynamo_tpu.llm.local_model import LocalModel
+        from dynamo_tpu.llm.preprocessor import OpenAIPreprocessor
+        from dynamo_tpu.runtime.pipeline.engine import link
+
+        if not args.model_path:
+            raise SystemExit("out=jax needs --model-path")
+        lm = LocalModel.prepare(args.model_path, name=args.model_name)
+        engine = lm.build_engine(**build_engine_config_kwargs(args))
+        pipeline = link(
+            OpenAIPreprocessor(lm.card), Backend.from_card(lm.card), engine
+        )
+        return pipeline, lm.card, engine
+    raise SystemExit(f"unknown out={out!r}")
+
+
+# ---------------------------------------------------------------- in= modes
+
+
+async def run_http(args, out: str) -> None:
+    from dynamo_tpu.llm.http.service import HttpService
+
+    svc = HttpService()
+    if out.startswith("dyn://"):
+        # ingress: discover models from the hub
+        from dynamo_tpu.llm.http.discovery import ModelWatcher
+        from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+        drt = await DistributedRuntime.from_settings(hub_addr=args.hub)
+        watcher = ModelWatcher(drt, svc.manager, router_mode=args.router_mode)
+        await watcher.start()
+    else:
+        pipeline, card, _engine = await build_output(args, out)
+        name = args.model_name or (card.display_name if card else "echo")
+        svc.manager.add_chat_model(name, pipeline)
+        svc.manager.add_completion_model(name, pipeline)
+    await svc.start(args.http_host, args.http_port)
+    log.info("serving OpenAI HTTP on %s:%d", args.http_host, svc.port)
+    await asyncio.Event().wait()
+
+
+async def run_worker(args, inp: str, out: str) -> None:
+    """in=dyn://ns.comp.ep: register as a worker on the hub."""
+    from dynamo_tpu.llm.http.discovery import register_llm
+    from dynamo_tpu.llm.kv_router import KvEventPublisher, KvMetricsPublisher
+    from dynamo_tpu.llm.local_model import LocalModel
+    from dynamo_tpu.runtime.component import EndpointId
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+    if out != "jax" and not out.startswith("echo"):
+        raise SystemExit("worker mode needs out=jax or out=echo_*")
+    drt = await DistributedRuntime.from_settings(hub_addr=args.hub)
+    eid = EndpointId.parse(inp)
+
+    if out.startswith("echo"):
+        from dynamo_tpu.llm.engines import EchoEngineCore
+
+        lm = LocalModel.prepare(args.model_path, name=args.model_name)
+        await register_llm(drt, EchoEngineCore(), lm.card, inp)
+        log.info("echo worker serving %s", inp)
+        await asyncio.Event().wait()
+        return
+
+    lm = LocalModel.prepare(args.model_path, name=args.model_name)
+    engine = lm.build_engine(**build_engine_config_kwargs(args))
+    lm.card.kv_cache_block_size = args.page_size
+    component = drt.namespace(eid.namespace).component(eid.component)
+    metrics = KvMetricsPublisher.for_engine(engine)
+
+    if args.disagg_mode == "prefill":
+        from dynamo_tpu.llm.disagg import PrefillHandler
+
+        PrefillHandler(drt, engine, eid.namespace, eid.component).start()
+        log.info("prefill worker on queue for %s.%s", eid.namespace, eid.component)
+        await asyncio.Event().wait()
+        return
+
+    serving_engine = engine
+    if args.disagg_mode == "decode":
+        from dynamo_tpu.llm.disagg import (
+            DisaggConfig,
+            DisaggDecodeWorker,
+            DisaggRouter,
+        )
+
+        worker = DisaggDecodeWorker(
+            drt, engine, eid.namespace, eid.component,
+            router=DisaggRouter(
+                drt, model=lm.card.display_name,
+                config=DisaggConfig(
+                    max_local_prefill_length=args.max_local_prefill_length
+                ),
+            ),
+        )
+        await worker.attach()
+        serving_engine = worker
+
+    await register_llm(
+        drt, serving_engine, lm.card, inp, stats_handler=metrics.stats_handler
+    )
+    KvEventPublisher(component, drt.primary_lease.lease_id).attach(engine).start()
+    log.info("worker (%s) serving %s", args.disagg_mode, inp)
+    await asyncio.Event().wait()
+
+
+async def _chat_once(pipeline, model: str, messages: list, max_tokens: int):
+    from dynamo_tpu.llm.protocols.openai import ChatCompletionRequest
+    from dynamo_tpu.runtime.pipeline.context import Context
+
+    req = ChatCompletionRequest.from_body(
+        {"model": model, "messages": messages, "max_tokens": max_tokens}
+    )
+    t0 = time.perf_counter()
+    ttft = None
+    text = ""
+    async for chunk in await pipeline.generate(Context(req)):
+        if chunk.get("__annotation__"):
+            continue
+        for choice in chunk.get("choices") or []:
+            piece = (choice.get("delta") or {}).get("content")
+            if piece:
+                if ttft is None:
+                    ttft = time.perf_counter() - t0
+                text += piece
+                print(piece, end="", flush=True)
+    print()
+    return text, ttft, time.perf_counter() - t0
+
+
+async def run_text(args, out: str) -> None:
+    pipeline, card, _ = await build_output(args, out)
+    model = args.model_name or (card.display_name if card else "echo")
+    messages: list = []
+    print(f"chat with {model} — empty line or ^D to quit")
+    while True:
+        try:
+            line = await asyncio.to_thread(input, "> ")
+        except EOFError:
+            return
+        if not line.strip():
+            return
+        messages.append({"role": "user", "content": line})
+        text, _, _ = await _chat_once(pipeline, model, messages, args.max_tokens)
+        messages.append({"role": "assistant", "content": text})
+
+
+async def run_stdin(args, out: str) -> None:
+    pipeline, card, _ = await build_output(args, out)
+    model = args.model_name or (card.display_name if card else "echo")
+    prompt = sys.stdin.read().strip()
+    await _chat_once(pipeline, model, [{"role": "user", "content": prompt}],
+                     args.max_tokens)
+
+
+async def run_batch(args, out: str, path: str) -> None:
+    """JSONL file of {"text": ...} prompts; writes outputs + latency stats
+    (reference: launch/dynamo-run/src/input/batch.rs:44-280)."""
+    pipeline, card, _ = await build_output(args, out)
+    model = args.model_name or (card.display_name if card else "echo")
+    ttfts, totals = [], []
+    out_path = path + ".out.jsonl"
+    with open(path) as f, open(out_path, "w") as of:
+        for line in f:
+            if not line.strip():
+                continue
+            item = json.loads(line)
+            text, ttft, total = await _chat_once(
+                pipeline, model,
+                [{"role": "user", "content": item["text"]}], args.max_tokens,
+            )
+            ttfts.append(ttft or 0.0)
+            totals.append(total)
+            of.write(json.dumps({"input": item["text"], "output": text}) + "\n")
+    if ttfts:
+        import statistics
+
+        print(
+            f"batch done: n={len(ttfts)} "
+            f"ttft_p50={statistics.median(ttfts) * 1000:.1f}ms "
+            f"total_p50={statistics.median(totals) * 1000:.1f}ms "
+            f"-> {out_path}"
+        )
+
+
+def main(argv: Optional[list[str]] = None) -> None:
+    configure_logging()
+    args = build_parser().parse_args(argv)
+    inp, out = parse_io(args.io)
+
+    if inp == "http":
+        coro = run_http(args, out)
+    elif inp == "text":
+        coro = run_text(args, out)
+    elif inp == "stdin":
+        coro = run_stdin(args, out)
+    elif inp.startswith("batch:"):
+        coro = run_batch(args, out, inp[len("batch:"):])
+    elif inp.startswith("dyn://"):
+        coro = run_worker(args, inp, out)
+    else:
+        raise SystemExit(f"unknown in={inp!r}")
+    try:
+        asyncio.run(coro)
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,32 @@
+"""Deployment SDK: declare component graphs, serve them as process groups.
+
+Equivalent of the reference's BentoML-derived SDK (reference:
+deploy/dynamo/sdk: @service service.py:80-307, depends() dependency.py:31-145,
+@dynamo_endpoint decorators.py:25-84, `dynamo serve` cli/serving.py) —
+rebuilt TPU-native and dependency-free: plain decorators, an asyncio process
+supervisor instead of circus, and a TPU chip allocator instead of
+CUDA_VISIBLE_DEVICES.
+"""
+
+from dynamo_tpu.sdk.config import ServiceConfig
+from dynamo_tpu.sdk.service import (
+    DynamoClient,
+    ServiceSpec,
+    async_on_start,
+    depends,
+    endpoint,
+    service,
+)
+from dynamo_tpu.sdk.supervisor import Supervisor, Watcher
+
+__all__ = [
+    "service",
+    "depends",
+    "endpoint",
+    "async_on_start",
+    "ServiceSpec",
+    "ServiceConfig",
+    "DynamoClient",
+    "Supervisor",
+    "Watcher",
+]

@@ -1,0 +1,53 @@
+"""TPU chip allocation across component processes on one host.
+
+Equivalent of the reference's GPU allocator (reference:
+sdk cli/allocator.py:54-251 ResourceAllocator.assign_gpus setting
+CUDA_VISIBLE_DEVICES) for TPU: each worker process gets a disjoint set of
+chip indices via TPU_VISIBLE_DEVICES (honored by libtpu) plus
+JAX_PLATFORMS passthrough; CPU-only components get JAX_PLATFORMS=cpu so
+they never grab the chips.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+def detect_num_chips() -> int:
+    env = os.environ.get("DYN_TPU_NUM_CHIPS")
+    if env:
+        return int(env)
+    try:
+        import jax
+
+        return len(jax.devices("tpu"))
+    except Exception:  # noqa: BLE001 — no TPU plugin / CPU-only host
+        return 0
+
+
+@dataclass
+class TpuAllocator:
+    total_chips: int = field(default_factory=detect_num_chips)
+    _next: int = 0
+
+    def assign(self, num_chips: int) -> Optional[list[int]]:
+        """A disjoint chip-id range, or None if the host is out of chips."""
+        if num_chips == 0:
+            return []
+        if self._next + num_chips > self.total_chips:
+            return None
+        ids = list(range(self._next, self._next + num_chips))
+        self._next += num_chips
+        return ids
+
+    def release_all(self) -> None:
+        self._next = 0
+
+    @staticmethod
+    def env_for(chip_ids: list[int]) -> dict[str, str]:
+        if not chip_ids:
+            # CPU-only component: keep it off the accelerators entirely
+            return {"JAX_PLATFORMS": "cpu"}
+        return {"TPU_VISIBLE_DEVICES": ",".join(str(i) for i in chip_ids)}

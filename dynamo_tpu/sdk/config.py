@@ -1,0 +1,51 @@
+"""Per-service configuration: YAML file + env overlay.
+
+reference: sdk lib/config.py (ServiceConfig / DYNAMO_SERVICE_CONFIG): a YAML
+mapping {ServiceName: {key: value}} merged under an env-var JSON override —
+the env form is how the supervisor passes resolved config to child
+processes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Optional
+
+ENV_VAR = "DYNAMO_SERVICE_CONFIG"
+
+
+class ServiceConfig:
+    def __init__(self, data: Optional[dict[str, dict[str, Any]]] = None):
+        self.data: dict[str, dict[str, Any]] = data or {}
+
+    @classmethod
+    def from_yaml(cls, path: str) -> "ServiceConfig":
+        import yaml
+
+        with open(path) as f:
+            return cls(yaml.safe_load(f) or {})
+
+    @classmethod
+    def from_env(cls) -> "ServiceConfig":
+        raw = os.environ.get(ENV_VAR)
+        return cls(json.loads(raw)) if raw else cls()
+
+    def merged_with_env(self) -> "ServiceConfig":
+        env = ServiceConfig.from_env()
+        out = {k: dict(v) for k, v in self.data.items()}
+        for svc, kv in env.data.items():
+            out.setdefault(svc, {}).update(kv)
+        return ServiceConfig(out)
+
+    def for_service(self, name: str) -> dict[str, Any]:
+        return dict(self.data.get(name, {}))
+
+    def get(self, service: str, key: str, default: Any = None) -> Any:
+        return self.data.get(service, {}).get(key, default)
+
+    def set(self, service: str, key: str, value: Any) -> None:
+        self.data.setdefault(service, {})[key] = value
+
+    def to_env(self) -> str:
+        return json.dumps(self.data)

@@ -1,0 +1,179 @@
+"""@service / depends / @endpoint — component graph declarations.
+
+A service class declares its endpoints (methods) and upstream dependencies
+(`depends(Other)` class attributes). `serve` walks the dependency edges from
+the entry service to find the whole graph (reference: LinkedServices +
+depends(), deploy/dynamo/sdk/src/dynamo/sdk/lib/{service,dependency}.py).
+
+Runtime model: each service runs in its own process (see supervisor/serve);
+inside, `serve_worker` creates the DistributedRuntime, hosts every
+`@endpoint`-marked method on `dyn://{namespace}.{service}.{endpoint}`, and
+materializes each `depends()` as a `DynamoClient` (a Client wrapper whose
+`.generate()` round-robins the dependency's live instances).
+"""
+
+from __future__ import annotations
+
+import inspect
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional, Type
+
+
+@dataclass
+class ServiceSpec:
+    cls: Type
+    name: str
+    namespace: str
+    resources: dict[str, Any] = field(default_factory=dict)  # {"tpu": n}
+    workers: int = 1
+    config: dict[str, Any] = field(default_factory=dict)
+
+    @property
+    def endpoints(self) -> dict[str, Callable]:
+        return {
+            ep_name: fn
+            for ep_name, fn in vars(self.cls).items()
+            if callable(fn) and getattr(fn, "__dynamo_endpoint__", None)
+        }
+
+    @property
+    def dependencies(self) -> dict[str, "Dependency"]:
+        return {
+            attr: dep
+            for attr, dep in vars(self.cls).items()
+            if isinstance(dep, Dependency)
+        }
+
+    def endpoint_path(self, ep_name: str) -> str:
+        return f"dyn://{self.namespace}.{self.name}.{ep_name}"
+
+
+def service(
+    name: Optional[str] = None,
+    namespace: str = "dynamo",
+    resources: Optional[dict] = None,
+    workers: int = 1,
+    **config: Any,
+):
+    """Class decorator declaring a component (reference: @service,
+    sdk lib/service.py:307)."""
+
+    def wrap(cls: Type) -> Type:
+        cls.__dynamo_spec__ = ServiceSpec(
+            cls=cls,
+            name=name or cls.__name__,
+            namespace=namespace,
+            resources=resources or {},
+            workers=workers,
+            config=config,
+        )
+        return cls
+
+    return wrap
+
+
+def get_spec(cls: Type) -> ServiceSpec:
+    spec = getattr(cls, "__dynamo_spec__", None)
+    if spec is None:
+        raise TypeError(f"{cls.__name__} is not a @service")
+    return spec
+
+
+class Dependency:
+    """Declared upstream edge; resolved to a DynamoClient at runtime
+    (reference: depends() -> DynamoClient, sdk lib/dependency.py:31-145)."""
+
+    def __init__(self, target: Type, endpoint: str = "generate"):
+        self.target = target
+        self.endpoint = endpoint
+        self._client: Optional["DynamoClient"] = None
+
+    @property
+    def spec(self) -> ServiceSpec:
+        return get_spec(self.target)
+
+    def __get__(self, obj, objtype=None):
+        if obj is None:
+            return self
+        if self._client is None:
+            raise RuntimeError(
+                f"dependency on {self.spec.name} not wired (serve_worker "
+                "resolves dependencies before on-start hooks)"
+            )
+        return self._client
+
+    async def resolve(self, drt) -> "DynamoClient":
+        from dynamo_tpu.runtime.component import EndpointId
+
+        eid = EndpointId.parse(self.spec.endpoint_path(self.endpoint))
+        ep = drt.namespace(eid.namespace).component(eid.component).endpoint(eid.name)
+        self._client = DynamoClient(await ep.client())
+        return self._client
+
+
+def depends(target: Type, endpoint: str = "generate") -> Dependency:
+    return Dependency(target, endpoint)
+
+
+class DynamoClient:
+    """Typed call surface of a dependency (reference: DynamoClient proxy,
+    sdk lib/dependency.py:145)."""
+
+    def __init__(self, client):
+        self.client = client
+
+    async def generate(self, payload, context=None, mode: str = "round_robin"):
+        return await self.client.generate(payload, context=context, mode=mode)
+
+    async def direct(self, payload, instance_id: int, **kw):
+        return await self.client.direct(payload, instance_id=instance_id, **kw)
+
+    async def wait_for_instances(self, timeout: float = 60.0):
+        return await self.client.wait_for_instances(timeout)
+
+    def instance_ids(self):
+        return self.client.instance_ids()
+
+
+def endpoint(name: Optional[str] = None):
+    """Method decorator marking a served endpoint (reference:
+    @dynamo_endpoint, sdk lib/decorators.py:25-84). The method signature is
+    `async def fn(self, request: Context) -> AsyncIterator`."""
+
+    def wrap(fn):
+        fn.__dynamo_endpoint__ = name or fn.__name__
+        return fn
+
+    return wrap
+
+
+def async_on_start(fn):
+    """Hook run after the runtime is up and dependencies resolve, before
+    endpoints serve (reference: @async_on_start, sdk lib/decorators.py)."""
+    fn.__dynamo_on_start__ = True
+    return fn
+
+
+def discover_graph(entry: Type) -> list[ServiceSpec]:
+    """All services reachable from `entry` via depends() edges, dependencies
+    first (reference: LinkedServices.remove_unused_edges, service.py:37-58)."""
+    seen: dict[str, ServiceSpec] = {}
+
+    def visit(cls: Type) -> None:
+        spec = get_spec(cls)
+        if spec.name in seen:
+            return
+        for dep in spec.dependencies.values():
+            visit(dep.target)
+        seen[spec.name] = spec
+
+    visit(entry)
+    return list(seen.values())
+
+
+def collect_on_start(obj) -> list[Callable]:
+    return [
+        getattr(obj, attr)
+        for attr, fn in inspect.getmembers(type(obj), callable)
+        if getattr(fn, "__dynamo_on_start__", False)
+    ]
