@@ -62,6 +62,7 @@ class JaxEngine:
         self.model_cfg = config.model_config()
         self._dtype = jnp.bfloat16 if config.dtype == "bfloat16" else jnp.float32
 
+        meshmod.validate_model_mesh(self.model_cfg, config.mesh)
         self.mesh = meshmod.build_mesh(config.mesh, devices)
         self._kv_sharding = meshmod.kv_cache_sharding(self.mesh)
 

@@ -153,15 +153,21 @@ class Backend(Operator):
         async def _out() -> AsyncIterator[dict]:
             # token ids consumed but not yet emitted (their text is still held
             # by the incremental detokenizer) — attached to the next frame so
-            # usage accounting downstream sees every generated token
+            # usage accounting downstream sees every generated token; same for
+            # frame meta (e.g. first-frame prefix_cached_tokens), merged so a
+            # fully-jailed frame's meta is not dropped
             pending_ids: list[int] = []
+            pending_meta: dict = {}
             async for raw in upstream:
                 out = EngineOutput.from_dict(raw) if isinstance(raw, dict) else raw
                 if request.is_stopped() and not decoder.finished:
                     decoder.finish_reason = FINISH_REASON_CANCELLED
+                    if out.meta:
+                        pending_meta.update(out.meta)
                     yield EngineOutput(
                         token_ids=pending_ids,
                         finish_reason=FINISH_REASON_CANCELLED,
+                        meta=pending_meta or None,
                     ).to_dict()
                     return
                 text_parts: list[str] = []
@@ -176,14 +182,17 @@ class Backend(Operator):
                 # only the consumed prefix: tokens past a mid-chunk stop must
                 # not leak into usage accounting downstream
                 pending_ids.extend(out.token_ids[:consumed])
+                if out.meta:
+                    pending_meta.update(out.meta)
                 if text_parts or decoder.finished:
                     yield EngineOutput(
                         token_ids=pending_ids,
                         text="".join(text_parts) or None,
                         finish_reason=decoder.finish_reason,
-                        meta=out.meta,
+                        meta=pending_meta or None,
                     ).to_dict()
                     pending_ids = []
+                    pending_meta = {}
                 if decoder.finished:
                     # tell the engine to stop producing (remote: stop frame)
                     request.stop_generating()
@@ -195,7 +204,7 @@ class Backend(Operator):
                         token_ids=pending_ids,
                         text=decoder.flush(),
                         finish_reason=out.finish_reason,
-                        meta=out.meta,
+                        meta=pending_meta or None,
                     ).to_dict()
                     return
             if not decoder.finished:
@@ -205,6 +214,7 @@ class Backend(Operator):
                     token_ids=pending_ids,
                     text=decoder.flush(),
                     finish_reason=FINISH_REASON_ERROR,
+                    meta=pending_meta or None,
                 ).to_dict()
 
         return _out()

@@ -6,10 +6,11 @@ scores each candidate worker
 
     logit = 2 * (overlap_blocks * block_size / isl_tokens)
             - gpu_cache_usage_perc
-            - active_slots / total_slots
+            - active_slots / max(active_slots across workers)
 
-(the exact formula at scheduler.rs:290) and the best logit wins, ties
-broken randomly. Every decision emits a KVHitRateEvent on the component's
+(the formula at scheduler.rs:290, with active slots normalized by the
+max across candidate workers as the reference does) and the best logit
+wins, ties broken randomly. Every decision emits a KVHitRateEvent on the component's
 `kv-hit-rate` subject for the metrics plane.
 """
 
@@ -53,16 +54,16 @@ class DefaultWorkerSelector:
     ) -> Optional[SchedulingDecision]:
         if not workers:
             return None
+        # reference normalizes active slots by the max across candidate
+        # workers (scheduler.rs:252-290); max_active == 0 means every
+        # worker is idle and the slot term vanishes
+        max_active = max(m.request_active_slots for m in workers.values())
         best: list[tuple[int, int, float]] = []  # (worker, overlap, logit)
         for wid, m in workers.items():
             overlap = overlaps.scores.get(wid, 0)
             score = 2.0 * (overlap * block_size / max(isl_tokens, 1))
             usage = m.gpu_cache_usage_perc
-            slots = (
-                m.request_active_slots / m.request_total_slots
-                if m.request_total_slots
-                else 0.0
-            )
+            slots = m.request_active_slots / max_active if max_active else 0.0
             logit = score - usage - slots
             if not best or logit > best[0][2] + 1e-9:
                 best = [(wid, overlap, logit)]

@@ -71,6 +71,23 @@ def build_mesh(cfg: MeshConfig, devices=None) -> Mesh:
     return Mesh(arr, AXES)
 
 
+def validate_model_mesh(cfg: ModelConfig, mc: MeshConfig) -> None:
+    """Fail fast with a clear message instead of an opaque XLA sharding
+    error when head counts don't divide the tp axis (e.g. qwen2.5-0.5b has
+    2 KV heads — tp=8 can never work)."""
+    if cfg.num_kv_heads % mc.tp:
+        raise ValueError(
+            f"model '{cfg.name}' has num_kv_heads={cfg.num_kv_heads}, which "
+            f"is not divisible by tp={mc.tp}; choose tp from the divisors "
+            f"of {cfg.num_kv_heads}"
+        )
+    if cfg.num_heads % mc.tp:
+        raise ValueError(
+            f"model '{cfg.name}' has num_heads={cfg.num_heads}, which is "
+            f"not divisible by tp={mc.tp}"
+        )
+
+
 def param_shardings(cfg: ModelConfig, mesh: Mesh) -> dict:
     """NamedSharding pytree matching `llama.init_params` structure.
 

@@ -213,10 +213,13 @@ async def run_worker(args, inp: str, out: str) -> None:
         await worker.attach()
         serving_engine = worker
 
+    # attach the event publisher BEFORE the worker becomes discoverable:
+    # events from requests arriving in the gap would be lost forever (the
+    # indexer has no replay)
+    KvEventPublisher(component, drt.primary_lease.lease_id).attach(engine).start()
     await register_llm(
         drt, serving_engine, lm.card, inp, stats_handler=metrics.stats_handler
     )
-    KvEventPublisher(component, drt.primary_lease.lease_id).attach(engine).start()
     log.info("worker (%s) serving %s", args.disagg_mode, inp)
     await asyncio.Event().wait()
 
