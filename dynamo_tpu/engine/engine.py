@@ -9,9 +9,24 @@ for XLA's compile-once regime:
 - the KV cache is **donated** through every step, so scatters update HBM
   in place;
 - sampling runs on device inside the same jit (no logits on the host);
+- decode attention runs the **Pallas paged kernel** on TPU
+  (`ops/pallas_attention.py`), the jnp gather oracle elsewhere;
 - the host loop is single-threaded asyncio (the reference's
   progress-engine-with-mailboxes pattern, SURVEY.md §5) and owns the
   allocator, slots and queues.
+
+Scheduling (one loop tick): admit waiting sequences into free slots, run at
+most ONE prefill chunk, then one decode dispatch — so a long prompt never
+stalls active decode streams for more than a chunk (the reference's disagg
+rationale, reference docs/disagg_serving.md:1-10, applied to aggregated
+serving).
+
+Decode is **pipelined**: dispatch N+1 is enqueued (using the on-device
+sampled tokens of dispatch N as carry — no host round trip) before N's
+tokens are fetched for emission, so host work overlaps device compute.
+Overshoot tokens of sequences that finished in N are discarded at sync;
+their trailing writes land in pages that are never hash-registered, so the
+prefix cache stays sound.
 
 Uniform step invariant: a sequence always has KV computed for exactly
 `total_tokens - 1` positions when decoding (the newest sampled token is fed
@@ -49,6 +64,18 @@ from dynamo_tpu.runtime.pipeline.context import Context
 log = logging.getLogger("dynamo_tpu.engine")
 
 
+class _Dispatch:
+    """One in-flight decode dispatch: device tokens + the slot snapshot it
+    was built from."""
+
+    __slots__ = ("out_dev", "snapshot", "steps")
+
+    def __init__(self, out_dev, snapshot, steps):
+        self.out_dev = out_dev          # [steps, B] device array
+        self.snapshot = snapshot        # list[(slot_index, Sequence)]
+        self.steps = steps
+
+
 class JaxEngine:
     """Paged continuous-batching engine over a jax Mesh.
 
@@ -65,6 +92,21 @@ class JaxEngine:
         meshmod.validate_model_mesh(self.model_cfg, config.mesh)
         self.mesh = meshmod.build_mesh(config.mesh, devices)
         self._kv_sharding = meshmod.kv_cache_sharding(self.mesh)
+
+        backend = jax.default_backend()
+        if config.attn_backend == "auto":
+            # pallas kernel needs shard_map integration for tp>1; single
+            # device on TPU is the supported fast path today
+            self._attn_pallas = (
+                backend == "tpu" and config.mesh.num_devices == 1
+            )
+            self._attn_interpret = False
+        elif config.attn_backend == "pallas":
+            self._attn_pallas = True
+            self._attn_interpret = backend != "tpu"
+        else:
+            self._attn_pallas = False
+            self._attn_interpret = False
 
         if params is None:
             if config.checkpoint_dir:
@@ -98,13 +140,18 @@ class JaxEngine:
 
         self.waiting: deque[Sequence] = deque()
         self.slots: list[Optional[Sequence]] = [None] * config.max_batch_size
+        self._prefilling: deque[Sequence] = deque()
+        self._inflight: Optional[_Dispatch] = None
+        self._carry_toks = jnp.zeros(config.max_batch_size, jnp.int32)
+        self._overrides: dict[int, object] = {}   # slot -> device scalar | int
+        self._pending_first: list[tuple[Sequence, object]] = []
         self._wake = asyncio.Event()
         self._loop_task: Optional[asyncio.Task] = None
         self._closed = False
         self._key = jax.random.PRNGKey(config.seed ^ 0x5EED)
         self._step_count = 0
 
-        # slot-matrix width: whole context in token slots
+        # slot-matrix width: whole context in token slots (gather prefill)
         self._smat_width = config.max_pages_per_seq * config.page_size
 
         # one jitted step; jax retraces per (B, T, C) shape family
@@ -187,6 +234,56 @@ class JaxEngine:
         lg = llama.logits(params, self.model_cfg, last_h)
         toks = sample_tokens(lg, key, temp, topk, topp)
         return toks, kv
+
+    def _decode_multi(self, params, kv, tokens, positions, block_tables, active,
+                      temp, topk, topp, key):
+        """`decode_steps` decode iterations in ONE dispatch (lax.scan with
+        on-device token feedback + slot computation) — the antidote to
+        per-token host round trips, which dominate wall clock when the
+        device is remote or fast. Returns sampled tokens [K, B]."""
+        s = self.page_size
+        b, w = block_tables.shape
+        smat = None
+        if not self._attn_pallas:
+            smat = (
+                block_tables[:, :, None] * s + jnp.arange(s, dtype=jnp.int32)
+            ).reshape(b, -1)
+
+        def body(carry, _):
+            tokens, positions, kv, key = carry
+            key, sub = jax.random.split(key)
+            page_idx = jnp.minimum(positions // s, w - 1)
+            wslots = (
+                jnp.take_along_axis(block_tables, page_idx[:, None], axis=1)[:, 0] * s
+                + positions % s
+            )
+            # inactive rows and positions past a finished sequence's budget
+            # must write the trash page, never a valid slot
+            wslots = jnp.where(
+                active & (positions < self.config.max_model_len), wslots, 0
+            ).astype(jnp.int32)
+            if self._attn_pallas:
+                attn = llama.AttnSpec.pallas_decode(
+                    block_tables,
+                    jnp.where(active, positions + 1, 0).astype(jnp.int32),
+                    s,
+                    interpret=self._attn_interpret,
+                )
+            else:
+                attn = llama.AttnSpec.gather(smat)
+            hidden, kv = llama.forward(
+                params, self.model_cfg, tokens[:, None], positions[:, None],
+                kv, wslots, attn,
+            )
+            lg = llama.logits(params, self.model_cfg, hidden[:, 0])
+            toks = sample_tokens(lg, sub, temp, topk, topp)
+            return (toks, positions + 1, kv, key), toks
+
+        (_, _, kv, _), out = jax.lax.scan(
+            body, (tokens, positions, kv, key), None,
+            length=self.config.decode_steps,
+        )
+        return out, kv
 
     # ------------------------------------------------------------------
     # engine protocol
@@ -294,33 +391,6 @@ class JaxEngine:
         finally:
             self.allocator.release(seq.page_ids)
 
-    async def _inject_preloaded(self, seq: Sequence) -> int:
-        """Scatter remotely-computed KV into the sequence's pages; returns
-        the remotely-sampled first token. Chunked by prefill buckets so the
-        jit shape family stays bounded."""
-        first_token, k_arr, v_arr = seq.preloaded
-        t = seq.total_tokens
-        start = seq.num_computed  # locally-cached prefix needs no injection
-        while start < t:
-            chunk = min(t - start, self.config.prefill_chunk)
-            bucket = self._bucket_for(chunk)
-            slots = np.zeros(bucket, np.int32)  # pad -> trash slot 0
-            for i in range(chunk):
-                slots[i] = self._write_slot(seq, start + i)
-            nk = np.zeros((k_arr.shape[0], bucket, *k_arr.shape[2:]), k_arr.dtype)
-            nv = np.zeros_like(nk)
-            nk[:, :chunk] = k_arr[:, start : start + chunk]
-            nv[:, :chunk] = v_arr[:, start : start + chunk]
-            self.kv = self._inject_fn(
-                self.kv, jnp.asarray(slots), jnp.asarray(nk), jnp.asarray(nv)
-            )
-            start += chunk
-            await asyncio.sleep(0)
-        seq.num_computed = t
-        self._register_full_pages(seq)
-        seq.preloaded = None
-        return first_token
-
     def _ensure_loop(self) -> None:
         if self._loop_task is None or self._loop_task.done():
             self._loop_task = asyncio.get_running_loop().create_task(self._loop())
@@ -344,22 +414,39 @@ class JaxEngine:
     async def _loop(self) -> None:
         try:
             while not self._closed:
-                progressed = False
-                progressed |= await self._admit()
-                if any(s is not None for s in self.slots):
-                    await self._decode_once()
+                progressed = self._admit_new()
+                # device queue per tick: decode dispatch N+1 first, then a
+                # bounded burst of prefill chunks — all enqueued before the
+                # (blocking) sync of dispatch N, so host work and new
+                # compute overlap
+                new = self._maybe_dispatch_decode()
+                progressed |= new is not None
+                progressed |= await self._prefill_tick()
+                old, self._inflight = self._inflight, new
+                if old is not None:
+                    await self._sync_dispatch(old)
                     progressed = True
-                if not progressed:
-                    self._wake.clear()
-                    if self._closed:
-                        return
-                    await self._wake.wait()
+                elif self._pending_first:
+                    await self._flush_first_tokens()
+                    progressed = True
+                if progressed:
+                    # yield so producers/consumers interleave with the loop
+                    await asyncio.sleep(0)
+                    continue
+                self._wake.clear()
+                if self._closed:
+                    return
+                if self.waiting or self._prefilling or self._inflight:
+                    continue
+                await self._wake.wait()
         except Exception:
             log.exception("engine loop crashed; failing all requests")
             for seq in list(self.waiting) + [s for s in self.slots if s]:
                 seq.out_queue.put_nowait(EngineOutput.final("error").to_dict())
             self.waiting.clear()
             self.slots = [None] * len(self.slots)
+            self._prefilling.clear()
+            self._inflight = None
             raise
 
     # ---- admission ----------------------------------------------------
@@ -370,7 +457,9 @@ class JaxEngine:
                 return i
         return None
 
-    async def _admit(self) -> bool:
+    def _admit_new(self) -> bool:
+        """Assign waiting sequences to free slots + pages; actual prefill
+        compute happens chunk-at-a-time in _prefill_tick."""
         progressed = False
         while self.waiting:
             slot = self._free_slot()
@@ -395,14 +484,13 @@ class JaxEngine:
                 break  # out of pages; wait for something to finish
             self.waiting.popleft()
             seq.slot = slot
+            seq.prefilling = True
+            seq.first_meta = {
+                "prefix_cached_tokens": seq.num_cached,
+                "prompt_tokens": seq.prompt_len,
+            }
             self.slots[slot] = seq
-            try:
-                await self._run_prefill(seq)
-            except Exception:
-                # contain per-sequence failures (e.g. a malformed remote KV
-                # payload): fail this request, keep the loop and batch alive
-                log.exception("prefill of seq %s failed", seq.seq_id)
-                self._finish(seq, FINISH_REASON_ERROR)
+            self._prefilling.append(seq)
             progressed = True
         return progressed
 
@@ -443,146 +531,219 @@ class JaxEngine:
     def _write_slot(self, seq: Sequence, pos: int) -> int:
         return seq.page_ids[pos // self.page_size] * self.page_size + pos % self.page_size
 
-    async def _run_prefill(self, seq: Sequence) -> None:
-        """Compute KV for tokens [num_computed, T), sample the next token
-        from position T-1, emit it. Chunked for long prompts."""
-        first_meta = {
-            "prefix_cached_tokens": seq.num_cached,
-            "prompt_tokens": seq.prompt_len,
-        }
-        if seq.preloaded is not None:
-            # remote-prefilled (disagg): KV arrives instead of being computed
-            first_token = await self._inject_preloaded(seq)
-            first_meta["remote_prefill"] = True
-            self._append_token(seq, first_token, extra_meta=first_meta)
-            return
-        tok = await self._prefill_forward(seq)
-        self._append_token(seq, tok, extra_meta=first_meta)
+    async def _prefill_tick(self) -> bool:
+        """Run ONE chunk of the oldest prefilling sequence (bounded work so
+        decode streams keep flowing under long prompts)."""
+        if not self._prefilling:
+            return False
+        seq = self._prefilling[0]
+        if seq.ctx.is_stopped():
+            self._prefilling.popleft()
+            self._finish(seq, FINISH_REASON_CANCELLED)
+            return True
+        try:
+            if seq.preloaded is not None:
+                tok = self._inject_chunk(seq)
+            else:
+                tok = self._prefill_chunk_dispatch(seq)
+        except Exception:
+            # contain per-sequence failures (e.g. a malformed remote KV
+            # payload): fail this request, keep the loop and batch alive
+            log.exception("prefill of seq %s failed", seq.seq_id)
+            self._prefilling.popleft()
+            self._finish(seq, FINISH_REASON_ERROR)
+            return True
+        if tok is not None:
+            # final chunk dispatched: sequence becomes decode-ready with
+            # its first token carried on device (or a host int from the
+            # disagg inject path) — no sync here
+            self._prefilling.popleft()
+            seq.prefilling = False
+            seq.device_pos = seq.num_computed
+            self._overrides[seq.slot] = tok
+            self._pending_first.append((seq, tok))
+            if hasattr(tok, "copy_to_host_async"):
+                tok.copy_to_host_async()
+        await asyncio.sleep(0)
+        return True
 
-    async def _prefill_forward(self, seq: Sequence) -> int:
-        """Chunked prefill compute only: writes KV, returns the token
-        sampled at the final position (no emission/bookkeeping)."""
+    def _prefill_chunk_dispatch(self, seq: Sequence):
+        """Dispatch one prefill chunk; returns the sampled-token device
+        array when this was the final chunk, else None."""
         tokens = seq.tokens
         t = len(tokens)
+        start = seq.num_computed
+        chunk = min(t - start, self.config.prefill_chunk)
+        bucket = self._bucket_for(chunk)
         smat = self._slot_matrix_row(seq)[None]
-        sampled: Optional[jax.Array] = None
-        while seq.num_computed < t:
-            start = seq.num_computed
+        tok_arr = np.zeros((1, bucket), np.int32)
+        pos_arr = np.zeros((1, bucket), np.int32)
+        wslots = np.zeros(bucket, np.int32)
+        tok_arr[0, :chunk] = tokens[start : start + chunk]
+        pos_arr[0, :chunk] = np.arange(start, start + chunk)
+        for i in range(chunk):
+            wslots[i] = self._write_slot(seq, start + i)
+        self._key, sub = jax.random.split(self._key)
+        toks, self.kv = self._step_fn(
+            self.params, self.kv,
+            jnp.asarray(tok_arr), jnp.asarray(pos_arr), jnp.asarray(wslots),
+            jnp.asarray(smat), jnp.asarray([chunk - 1]),
+            jnp.asarray([seq.temperature], jnp.float32),
+            jnp.asarray([seq.top_k], jnp.int32),
+            jnp.asarray([seq.top_p], jnp.float32),
+            sub,
+        )
+        seq.num_computed += chunk
+        self._register_full_pages(seq)
+        return toks[0] if seq.num_computed >= t else None
+
+    async def _prefill_forward(self, seq: Sequence) -> int:
+        """Blocking chunked prefill (disagg prefill_only path): writes KV,
+        returns the token sampled at the final position."""
+        tok = None
+        while tok is None:
+            tok = self._prefill_chunk_dispatch(seq)
+            await asyncio.sleep(0)
+        out = await asyncio.to_thread(np.asarray, tok)
+        return int(out)
+
+    def _inject_chunk(self, seq: Sequence) -> Optional[int]:
+        """Scatter one chunk of remotely-computed KV into the sequence's
+        pages (disagg decode side); returns the remotely-sampled first
+        token when injection is complete."""
+        first_token, k_arr, v_arr = seq.preloaded
+        t = seq.total_tokens
+        start = seq.num_computed  # locally-cached prefix needs no injection
+        if start < t:
             chunk = min(t - start, self.config.prefill_chunk)
             bucket = self._bucket_for(chunk)
-            tok_arr = np.zeros((1, bucket), np.int32)
-            pos_arr = np.zeros((1, bucket), np.int32)
-            wslots = np.zeros(bucket, np.int32)
-            tok_arr[0, :chunk] = tokens[start : start + chunk]
-            pos_arr[0, :chunk] = np.arange(start, start + chunk)
+            slots = np.zeros(bucket, np.int32)  # pad -> trash slot 0
             for i in range(chunk):
-                wslots[i] = self._write_slot(seq, start + i)
-            self._key, sub = jax.random.split(self._key)
-            toks, self.kv = self._step_fn(
-                self.params, self.kv,
-                jnp.asarray(tok_arr), jnp.asarray(pos_arr), jnp.asarray(wslots),
-                jnp.asarray(smat), jnp.asarray([chunk - 1]),
-                jnp.asarray([seq.temperature], jnp.float32),
-                jnp.asarray([seq.top_k], jnp.int32),
-                jnp.asarray([seq.top_p], jnp.float32),
-                sub,
+                slots[i] = self._write_slot(seq, start + i)
+            nk = np.zeros((k_arr.shape[0], bucket, *k_arr.shape[2:]), k_arr.dtype)
+            nv = np.zeros_like(nk)
+            nk[:, :chunk] = k_arr[:, start : start + chunk]
+            nv[:, :chunk] = v_arr[:, start : start + chunk]
+            self.kv = self._inject_fn(
+                self.kv, jnp.asarray(slots), jnp.asarray(nk), jnp.asarray(nv)
             )
             seq.num_computed += chunk
             self._register_full_pages(seq)
-            sampled = toks
-            await asyncio.sleep(0)  # let other tasks breathe between chunks
-        out = await asyncio.to_thread(np.asarray, sampled)
-        return int(out[0])
+        if seq.num_computed >= t:
+            seq.preloaded = None
+            seq.first_meta = {**(seq.first_meta or {}), "remote_prefill": True}
+            return int(first_token)
+        return None
 
     # ---- decode -------------------------------------------------------
 
-    def _decode_multi(self, params, kv, tokens, positions, block_tables,
-                      temp, topk, topp, key):
-        """`decode_steps` decode iterations in ONE dispatch (lax.scan with
-        on-device token feedback + slot computation) — the antidote to
-        per-token host round trips, which dominate wall clock when the
-        device is remote or fast. Returns sampled tokens [K, B]."""
-        s = self.page_size
-        b, w = block_tables.shape
-        smat = (
-            block_tables[:, :, None] * s + jnp.arange(s, dtype=jnp.int32)
-        ).reshape(b, -1)
+    async def _decode_tick(self) -> bool:
+        """Pipelined decode: enqueue dispatch N+1 (device token carry),
+        then sync + emit dispatch N's tokens while N+1 computes."""
+        prog = False
+        new = None
+        if not self._closed:
+            ready = [
+                (i, s)
+                for i, s in enumerate(self.slots)
+                if s is not None and not s.prefilling
+            ]
+            # cancellation sweep before building a dispatch
+            for i, s in ready:
+                if s.ctx.is_stopped():
+                    self._finish(s, FINISH_REASON_CANCELLED)
+            ready = [(i, s) for i, s in ready if self.slots[i] is s]
+            if ready:
+                new = self._dispatch_decode(ready)
+                prog = new is not None
+        old, self._inflight = self._inflight, new
+        if old is not None:
+            await self._sync_dispatch(old)
+            prog = True
+        elif self._pending_first:
+            await self._flush_first_tokens()
+            prog = True
+        return prog
 
-        def body(carry, _):
-            tokens, positions, kv, key = carry
-            key, sub = jax.random.split(key)
-            page_idx = jnp.minimum(positions // s, w - 1)
-            wslots = (
-                jnp.take_along_axis(block_tables, page_idx[:, None], axis=1)[:, 0] * s
-                + positions % s
-            )
-            # past a finished sequence's budget the scan keeps running: those
-            # writes must land in the trash page, never a valid slot
-            wslots = jnp.where(
-                positions < self.config.max_model_len, wslots, 0
-            ).astype(jnp.int32)
-            hidden, kv = llama.forward(
-                params, self.model_cfg, tokens[:, None], positions[:, None],
-                kv, wslots, smat,
-            )
-            lg = llama.logits(params, self.model_cfg, hidden[:, 0])
-            toks = sample_tokens(lg, sub, temp, topk, topp)
-            return (toks, positions + 1, kv, key), toks
-
-        (_, _, kv, _), out = jax.lax.scan(
-            body, (tokens, positions, kv, key), None,
-            length=self.config.decode_steps,
-        )
-        return out, kv
-
-    async def _decode_once(self) -> None:
+    def _dispatch_decode(self, ready) -> Optional[_Dispatch]:
         b = len(self.slots)
         k_steps = self.config.decode_steps
-        # ensure every active sequence has pages for all positions this
-        # dispatch will write: [p, p + k_steps)
-        for seq in [s for s in self.slots if s is not None]:
+        # ensure every ready sequence has pages for all positions this
+        # dispatch will write: [device_pos, device_pos + k_steps)
+        for _, seq in ready:
             if seq.slot < 0 or self.slots[seq.slot] is not seq:
                 continue  # preempted by an earlier victim pick this pass
-            if seq.ctx.is_stopped():
-                self._finish(seq, FINISH_REASON_CANCELLED)
-                continue
             upto = min(
-                seq.num_computed + k_steps - 1, self.config.max_model_len - 1
+                seq.device_pos + k_steps - 1, self.config.max_model_len - 1
             )
             if not self._ensure_pages_through(seq, upto):
-                return  # seq itself was preempted; retry next loop
-        active = [(i, s) for i, s in enumerate(self.slots) if s is not None]
+                return None  # seq itself was preempted; retry next tick
+        active = [
+            (i, s)
+            for i, s in ready
+            if self.slots[i] is s and not s.prefilling
+        ]
         if not active:
-            return
+            return None
 
         w = self.config.max_pages_per_seq
-        tokens = np.zeros(b, np.int32)
         positions = np.zeros(b, np.int32)
         tables = np.zeros((b, w), np.int32)
+        act = np.zeros(b, bool)
         temp = np.zeros(b, np.float32)
         topk = np.zeros(b, np.int32)
         topp = np.ones(b, np.float32)
         for i, seq in active:
-            tokens[i] = seq.last_token
-            positions[i] = seq.num_computed
+            positions[i] = seq.device_pos
             tables[i, : len(seq.page_ids)] = seq.page_ids
+            act[i] = True
             temp[i] = seq.temperature
             topk[i] = seq.top_k
             topp[i] = seq.top_p
 
+        toks = self._carry_toks
+        for slot, val in self._overrides.items():
+            if act[slot]:
+                toks = toks.at[slot].set(val)
+        self._overrides.clear()
+
         self._key, sub = jax.random.split(self._key)
-        toks, self.kv = self._decode_fn(
+        out, self.kv = self._decode_fn(
             self.params, self.kv,
-            jnp.asarray(tokens), jnp.asarray(positions), jnp.asarray(tables),
+            toks, jnp.asarray(positions), jnp.asarray(tables), jnp.asarray(act),
             jnp.asarray(temp), jnp.asarray(topk), jnp.asarray(topp),
             sub,
         )
         self._step_count += 1
-        out = await asyncio.to_thread(np.asarray, toks)  # [K, B]
+        self._carry_toks = out[-1]
+        out.copy_to_host_async()
+        for i, seq in active:
+            seq.device_pos += k_steps
+        return _Dispatch(out, active, k_steps)
+
+    async def _flush_first_tokens(self) -> None:
+        """Emit prefill first tokens (device scalars or disagg host ints),
+        in stream order before any decode tokens of the same sequence."""
+        pending, self._pending_first = self._pending_first, []
+        for seq, tok in pending:
+            if seq.slot < 0 or self.slots[seq.slot] is not seq:
+                continue  # finished/preempted before emission: dropped
+            val = (
+                int(await asyncio.to_thread(np.asarray, tok))
+                if hasattr(tok, "copy_to_host_async")
+                else int(tok)
+            )
+            seq.num_computed = seq.total_tokens  # prefill KV all valid
+            self._append_token(seq, val, extra_meta=seq.first_meta)
+            seq.first_meta = None
+
+    async def _sync_dispatch(self, d: _Dispatch) -> None:
+        await self._flush_first_tokens()
+        out = await asyncio.to_thread(np.asarray, d.out_dev)  # [K, B]
         for step in range(out.shape[0]):
-            for i, seq in active:
+            for i, seq in d.snapshot:
                 if self.slots[i] is not seq:
-                    # finished earlier in this chunk: overshoot discarded
+                    # finished/preempted earlier: overshoot discarded
                     continue
                 seq.num_computed += 1
                 self._register_full_pages(seq)
@@ -607,10 +768,15 @@ class JaxEngine:
         self._register_full_pages(seq)
         self.allocator.release(seq.page_ids)
         self.slots[seq.slot] = None
+        self._overrides.pop(seq.slot, None)
+        if seq in self._prefilling:
+            self._prefilling.remove(seq)
         seq.slot = -1
+        seq.prefilling = False
         seq.page_ids = []
         seq.num_cached = 0
         seq.num_computed = 0
+        seq.device_pos = 0
         seq.registered_pages = 0
         self.waiting.appendleft(seq)
 
@@ -644,8 +810,12 @@ class JaxEngine:
         self._register_full_pages(seq)
         self.allocator.release(seq.page_ids)
         if seq.slot >= 0:
+            self._overrides.pop(seq.slot, None)
             self.slots[seq.slot] = None
             seq.slot = -1
+        if seq in self._prefilling:
+            self._prefilling.remove(seq)
+        seq.prefilling = False
         seq.finish = reason
         seq.out_queue.put_nowait(EngineOutput.final(reason).to_dict())
         self._wake.set()

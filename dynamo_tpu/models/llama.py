@@ -29,6 +29,55 @@ from dynamo_tpu.ops.rope import apply_rope, rope_cos_sin, rope_inv_freq
 Params = dict[str, Any]
 
 
+class AttnSpec:
+    """How attention reads the paged KV pool — one of two modes, chosen
+    statically at trace time by which fields are populated:
+
+    - gather (oracle / prefill): `slot_matrix` [B, C] position-ordered
+      slots; runs `ops.attention.paged_attention` (pure jnp, any backend).
+    - pallas decode (T==1): `block_tables` [B, W] page ids + `lengths`
+      [B] valid-KV counts (0 = inactive row); runs the flash paged kernel
+      (`ops.pallas_attention`), walking only live pages.
+
+    Registered as a pytree with `page_size`/`interpret` as static aux data
+    so they stay Python values under jit.
+    """
+
+    def __init__(self, slot_matrix=None, block_tables=None, lengths=None,
+                 page_size: int = 16, interpret: bool = False):
+        self.slot_matrix = slot_matrix
+        self.block_tables = block_tables
+        self.lengths = lengths
+        self.page_size = page_size
+        self.interpret = interpret
+
+    @classmethod
+    def gather(cls, slot_matrix):
+        return cls(slot_matrix=slot_matrix)
+
+    @classmethod
+    def pallas_decode(cls, block_tables, lengths, page_size, interpret=False):
+        return cls(
+            block_tables=block_tables,
+            lengths=lengths,
+            page_size=page_size,
+            interpret=interpret,
+        )
+
+
+jax.tree_util.register_pytree_node(
+    AttnSpec,
+    lambda s: (
+        (s.slot_matrix, s.block_tables, s.lengths),
+        (s.page_size, s.interpret),
+    ),
+    lambda aux, children: AttnSpec(
+        slot_matrix=children[0], block_tables=children[1], lengths=children[2],
+        page_size=aux[0], interpret=aux[1],
+    ),
+)
+
+
 class KVCache(NamedTuple):
     """Layer-stacked flat slot pools: k/v [num_layers, num_slots, K, Hd]."""
 
@@ -56,7 +105,7 @@ def _attn_block(
     kv_k: jnp.ndarray,       # [N, K, Hd] this layer's pools
     kv_v: jnp.ndarray,
     write_slots: jnp.ndarray,   # [B*T] int32
-    slot_matrix: jnp.ndarray,   # [B, C]
+    attn: "AttnSpec",
     positions: jnp.ndarray,     # [B, T]
 ):
     b, t, _ = x.shape
@@ -79,7 +128,20 @@ def _attn_block(
     kv_k, kv_v = write_kv_slots(
         kv_k, kv_v, write_slots, k.reshape(b * t, kh, hd), v.reshape(b * t, kh, hd)
     )
-    out = paged_attention(q, kv_k, kv_v, slot_matrix, positions)
+    if attn.block_tables is not None:
+        from dynamo_tpu.ops.pallas_attention import paged_decode_attention
+
+        out = paged_decode_attention(
+            q[:, 0],
+            kv_k,
+            kv_v,
+            attn.block_tables,
+            attn.lengths,
+            page_size=attn.page_size,
+            interpret=attn.interpret,
+        )[:, None]
+    else:
+        out = paged_attention(q, kv_k, kv_v, attn.slot_matrix, positions)
     return out.reshape(b, t, h * hd) @ lp["wo"], kv_k, kv_v
 
 
@@ -96,13 +158,15 @@ def forward(
     positions: jnp.ndarray,    # [B, T] int32 absolute positions
     kv: KVCache,
     write_slots: jnp.ndarray,  # [B*T] int32 flat slots for the new tokens (0=trash for pads)
-    slot_matrix: jnp.ndarray,  # [B, C] int32 per-sequence slot gather table
+    attn,                      # AttnSpec, or a raw [B, C] slot matrix (gather mode)
 ) -> tuple[jnp.ndarray, KVCache]:
     """One model step. Returns (hidden [B, T, D] after final norm, updated kv).
 
     Logits are computed by `logits()` on the (usually sliced) hidden states
     so prefill only pays the vocab matmul for the last position.
     """
+    if not isinstance(attn, AttnSpec):
+        attn = AttnSpec.gather(attn)
     x = params["embed"][tokens]
 
     inv_freq = jnp.asarray(rope_inv_freq(cfg))
@@ -114,7 +178,7 @@ def forward(
         attn_in = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
         attn_out, layer_k, layer_v = _attn_block(
             lp, cfg, attn_in, cos, sin, kv.k[l], kv.v[l],
-            write_slots, slot_matrix, positions,
+            write_slots, attn, positions,
         )
         x = x + attn_out
         mlp_in = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)

@@ -1,0 +1,237 @@
+"""Pallas TPU paged-attention decode kernel.
+
+The TPU-native answer to the GPU stack's paged-attention + block-copy
+kernels (reference: vLLM paged attention and
+lib/llm/src/kernels/block_copy.cu:41-731 — there paging is a copy problem
+bolted onto a dense kernel; here the kernel reads pages directly).
+
+Decode attention is HBM-bandwidth bound: each step must stream every live
+KV page exactly once. The jnp oracle (`ops/attention.py`) instead gathers
+the full `[B, max_context]` slot matrix per layer — materializing padded
+KV and paying gather latency. This kernel:
+
+- grids over the batch; each program walks ITS sequence's live pages only
+  (`ceil(len/page)` pages, not `max_pages_per_seq`),
+- double-buffers page DMAs from HBM into VMEM so copy overlaps compute,
+- reads each page ONCE for all KV heads (pages are `[page, K*Hd]` rows —
+  the flat-slot pool reshape anticipated in ops/attention.py:10-18),
+- runs flash-style online softmax (running max/denominator, rescaled
+  accumulator) so nothing [T]-sized ever materializes.
+
+Layout notes: the engine's pools are `[num_slots, K, Hd]` with
+`slot = page * page_size + offset`, so `[num_pages, page_size, K*Hd]` is a
+free reshape; a page row is `page_size × (K·Hd)` — contiguous, lane-aligned
+for Hd ∈ {64, 128}, and one DMA descriptor per page.
+
+Sharding: KV heads are the tp axis. The kernel is written for the
+per-shard view (local K heads); `shard_map` wrapping happens in the
+caller (ops/attention.py dispatch) so single-chip runs skip it.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+
+def _decode_kernel(
+    # scalar prefetch
+    lengths_ref,       # [B] i32: valid KV positions per sequence (0 = inactive)
+    tables_ref,        # [B, W] i32 page ids (W % pages_per_block == 0)
+    # inputs
+    q_ref,             # [H, Hd] this program's queries (pre-scaled)
+    k_pages_hbm,       # [num_pages, page_size, K*Hd] in HBM/ANY
+    v_pages_hbm,
+    # outputs
+    o_ref,             # [H, Hd]
+    # scratch
+    k_buf,             # [2, ppb, page_size, K*Hd] VMEM
+    v_buf,
+    k_sems,            # DMA sems [2]
+    v_sems,
+    acc,               # [H, Hd] f32 VMEM
+    m_scr,             # [H, 1] f32 VMEM running max
+    l_scr,             # [H, 1] f32 VMEM running denom
+    *,
+    num_kv_heads: int,
+    page_size: int,
+    pages_per_block: int,
+):
+    b = pl.program_id(0)
+    length = lengths_ref[b]
+    t_blk = pages_per_block * page_size
+    n_blocks = lax_cdiv(length, t_blk)
+
+    h, hd = q_ref.shape
+    g = h // num_kv_heads
+
+    def start_block_dma(blk, slot):
+        for p in range(pages_per_block):
+            page_id = tables_ref[b, blk * pages_per_block + p]
+            pltpu.make_async_copy(
+                k_pages_hbm.at[page_id], k_buf.at[slot, p], k_sems.at[slot]
+            ).start()
+            pltpu.make_async_copy(
+                v_pages_hbm.at[page_id], v_buf.at[slot, p], v_sems.at[slot]
+            ).start()
+
+    def wait_block_dma(slot):
+        # one wait per started copy: semaphores count completions
+        for _ in range(pages_per_block):
+            pltpu.make_async_copy(
+                k_pages_hbm.at[0], k_buf.at[slot, 0], k_sems.at[slot]
+            ).wait()
+            pltpu.make_async_copy(
+                v_pages_hbm.at[0], v_buf.at[slot, 0], v_sems.at[slot]
+            ).wait()
+
+    acc[...] = jnp.zeros_like(acc)
+    m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+    l_scr[...] = jnp.zeros_like(l_scr)
+    o_ref[...] = jnp.zeros_like(o_ref)
+
+    @pl.when(n_blocks > 0)
+    def _run():
+        start_block_dma(0, 0)
+
+        def body(i, _):
+            slot = jax.lax.rem(i, 2)
+
+            @pl.when(i + 1 < n_blocks)
+            def _prefetch():
+                start_block_dma(i + 1, 1 - slot)
+
+            wait_block_dma(slot)
+
+            kb = k_buf[slot].reshape(t_blk, num_kv_heads * q_ref.shape[1])
+            vb = v_buf[slot].reshape(t_blk, num_kv_heads * q_ref.shape[1])
+            qf = q_ref[...].astype(jnp.float32)
+
+            # scores [H, T_blk]: per-kv-head matmul on the local page block
+            parts = []
+            for k in range(num_kv_heads):
+                qk = qf[k * g : (k + 1) * g, :]                      # [G, Hd]
+                kk = kb[:, k * hd : (k + 1) * hd].astype(jnp.float32)  # [T, Hd]
+                parts.append(
+                    jax.lax.dot_general(
+                        qk, kk,
+                        dimension_numbers=(((1,), (1,)), ((), ())),
+                        preferred_element_type=jnp.float32,
+                    )
+                )
+            s = jnp.concatenate(parts, axis=0) if len(parts) > 1 else parts[0]
+
+            pos = i * t_blk + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(pos < length, s, _NEG_INF)
+
+            m_prev = m_scr[...]
+            l_prev = l_scr[...]
+            m_curr = jnp.max(s, axis=-1, keepdims=True)            # [H, 1]
+            m_next = jnp.maximum(m_prev, m_curr)
+            p_blk = jnp.exp(s - m_next)                             # [H, T]
+            l_curr = jnp.sum(p_blk, axis=-1, keepdims=True)
+            alpha = jnp.exp(m_prev - m_next)
+            l_next = alpha * l_prev + l_curr
+            m_scr[...] = m_next
+            l_scr[...] = l_next
+
+            outs = []
+            for k in range(num_kv_heads):
+                pv = p_blk[k * g : (k + 1) * g, :]                  # [G, T]
+                vv = vb[:, k * hd : (k + 1) * hd].astype(jnp.float32)
+                outs.append(
+                    jax.lax.dot_general(
+                        pv, vv,
+                        dimension_numbers=(((1,), (0,)), ((), ())),
+                        preferred_element_type=jnp.float32,
+                    )
+                )
+            o_curr = jnp.concatenate(outs, axis=0) if len(outs) > 1 else outs[0]
+            acc[...] = acc[...] * alpha + o_curr
+            return ()
+
+        jax.lax.fori_loop(0, n_blocks, body, ())
+        o_ref[...] = (acc[...] / jnp.maximum(l_scr[...], 1e-30)).astype(
+            o_ref.dtype
+        )
+
+
+def lax_cdiv(a, b: int):
+    return jax.lax.div(a + (b - 1), b)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=["page_size", "pages_per_block", "interpret"],
+)
+def paged_decode_attention(
+    q: jax.Array,             # [B, H, Hd] (rope applied, unscaled)
+    k_cache: jax.Array,       # [num_slots, K, Hd] flat slot pool
+    v_cache: jax.Array,
+    block_tables: jax.Array,  # [B, W] i32 page ids (0 = trash page)
+    lengths: jax.Array,       # [B] i32 valid KV positions (0 = inactive row)
+    *,
+    page_size: int,
+    pages_per_block: int = 8,
+    interpret: bool = False,
+) -> jax.Array:
+    """Flash paged decode attention; returns [B, H, Hd] in q.dtype."""
+    b, h, hd = q.shape
+    num_slots, kh, hd_k = k_cache.shape
+    assert hd == hd_k and h % kh == 0
+    num_pages = num_slots // page_size
+
+    w = block_tables.shape[1]
+    if w % pages_per_block:
+        pad = pages_per_block - w % pages_per_block
+        block_tables = jnp.pad(block_tables, ((0, 0), (0, pad)))
+
+    k_pages = k_cache.reshape(num_pages, page_size, kh * hd)
+    v_pages = v_cache.reshape(num_pages, page_size, kh * hd)
+
+    scale = hd ** -0.5
+    q = (q * scale).astype(q.dtype)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b,),
+        in_specs=[
+            pl.BlockSpec((None, h, hd), lambda b_, *_: (b_, 0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec((None, h, hd), lambda b_, *_: (b_, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((2, pages_per_block, page_size, kh * hd), k_cache.dtype),
+            pltpu.VMEM((2, pages_per_block, page_size, kh * hd), v_cache.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.VMEM((h, hd), jnp.float32),
+            pltpu.VMEM((h, 1), jnp.float32),
+            pltpu.VMEM((h, 1), jnp.float32),
+        ],
+    )
+
+    kernel = functools.partial(
+        _decode_kernel,
+        num_kv_heads=kh,
+        page_size=page_size,
+        pages_per_block=pages_per_block,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, hd), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",),
+        ),
+        interpret=interpret,
+    )(lengths.astype(jnp.int32), block_tables.astype(jnp.int32), q,
+      k_pages, v_pages)
+    return out

@@ -1,0 +1,120 @@
+"""Prefill ablations: why does a 512-token prefill cost ~57 ms?
+
+Run: python scripts/profile_prefill.py
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dynamo_tpu.models import llama
+from dynamo_tpu.models.config import get_config
+from dynamo_tpu.ops import attention as attn
+from dynamo_tpu.ops.sampling import sample_tokens
+
+CFG = get_config("llama-3.2-1b")
+PAGE = 16
+T = 512
+W = 38
+NUM_SLOTS = (8 * W + 17) * PAGE
+DTYPE = jnp.bfloat16
+
+
+def timeit(name, fn, *args, n=10, **kw):
+    jax.block_until_ready(fn(*args, **kw))
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn(*args, **kw)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / n
+    print(f"{name:55s} {dt*1000:9.2f} ms")
+    return dt
+
+
+def main():
+    params = llama.init_params(CFG, jax.random.PRNGKey(0), dtype=DTYPE)
+    kv = jax.device_put(llama.init_kv_cache(CFG, NUM_SLOTS, dtype=DTYPE))
+    ptok = jnp.ones((1, T), jnp.int32)
+    ppos = jnp.arange(T, dtype=jnp.int32)[None]
+    pws = jnp.asarray(np.arange(PAGE, PAGE + T), jnp.int32)
+    smat_full = jnp.asarray(
+        (np.arange(1, 1 + W)[:, None] * PAGE + np.arange(PAGE)).reshape(1, -1),
+        jnp.int32,
+    )
+    smat_tight = smat_full[:, : T]  # exactly the chunk's slots
+    key = jax.random.PRNGKey(0)
+    temp = jnp.zeros((1,), jnp.float32)
+    topk = jnp.zeros((1,), jnp.int32)
+    topp = jnp.ones((1,), jnp.float32)
+
+    def run(smat, attn_mode="gather", sample=True, batch=1):
+        tok = jnp.tile(ptok, (batch, 1))
+        pos = jnp.tile(ppos, (batch, 1))
+        ws = jnp.tile(pws, (batch,))  # aliasing writes; timing only
+        sm = jnp.tile(smat, (batch, 1))
+
+        def fn(params, kv, tok, pos, ws, sm, key):
+            real = attn.paged_attention
+            if attn_mode == "causal":
+                def causal(q, kc, vc, smat_, positions):
+                    b, t, h, hd = q.shape
+                    kh = kc.shape[1]
+                    # direct chunk attention: K/V just written are the chunk
+                    k = kc[smat_[:, : t]]
+                    v = vc[smat_[:, : t]]
+                    g = h // kh
+                    qg = q.reshape(b, t, kh, g, hd)
+                    lg = jnp.einsum(
+                        "btkgd,bskd->bkgts", qg, k,
+                        preferred_element_type=jnp.float32,
+                    ) * (hd ** -0.5)
+                    mask = (
+                        jnp.arange(t)[None, :] <= jnp.arange(t)[:, None]
+                    )[None, None, None]
+                    lg = jnp.where(mask, lg, -1e30)
+                    p = jax.nn.softmax(lg, axis=-1)
+                    o = jnp.einsum("bkgts,bskd->btkgd", p.astype(v.dtype), v)
+                    return o.reshape(b, t, h, hd)
+
+                attn.paged_attention = causal
+                llama.paged_attention = causal
+            elif attn_mode == "none":
+                attn.paged_attention = lambda q, *a: q
+                llama.paged_attention = attn.paged_attention
+            try:
+                hidden, kv2 = llama.forward(params, CFG, tok, pos, kv, ws, sm)
+            finally:
+                attn.paged_attention = real
+                llama.paged_attention = real
+            lg = llama.logits(params, CFG, hidden[:, -1])
+            if sample:
+                toks = sample_tokens(
+                    lg, key,
+                    jnp.tile(temp, (batch,)), jnp.tile(topk, (batch,)),
+                    jnp.tile(topp, (batch,)),
+                )
+            else:
+                toks = jnp.argmax(lg, -1)
+            return toks, kv2
+
+        return jax.jit(fn), (params, kv, tok, pos, ws, sm, key)
+
+    for name, (fn, args) in [
+        ("full prefill 512 (gather, C=608)", run(smat_full)),
+        ("gather C=512 (tight smat)", run(smat_tight)),
+        ("direct causal chunk attention", run(smat_tight, attn_mode="causal")),
+        ("no attention", run(smat_tight, attn_mode="none")),
+        ("batch=4 prefill, causal", run(smat_tight, attn_mode="causal", batch=4)),
+        ("batch=4 prefill, gather C=608", run(smat_full, batch=4)),
+    ]:
+        timeit(name, fn, *args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,124 @@
+"""Ablation profile of the 16-step decode scan: where do the ms/token go?
+
+Run: python scripts/profile_scan.py
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dynamo_tpu.models import llama
+from dynamo_tpu.models.config import get_config
+from dynamo_tpu.ops import attention as attn
+from dynamo_tpu.ops.sampling import sample_tokens
+
+CFG = get_config("llama-3.2-1b")
+PAGE = 16
+B = 8
+MAX_LEN = 608
+W = -(-MAX_LEN // PAGE)
+NUM_SLOTS = (B * W + 17) * PAGE
+DTYPE = jnp.bfloat16
+STEPS = 16
+
+
+def timeit(name, fn, *args, n=3, **kw):
+    jax.block_until_ready(fn(*args, **kw))
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn(*args, **kw)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / n
+    print(f"{name:50s} {dt*1000:9.2f} ms  ({dt*1000/STEPS:6.2f} /tok)")
+    return dt
+
+
+def make_scan(sample_mode="full", attn_mode="gather", logits_mode="full"):
+    temp = jnp.zeros((B,), jnp.float32)
+    topk = jnp.zeros((B,), jnp.int32)
+    topp = jnp.ones((B,), jnp.float32)
+
+    def decode_multi(params, kv, tokens, positions, tables, key):
+        s = PAGE
+        smat = (tables[:, :, None] * s + jnp.arange(s, dtype=jnp.int32)).reshape(B, -1)
+
+        def body(carry, _):
+            tokens, positions, kv, key = carry
+            key, sub = jax.random.split(key)
+            page_idx = jnp.minimum(positions // s, W - 1)
+            wslots = (
+                jnp.take_along_axis(tables, page_idx[:, None], axis=1)[:, 0] * s
+                + positions % s
+            )
+            wslots = jnp.where(positions < MAX_LEN, wslots, 0).astype(jnp.int32)
+
+            real_paged = attn.paged_attention
+            if attn_mode == "none":
+                attn.paged_attention = lambda q, kc, vc, sm, pos: q
+                llama.paged_attention = attn.paged_attention
+            try:
+                hidden, kv2 = llama.forward(
+                    params, CFG, tokens[:, None], positions[:, None], kv, wslots, smat
+                )
+            finally:
+                attn.paged_attention = real_paged
+                llama.paged_attention = real_paged
+
+            if logits_mode == "full":
+                lg = llama.logits(params, CFG, hidden[:, 0])
+            else:
+                lg = hidden[:, 0, : 128].astype(jnp.float32)  # skip vocab matmul
+
+            if sample_mode == "full":
+                toks = sample_tokens(lg, sub, temp, topk, topp)
+            else:
+                toks = jnp.argmax(lg, -1).astype(jnp.int32)
+            return (toks, positions + 1, kv2, key), toks
+
+        (_, _, kv, _), out = jax.lax.scan(
+            body, (tokens, positions, kv, key), None, length=STEPS
+        )
+        return out, kv
+
+    return jax.jit(decode_multi, donate_argnums=(1,))
+
+
+def main():
+    params = llama.init_params(CFG, jax.random.PRNGKey(0), dtype=DTYPE)
+    tables = np.stack([np.arange(1 + i * W, 1 + (i + 1) * W) for i in range(B)])
+    tables = jnp.asarray(tables, jnp.int32)
+    tokens = jnp.ones((B,), jnp.int32)
+    positions = jnp.full((B,), 500, jnp.int32)
+    key = jax.random.PRNGKey(0)
+
+    def fresh_kv():
+        return jax.device_put(llama.init_kv_cache(CFG, NUM_SLOTS, dtype=DTYPE))
+
+    for name, kw in [
+        ("full (baseline)", {}),
+        ("greedy-only sampling", dict(sample_mode="greedy")),
+        ("no attention", dict(attn_mode="none")),
+        ("no vocab logits+greedy", dict(logits_mode="none", sample_mode="greedy")),
+        ("no attn + no vocab + greedy",
+         dict(attn_mode="none", logits_mode="none", sample_mode="greedy")),
+    ]:
+        fn = make_scan(**kw)
+        kv = fresh_kv()
+        fn(params, kv, tokens, positions, tables, key)  # compile (donates kv)
+        kv = fresh_kv()
+        jax.block_until_ready(kv)
+        t0 = time.perf_counter()
+        out, kv = fn(params, kv, tokens, positions, tables, key)
+        jax.block_until_ready(out)
+        dt = time.perf_counter() - t0
+        print(f"{name:50s} {dt*1000:9.2f} ms  ({dt*1000/STEPS:6.2f} /tok)")
+
+
+if __name__ == "__main__":
+    main()

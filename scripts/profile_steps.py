@@ -1,0 +1,197 @@
+"""Microbenchmark of the compiled prefill/decode steps on the local chip.
+
+Times (a) the full decode multi-step dispatch, (b) a single decode step,
+(c) the prefill step, (d) attention-ablated variants to locate the cost.
+Run: python scripts/profile_steps.py
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dynamo_tpu.models import llama
+from dynamo_tpu.models.config import get_config
+from dynamo_tpu.ops import attention as attn
+from dynamo_tpu.ops.sampling import sample_tokens
+
+CFG = get_config("llama-3.2-1b")
+PAGE = 16
+B = 8
+MAX_LEN = 608
+W = -(-MAX_LEN // PAGE)  # pages per seq
+NUM_SLOTS = (B * W + 17) * PAGE
+DTYPE = jnp.bfloat16
+
+
+def timeit(name, fn, *args, n=5, **kw):
+    fn(*args, **kw)  # compile
+    jax.block_until_ready(fn(*args, **kw))
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn(*args, **kw)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / n
+    print(f"{name:45s} {dt*1000:9.2f} ms")
+    return dt
+
+
+def main():
+    dev = jax.devices()[0]
+    stats = dev.memory_stats() or {}
+    print("device:", dev, stats.get("bytes_limit", 0) / 1e9, "GB")
+
+    params = llama.init_params(CFG, jax.random.PRNGKey(0), dtype=DTYPE)
+    kv = llama.init_kv_cache(CFG, NUM_SLOTS, dtype=DTYPE)
+    kv = jax.device_put(kv)
+
+    tables = np.stack([np.arange(1 + i * W, 1 + (i + 1) * W) for i in range(B)])
+    tables = jnp.asarray(tables, jnp.int32)
+    tokens = jnp.ones((B,), jnp.int32)
+    positions = jnp.full((B,), 500, jnp.int32)
+    temp = jnp.zeros((B,), jnp.float32)
+    topk = jnp.zeros((B,), jnp.int32)
+    topp = jnp.ones((B,), jnp.float32)
+    key = jax.random.PRNGKey(0)
+
+    # single decode step (T=1), full forward
+    @jax.jit
+    def decode1(params, kv, tokens, positions, tables, key):
+        s = PAGE
+        smat = (tables[:, :, None] * s + jnp.arange(s, dtype=jnp.int32)).reshape(B, -1)
+        wslots = (
+            jnp.take_along_axis(tables, (positions // s)[:, None], axis=1)[:, 0] * s
+            + positions % s
+        ).astype(jnp.int32)
+        hidden, kv2 = llama.forward(
+            params, CFG, tokens[:, None], positions[:, None], kv, wslots, smat
+        )
+        lg = llama.logits(params, CFG, hidden[:, 0])
+        toks = sample_tokens(lg, key, temp, topk, topp)
+        return toks, kv2
+
+    t_step = timeit("decode single step (full fwd)", decode1,
+                    params, kv, tokens, positions, tables, key)
+
+    # forward with attention replaced by identity (isolates attention+gather)
+    real_paged = attn.paged_attention
+    try:
+        def fake_paged(q, k_cache, v_cache, slot_matrix, positions):
+            return q  # no gather, no softmax
+
+        attn.paged_attention = fake_paged
+        llama.paged_attention = fake_paged
+
+        @jax.jit
+        def decode1_noattn(params, kv, tokens, positions, tables, key):
+            s = PAGE
+            smat = (tables[:, :, None] * s + jnp.arange(s, dtype=jnp.int32)).reshape(B, -1)
+            wslots = (
+                jnp.take_along_axis(tables, (positions // s)[:, None], axis=1)[:, 0] * s
+                + positions % s
+            ).astype(jnp.int32)
+            hidden, kv2 = llama.forward(
+                params, CFG, tokens[:, None], positions[:, None], kv, wslots, smat
+            )
+            lg = llama.logits(params, CFG, hidden[:, 0])
+            toks = sample_tokens(lg, key, temp, topk, topp)
+            return toks, kv2
+
+        timeit("decode single step (attention ablated)", decode1_noattn,
+               params, kv, tokens, positions, tables, key)
+    finally:
+        attn.paged_attention = real_paged
+        llama.paged_attention = real_paged
+
+    # pure attention op at decode shapes, one layer x num_layers
+    q = jnp.ones((B, 1, CFG.num_heads, CFG.head_dim), DTYPE)
+    smat = (tables[:, :, None] * PAGE + jnp.arange(PAGE, dtype=jnp.int32)).reshape(B, -1)
+    kc = kv.k[0]
+    vc = kv.v[0]
+
+    @jax.jit
+    def attn_only(q, kc, vc, smat, positions):
+        return attn.paged_attention(q, kc, vc, smat, positions[:, None])
+
+    t_attn = timeit("paged_attention op (1 layer, decode)", attn_only,
+                    q, kc, vc, smat, positions)
+    print(f"{'  x num_layers':45s} {t_attn*1000*CFG.num_layers:9.2f} ms")
+
+    # gather only
+    @jax.jit
+    def gather_only(kc, vc, smat):
+        return kc[smat], vc[smat]
+
+    t_g = timeit("KV gather only (1 layer)", gather_only, kc, vc, smat)
+    print(f"{'  x num_layers':45s} {t_g*1000*CFG.num_layers:9.2f} ms")
+
+    # 16-step scan dispatch (what the engine does)
+    def decode_multi(params, kv, tokens, positions, tables, key):
+        s = PAGE
+        smat = (tables[:, :, None] * s + jnp.arange(s, dtype=jnp.int32)).reshape(B, -1)
+
+        def body(carry, _):
+            tokens, positions, kv, key = carry
+            key, sub = jax.random.split(key)
+            page_idx = jnp.minimum(positions // s, W - 1)
+            wslots = (
+                jnp.take_along_axis(tables, page_idx[:, None], axis=1)[:, 0] * s
+                + positions % s
+            )
+            wslots = jnp.where(positions < MAX_LEN, wslots, 0).astype(jnp.int32)
+            hidden, kv = llama.forward(
+                params, CFG, tokens[:, None], positions[:, None], kv, wslots, smat
+            )
+            lg = llama.logits(params, CFG, hidden[:, 0])
+            toks = sample_tokens(lg, sub, temp, topk, topp)
+            return (toks, positions + 1, kv, key), toks
+
+        (_, _, kv, _), out = jax.lax.scan(
+            body, (tokens, positions, kv, key), None, length=16
+        )
+        return out, kv
+
+    jmulti = jax.jit(decode_multi)
+    t16 = timeit("decode 16-step scan dispatch", jmulti,
+                 params, kv, tokens, positions, tables, key, n=3)
+    print(f"{'  per token':45s} {t16*1000/16:9.2f} ms")
+
+    # prefill 512
+    T = 512
+    ptok = jnp.ones((1, T), jnp.int32)
+    ppos = jnp.arange(T, dtype=jnp.int32)[None]
+    pws = jnp.asarray(np.arange(PAGE, PAGE + T), jnp.int32)
+    psmat = smat[:1]
+
+    @jax.jit
+    def prefill(params, kv, ptok, ppos, pws, psmat, key):
+        hidden, kv2 = llama.forward(params, CFG, ptok, ppos, kv, pws, psmat)
+        lg = llama.logits(params, CFG, hidden[:, -1])
+        toks = sample_tokens(lg, key, temp[:1], topk[:1], topp[:1])
+        return toks, kv2
+
+    timeit("prefill 512 dispatch", prefill, params, kv, ptok, ppos, pws, psmat, key, n=3)
+
+    # dispatch overhead: trivial op
+    @jax.jit
+    def triv(x):
+        return x + 1
+
+    x = jnp.ones((8, 128), DTYPE)
+    timeit("trivial dispatch (tunnel RTT)", triv, x, n=20)
+
+    # device->host transfer of a tiny array (the per-dispatch sync)
+    y = triv(x)
+    t0 = time.perf_counter()
+    for _ in range(20):
+        np.asarray(y)
+    print(f"{'tiny device->host':45s} {(time.perf_counter()-t0)/20*1000:9.2f} ms")
+
+
+if __name__ == "__main__":
+    main()
