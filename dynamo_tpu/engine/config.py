@@ -20,6 +20,10 @@ class EngineConfig:
     num_pages: Optional[int] = None  # total pages incl. trash page 0; None = auto from HBM
     hbm_utilization: float = 0.85    # fraction of free HBM given to KV when auto-sizing
 
+    # "auto": pallas paged kernel on TPU, gather oracle elsewhere;
+    # "pallas": force the kernel (interpret mode off-TPU); "gather": oracle
+    attn_backend: str = "auto"
+
     max_batch_size: int = 8       # decode slots
     max_model_len: int = 2048     # context limit per sequence
     prefill_chunk: int = 512      # longest single prefill call (longer prompts chunk)

@@ -102,11 +102,21 @@ class JaxEngine:
             )
             self._attn_interpret = False
         elif config.attn_backend == "pallas":
+            if config.mesh.num_devices > 1:
+                raise ValueError(
+                    "attn_backend='pallas' requires a single-device mesh for "
+                    "now (shard_map integration pending); use 'auto'"
+                )
             self._attn_pallas = True
             self._attn_interpret = backend != "tpu"
-        else:
+        elif config.attn_backend == "gather":
             self._attn_pallas = False
             self._attn_interpret = False
+        else:
+            raise ValueError(
+                f"unknown attn_backend {config.attn_backend!r}; "
+                "expected 'auto', 'pallas' or 'gather'"
+            )
 
         if params is None:
             if config.checkpoint_dir:
@@ -637,33 +647,23 @@ class JaxEngine:
 
     # ---- decode -------------------------------------------------------
 
-    async def _decode_tick(self) -> bool:
-        """Pipelined decode: enqueue dispatch N+1 (device token carry),
-        then sync + emit dispatch N's tokens while N+1 computes."""
-        prog = False
-        new = None
-        if not self._closed:
-            ready = [
-                (i, s)
-                for i, s in enumerate(self.slots)
-                if s is not None and not s.prefilling
-            ]
-            # cancellation sweep before building a dispatch
-            for i, s in ready:
-                if s.ctx.is_stopped():
-                    self._finish(s, FINISH_REASON_CANCELLED)
-            ready = [(i, s) for i, s in ready if self.slots[i] is s]
-            if ready:
-                new = self._dispatch_decode(ready)
-                prog = new is not None
-        old, self._inflight = self._inflight, new
-        if old is not None:
-            await self._sync_dispatch(old)
-            prog = True
-        elif self._pending_first:
-            await self._flush_first_tokens()
-            prog = True
-        return prog
+    def _maybe_dispatch_decode(self) -> Optional[_Dispatch]:
+        """Build and enqueue the next decode dispatch (device token carry),
+        after a cancellation sweep; returns None when nothing is decode-ready."""
+        if self._closed:
+            return None
+        ready = [
+            (i, s)
+            for i, s in enumerate(self.slots)
+            if s is not None and not s.prefilling
+        ]
+        for i, s in ready:
+            if s.ctx.is_stopped():
+                self._finish(s, FINISH_REASON_CANCELLED)
+        ready = [(i, s) for i, s in ready if self.slots[i] is s]
+        if not ready:
+            return None
+        return self._dispatch_decode(ready)
 
     def _dispatch_decode(self, ready) -> Optional[_Dispatch]:
         b = len(self.slots)

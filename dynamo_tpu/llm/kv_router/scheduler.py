@@ -12,6 +12,11 @@ scores each candidate worker
 max across candidate workers as the reference does) and the best logit
 wins, ties broken randomly. Every decision emits a KVHitRateEvent on the component's
 `kv-hit-rate` subject for the metrics plane.
+
+Deliberate deviation: when max_active == 0 the reference returns a
+NoEndpoints error (scheduler.rs:263); here every worker being idle simply
+zeroes the slot term — an all-idle pool is a fine place to schedule, not
+an error.
 """
 
 from __future__ import annotations
