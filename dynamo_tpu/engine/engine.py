@@ -138,8 +138,8 @@ class JaxEngine:
         num_slots = self.num_pages * self.page_size
         kv = llama.init_kv_cache(self.model_cfg, num_slots, dtype=self._dtype)
         self.kv = llama.KVCache(
-            k=jax.device_put(kv.k, self._kv_sharding),
-            v=jax.device_put(kv.v, self._kv_sharding),
+            k=tuple(jax.device_put(x, self._kv_sharding) for x in kv.k),
+            v=tuple(jax.device_put(x, self._kv_sharding) for x in kv.v),
         )
 
         self._event_seq = 0
@@ -170,14 +170,21 @@ class JaxEngine:
         self._decode_fn = jax.jit(self._decode_multi, donate_argnums=(1,))
         # disagg KV transfer: in-place scatter of received blocks / gather
         # of computed blocks (reference: the NIXL read/write data plane,
-        # patch nixl.py — here device<->host staged, see llm/disagg)
+        # patch nixl.py — here device<->host staged, see llm/disagg);
+        # wire format is layer-stacked [L, T, K*Hd]
         self._inject_fn = jax.jit(
             lambda kv, slots, nk, nv: llama.KVCache(
-                k=kv.k.at[:, slots].set(nk), v=kv.v.at[:, slots].set(nv)
+                k=tuple(x.at[slots].set(nk[l]) for l, x in enumerate(kv.k)),
+                v=tuple(x.at[slots].set(nv[l]) for l, x in enumerate(kv.v)),
             ),
             donate_argnums=(0,),
         )
-        self._extract_fn = jax.jit(lambda kv, slots: (kv.k[:, slots], kv.v[:, slots]))
+        self._extract_fn = jax.jit(
+            lambda kv, slots: (
+                jnp.stack([x[slots] for x in kv.k]),
+                jnp.stack([x[slots] for x in kv.v]),
+            )
+        )
 
     # ------------------------------------------------------------------
     # sizing
@@ -262,24 +269,36 @@ class JaxEngine:
         def body(carry, _):
             tokens, positions, kv, key = carry
             key, sub = jax.random.split(key)
-            page_idx = jnp.minimum(positions // s, w - 1)
-            wslots = (
-                jnp.take_along_axis(block_tables, page_idx[:, None], axis=1)[:, 0] * s
-                + positions % s
-            )
-            # inactive rows and positions past a finished sequence's budget
-            # must write the trash page, never a valid slot
-            wslots = jnp.where(
-                active & (positions < self.config.max_model_len), wslots, 0
-            ).astype(jnp.int32)
+            max_len = self.config.max_model_len
             if self._attn_pallas:
+                # fused path: the kernel owns the write — no slot scatter.
+                # write_pos -1 skips rows that are inactive or past the
+                # model-length budget (overshoot; outputs discarded)
+                wslots = jnp.zeros_like(positions)
                 attn = llama.AttnSpec.pallas_decode(
                     block_tables,
-                    jnp.where(active, positions + 1, 0).astype(jnp.int32),
+                    jnp.where(
+                        active, jnp.minimum(positions + 1, max_len), 0
+                    ).astype(jnp.int32),
                     s,
+                    write_pos=jnp.where(
+                        active & (positions < max_len), positions, -1
+                    ).astype(jnp.int32),
                     interpret=self._attn_interpret,
                 )
             else:
+                page_idx = jnp.minimum(positions // s, w - 1)
+                wslots = (
+                    jnp.take_along_axis(
+                        block_tables, page_idx[:, None], axis=1
+                    )[:, 0] * s
+                    + positions % s
+                )
+                # inactive rows and positions past a finished sequence's
+                # budget must write the trash page, never a valid slot
+                wslots = jnp.where(
+                    active & (positions < max_len), wslots, 0
+                ).astype(jnp.int32)
                 attn = llama.AttnSpec.gather(smat)
             hidden, kv = llama.forward(
                 params, self.model_cfg, tokens[:, None], positions[:, None],
@@ -356,7 +375,7 @@ class JaxEngine:
             else payload
         )
         m = self.model_cfg
-        want = (m.num_layers, len(pre.token_ids), m.num_kv_heads, m.head_dim)
+        want = (m.num_layers, len(pre.token_ids), m.num_kv_heads * m.head_dim)
         for name, arr in (("k", k_arr), ("v", v_arr)):
             if tuple(arr.shape) != want:
                 raise ValueError(
@@ -371,7 +390,7 @@ class JaxEngine:
         """Prefill-side disagg entry: compute the prompt's KV (+ first
         token), extract it host-side, and keep the pages in the prefix
         cache for future hits. Returns (first_token, k, v) with k/v shaped
-        [L, T, Kh, Hd]."""
+        [L, T, Kh*Hd]."""
         ctx = ctx or Context(pre.to_dict())
         usable_tokens = (self.num_pages - 1) * self.page_size
         if len(pre.token_ids) + 1 > usable_tokens:

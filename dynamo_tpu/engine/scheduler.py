@@ -53,7 +53,7 @@ class Sequence:
     device_pos: int = 0        # next position a decode dispatch will write
     # metadata attached to the first emitted token (prefix-hit stats etc.)
     first_meta: Optional[dict] = None
-    # disagg: (first_token, k [L,T,Kh,Hd], v) delivered by a remote prefill
+    # disagg: (first_token, k [L,T,Kh*Hd], v) delivered by a remote prefill
     # worker — admission injects this into pages instead of computing it
     preloaded: Optional[tuple] = None
 

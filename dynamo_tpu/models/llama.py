@@ -30,24 +30,31 @@ Params = dict[str, Any]
 
 
 class AttnSpec:
-    """How attention reads the paged KV pool — one of two modes, chosen
-    statically at trace time by which fields are populated:
+    """How attention reads (and the step writes) the paged KV pool — one of
+    three modes, chosen statically at trace time by which fields are
+    populated:
 
     - gather (oracle / prefill): `slot_matrix` [B, C] position-ordered
-      slots; runs `ops.attention.paged_attention` (pure jnp, any backend).
-    - pallas decode (T==1): `block_tables` [B, W] page ids + `lengths`
-      [B] valid-KV counts (0 = inactive row); runs the flash paged kernel
-      (`ops.pallas_attention`), walking only live pages.
+      slots; new KV is scattered by `write_kv_slots`, then
+      `ops.attention.paged_attention` (pure jnp, any backend) reads it.
+    - pallas decode, fused write (T==1): `block_tables` [B, W] page ids +
+      `lengths` [B] attended-KV counts + `write_pos` [B] (-1 = skip); the
+      flash paged kernel (`ops.pallas_attention`) injects the new token's
+      KV into its page in VMEM, writes only that page back, and attends —
+      no XLA scatter on the decode path.
+    - pallas decode, read-only: as above with `write_pos=None`; KV is
+      scattered first (oracle write), the kernel only reads.
 
     Registered as a pytree with `page_size`/`interpret` as static aux data
     so they stay Python values under jit.
     """
 
     def __init__(self, slot_matrix=None, block_tables=None, lengths=None,
-                 page_size: int = 16, interpret: bool = False):
+                 write_pos=None, page_size: int = 16, interpret: bool = False):
         self.slot_matrix = slot_matrix
         self.block_tables = block_tables
         self.lengths = lengths
+        self.write_pos = write_pos
         self.page_size = page_size
         self.interpret = interpret
 
@@ -56,10 +63,12 @@ class AttnSpec:
         return cls(slot_matrix=slot_matrix)
 
     @classmethod
-    def pallas_decode(cls, block_tables, lengths, page_size, interpret=False):
+    def pallas_decode(cls, block_tables, lengths, page_size, write_pos=None,
+                      interpret=False):
         return cls(
             block_tables=block_tables,
             lengths=lengths,
+            write_pos=write_pos,
             page_size=page_size,
             interpret=interpret,
         )
@@ -68,32 +77,53 @@ class AttnSpec:
 jax.tree_util.register_pytree_node(
     AttnSpec,
     lambda s: (
-        (s.slot_matrix, s.block_tables, s.lengths),
+        (s.slot_matrix, s.block_tables, s.lengths, s.write_pos),
         (s.page_size, s.interpret),
     ),
     lambda aux, children: AttnSpec(
         slot_matrix=children[0], block_tables=children[1], lengths=children[2],
-        page_size=aux[0], interpret=aux[1],
+        write_pos=children[3], page_size=aux[0], interpret=aux[1],
     ),
 )
 
 
 class KVCache(NamedTuple):
-    """Layer-stacked flat slot pools: k/v [num_layers, num_slots, K, Hd]."""
+    """Per-layer flat slot pools: k/v are length-L tuples of
+    [num_slots, K*Hd] arrays.
 
-    k: jnp.ndarray
-    v: jnp.ndarray
+    Two deliberate layout choices (both measured on v5e):
+
+    - per-layer buffers (not one stacked [L, ...] array) so each layer's
+      pool aliases straight through jit donation and the Pallas kernels —
+      the stacked layout forced an unstack/restack copy of the whole
+      cache every step (~36 ms at 1.3 GB);
+    - slots x (K*Hd) 2-D shape: for [N, K, Hd] XLA picks layout
+      major_to_minor=(1, 2, 0) — the slot dim minor-most — which makes a
+      "page" a strided scatter across the whole pool and every page DMA
+      ~15x slower. [N, K*Hd] keeps row-major tiling, so a page
+      ([page_size, K*Hd]) is one contiguous DMA and the reshape to
+      [num_pages, page_size, K*Hd] is a free bitcast."""
+
+    k: tuple
+    v: tuple
 
     @property
     def num_slots(self) -> int:
-        return self.k.shape[1]
+        return self.k[0].shape[0]
+
+    def stacked(self) -> tuple[jnp.ndarray, jnp.ndarray]:
+        """[L, N, K*Hd] copies (host extraction / wire format only)."""
+        return jnp.stack(self.k), jnp.stack(self.v)
 
 
 def init_kv_cache(
     cfg: ModelConfig, num_slots: int, dtype=jnp.bfloat16
 ) -> KVCache:
-    shape = (cfg.num_layers, num_slots, cfg.num_kv_heads, cfg.head_dim)
-    return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
+    shape = (num_slots, cfg.num_kv_heads * cfg.head_dim)
+    return KVCache(
+        k=tuple(jnp.zeros(shape, dtype) for _ in range(cfg.num_layers)),
+        v=tuple(jnp.zeros(shape, dtype) for _ in range(cfg.num_layers)),
+    )
 
 
 def _attn_block(
@@ -125,23 +155,41 @@ def _attn_block(
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
 
-    kv_k, kv_v = write_kv_slots(
-        kv_k, kv_v, write_slots, k.reshape(b * t, kh, hd), v.reshape(b * t, kh, hd)
-    )
-    if attn.block_tables is not None:
-        from dynamo_tpu.ops.pallas_attention import paged_decode_attention
+    if attn.block_tables is not None and attn.write_pos is not None:
+        from dynamo_tpu.ops.pallas_attention import fused_paged_decode_attention
 
-        out = paged_decode_attention(
+        out, kv_k, kv_v = fused_paged_decode_attention(
             q[:, 0],
+            k[:, 0].reshape(b, kh * hd),
+            v[:, 0].reshape(b, kh * hd),
             kv_k,
             kv_v,
             attn.block_tables,
             attn.lengths,
+            attn.write_pos,
             page_size=attn.page_size,
             interpret=attn.interpret,
-        )[:, None]
+        )
+        out = out[:, None]
     else:
-        out = paged_attention(q, kv_k, kv_v, attn.slot_matrix, positions)
+        kv_k, kv_v = write_kv_slots(
+            kv_k, kv_v, write_slots,
+            k.reshape(b * t, kh * hd), v.reshape(b * t, kh * hd),
+        )
+        if attn.block_tables is not None:
+            from dynamo_tpu.ops.pallas_attention import paged_decode_attention
+
+            out = paged_decode_attention(
+                q[:, 0],
+                kv_k,
+                kv_v,
+                attn.block_tables,
+                attn.lengths,
+                page_size=attn.page_size,
+                interpret=attn.interpret,
+            )[:, None]
+        else:
+            out = paged_attention(q, kv_k, kv_v, attn.slot_matrix, positions)
     return out.reshape(b, t, h * hd) @ lp["wo"], kv_k, kv_v
 
 
@@ -186,7 +234,7 @@ def forward(
         new_k_layers.append(layer_k)
         new_v_layers.append(layer_v)
 
-    kv = KVCache(k=jnp.stack(new_k_layers), v=jnp.stack(new_v_layers))
+    kv = KVCache(k=tuple(new_k_layers), v=tuple(new_v_layers))
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     return x, kv
 

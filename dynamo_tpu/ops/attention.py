@@ -9,13 +9,15 @@ the attention op).
 
 KV cache layout (per layer): flat **slot** pools
 
-    k_cache, v_cache : [num_slots, num_kv_heads, head_dim]
+    k_cache, v_cache : [num_slots, num_kv_heads * head_dim]
 
 where slot = page_id * page_size + offset. Pages exist only in the
 allocator; the device sees flat slots, so scatter (write) and gather (read)
-are single-index ops and a reshape to [num_pages, page_size, K, Hd] is free
-when a Pallas kernel wants page-granular DMA. Slot 0 lives in the reserved
-trash page: padded positions scatter there, and it is never allocated.
+are single-index ops and a reshape to [num_pages, page_size, K*Hd] is a
+free bitcast when a Pallas kernel wants page-granular DMA (the folded
+K*Hd trailing dim keeps XLA's layout row-major — see llama.KVCache).
+Slot 0 lives in the reserved trash page: padded positions scatter there,
+and it is never allocated.
 
 The unified step: new tokens' KV is **written first**, then queries attend
 over the sequence's gathered slots (which now include themselves) under the
@@ -39,10 +41,10 @@ _NEG_INF = -1e30
 
 
 def write_kv_slots(
-    k_cache: jnp.ndarray,  # [N, K, Hd]
+    k_cache: jnp.ndarray,  # [N, K*Hd]
     v_cache: jnp.ndarray,
     slots: jnp.ndarray,    # [M] int32 flat slot ids (0 = trash)
-    new_k: jnp.ndarray,    # [M, K, Hd]
+    new_k: jnp.ndarray,    # [M, K*Hd]
     new_v: jnp.ndarray,
 ):
     """Scatter per-token KV into the slot pool; in-place when donated.
@@ -67,7 +69,7 @@ def _masked_softmax(logits: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
 
 def paged_attention(
     q: jnp.ndarray,            # [B, T, H, Hd] (rope applied; KV already written)
-    k_cache: jnp.ndarray,      # [N, K, Hd]
+    k_cache: jnp.ndarray,      # [N, K*Hd]
     v_cache: jnp.ndarray,
     slot_matrix: jnp.ndarray,  # [B, C] int32: the sequence's slots, position-ordered
     positions: jnp.ndarray,    # [B, T] int32 absolute position of each query
@@ -77,18 +79,18 @@ def paged_attention(
     0-padded slot-table tails are masked out by the same comparison (their
     garbage KV rides the trash page)."""
     b, t, h, hd = q.shape
-    kh = k_cache.shape[1]
+    kh = k_cache.shape[1] // hd
     g = h // kh
     scale = hd ** -0.5
 
-    k = k_cache[slot_matrix]  # [B, C, K, Hd]
-    v = v_cache[slot_matrix]
+    c = slot_matrix.shape[1]
+    k = k_cache[slot_matrix].reshape(b, c, kh, hd)  # [B, C, K, Hd]
+    v = v_cache[slot_matrix].reshape(b, c, kh, hd)
     qg = q.reshape(b, t, kh, g, hd)
     logits = jnp.einsum(
         "btkgd,bskd->bkgts", qg, k, preferred_element_type=jnp.float32
     ) * scale  # [B, K, G, T, C]
 
-    c = slot_matrix.shape[1]
     j = jnp.arange(c)
     mask = j[None, None, :] <= positions[:, :, None]  # [B, T, C]
     mask = mask[:, None, None, :, :]

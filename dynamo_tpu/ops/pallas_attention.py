@@ -1,31 +1,41 @@
-"""Pallas TPU paged-attention decode kernel.
+"""Pallas TPU paged-attention decode kernel, fused with the KV-cache write.
 
 The TPU-native answer to the GPU stack's paged-attention + block-copy
 kernels (reference: vLLM paged attention and
 lib/llm/src/kernels/block_copy.cu:41-731 — there paging is a copy problem
-bolted onto a dense kernel; here the kernel reads pages directly).
+bolted onto a dense kernel; here the kernel reads pages directly and the
+cache update happens inside the same kernel).
 
 Decode attention is HBM-bandwidth bound: each step must stream every live
-KV page exactly once. The jnp oracle (`ops/attention.py`) instead gathers
-the full `[B, max_context]` slot matrix per layer — materializing padded
-KV and paying gather latency. This kernel:
+KV page exactly once. Design points (measured on v5e):
 
-- grids over the batch; each program walks ITS sequence's live pages only
-  (`ceil(len/page)` pages, not `max_pages_per_seq`),
-- double-buffers page DMAs from HBM into VMEM so copy overlaps compute,
-- reads each page ONCE for all KV heads (pages are `[page, K*Hd]` rows —
-  the flat-slot pool reshape anticipated in ops/attention.py:10-18),
-- runs flash-style online softmax (running max/denominator, rescaled
-  accumulator) so nothing [T]-sized ever materializes.
+- **one grid program over a flat work list**: the host side flattens
+  (sequence, page-block) pairs into a work queue; the kernel walks it in
+  a single fori loop with an NBUF-deep ring of DMA buffers, so page
+  streams stay full across sequence boundaries. A (batch,) grid paid
+  ~20 us of pipeline overhead per program; per-program double buffering
+  stalled at every sequence switch.
+- **fused cache write**: XLA lowers `pool.at[slots].set(rows)` to a
+  scatter the TPU backend serializes (~20 us/row); instead the kernel
+  injects the new token's K/V into its page while that page sits in VMEM
+  and writes only that page back — no scatter anywhere on the decode path.
+- **block-diagonal GQA matmuls**: per page-block the scores for ALL kv
+  heads come from ONE `[H, K*Hd] @ [K*Hd, T]` MXU dot — queries are laid
+  out block-diagonally (q for kv head k occupies columns [k*Hd,(k+1)*Hd)),
+  so cross-head products vanish by construction. The FLOP padding is free
+  (the MXU was idle); a per-head loop of [G,Hd] dots + a concat was the
+  compute bottleneck. The PV product is one `[H, T] @ [T, K*Hd]` dot whose
+  block-diagonal slice is selected outside the kernel.
+- pools are `[num_slots, K*Hd]` so pages ([page_size, K*Hd] rows) are
+  physically contiguous — XLA lays [N, K, Hd] out slot-minor, which turns
+  page DMA into a strided scatter (~15x slower).
 
-Layout notes: the engine's pools are `[num_slots, K, Hd]` with
-`slot = page * page_size + offset`, so `[num_pages, page_size, K*Hd]` is a
-free reshape; a page row is `page_size × (K·Hd)` — contiguous, lane-aligned
-for Hd ∈ {64, 128}, and one DMA descriptor per page.
+VMEM budget: q/out [B, H, K*Hd] + NBUF block buffers; at B=128, H=32,
+K*Hd=512, page 64 x ppb 4 x NBUF 4 that is ~10 MB.
 
 Sharding: KV heads are the tp axis. The kernel is written for the
 per-shard view (local K heads); `shard_map` wrapping happens in the
-caller (ops/attention.py dispatch) so single-chip runs skip it.
+caller so single-chip runs skip it.
 """
 
 from __future__ import annotations
@@ -42,38 +52,47 @@ _NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
 
 def _decode_kernel(
     # scalar prefetch
-    lengths_ref,       # [B] i32: valid KV positions per sequence (0 = inactive)
+    lengths_ref,       # [B] i32: attended KV count per sequence (0 = inactive)
     tables_ref,        # [B, W] i32 page ids (W % pages_per_block == 0)
-    # inputs
-    q_ref,             # [H, Hd] this program's queries (pre-scaled)
-    k_pages_hbm,       # [num_pages, page_size, K*Hd] in HBM/ANY
+    wpos_ref,          # [B] i32 position whose KV this step writes (-1 = none)
+    work_seq_ref,      # [MAXW] i32 sequence of each work item
+    work_blk_ref,      # [MAXW] i32 page-block index of each work item
+    n_work_ref,        # [1] i32 number of valid work items
+    # inputs (VMEM)
+    qb_ref,            # [B, H, K*Hd] block-diagonal queries (pre-scaled)
+    knew_ref,          # [B, 1, K*Hd] new-token key rows
+    vnew_ref,
+    # inputs (HBM)
+    k_pages_hbm,       # [num_pages, page_size, K*Hd]
     v_pages_hbm,
     # outputs
-    o_ref,             # [H, Hd]
+    o_ref,             # [B, H, K*Hd] VMEM (block-diag slice taken outside)
+    ko_pages_hbm,      # aliased k_pages_hbm
+    vo_pages_hbm,
     # scratch
-    k_buf,             # [2, ppb, page_size, K*Hd] VMEM
+    k_buf,             # [NBUF, ppb, page_size, K*Hd] VMEM
     v_buf,
-    k_sems,            # DMA sems [2]
+    k_sems,            # DMA sems [NBUF]
     v_sems,
-    acc,               # [H, Hd] f32 VMEM
-    m_scr,             # [H, 1] f32 VMEM running max
-    l_scr,             # [H, 1] f32 VMEM running denom
+    w_sem,             # DMA sem for page write-backs
+    wb_pending,        # SMEM [NBUF]: write-back in flight from this slot
     *,
-    num_kv_heads: int,
+    batch: int,
     page_size: int,
     pages_per_block: int,
+    nbuf: int,
+    ablate: str = "",   # perf bisection: "nocompute" | "empty"
 ):
-    b = pl.program_id(0)
-    length = lengths_ref[b]
     t_blk = pages_per_block * page_size
-    n_blocks = lax_cdiv(length, t_blk)
+    h = qb_ref.shape[1]
+    kw = qb_ref.shape[2]
+    n_work = n_work_ref[0]
 
-    h, hd = q_ref.shape
-    g = h // num_kv_heads
-
-    def start_block_dma(blk, slot):
+    def start_work_dma(w, slot):
+        seq = work_seq_ref[w]
+        blk = work_blk_ref[w]
         for p in range(pages_per_block):
-            page_id = tables_ref[b, blk * pages_per_block + p]
+            page_id = tables_ref[seq, blk * pages_per_block + p]
             pltpu.make_async_copy(
                 k_pages_hbm.at[page_id], k_buf.at[slot, p], k_sems.at[slot]
             ).start()
@@ -81,7 +100,7 @@ def _decode_kernel(
                 v_pages_hbm.at[page_id], v_buf.at[slot, p], v_sems.at[slot]
             ).start()
 
-    def wait_block_dma(slot):
+    def wait_work_dma(slot):
         # one wait per started copy: semaphores count completions
         for _ in range(pages_per_block):
             pltpu.make_async_copy(
@@ -91,75 +110,129 @@ def _decode_kernel(
                 v_pages_hbm.at[0], v_buf.at[slot, 0], v_sems.at[slot]
             ).wait()
 
-    acc[...] = jnp.zeros_like(acc)
-    m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
-    l_scr[...] = jnp.zeros_like(l_scr)
+    def drain_wb(slot):
+        # a pending page write-back reads from k_buf/v_buf[slot]; it must
+        # land before that slot is reused as a DMA-in target
+        @pl.when(wb_pending[slot] == 1)
+        def _():
+            pltpu.make_async_copy(
+                k_buf.at[0, 0], ko_pages_hbm.at[0], w_sem
+            ).wait()
+            pltpu.make_async_copy(
+                v_buf.at[0, 0], vo_pages_hbm.at[0], w_sem
+            ).wait()
+            wb_pending[slot] = 0
+
     o_ref[...] = jnp.zeros_like(o_ref)
+    for j in range(nbuf):
+        wb_pending[j] = 0
 
-    @pl.when(n_blocks > 0)
-    def _run():
-        start_block_dma(0, 0)
+        @pl.when(j < n_work)
+        def _prologue(j=j):
+            start_work_dma(j, j)
 
-        def body(i, _):
-            slot = jax.lax.rem(i, 2)
+    if ablate == "empty":
+        return
 
-            @pl.when(i + 1 < n_blocks)
-            def _prefetch():
-                start_block_dma(i + 1, 1 - slot)
+    def body(w, carry):
+        m_prev, l_prev, acc = carry
+        seq = work_seq_ref[w]
+        blk = work_blk_ref[w]
+        length = lengths_ref[seq]
+        wpos = wpos_ref[seq]
+        slot = jax.lax.rem(w, nbuf)
 
-            wait_block_dma(slot)
+        wait_work_dma(slot)
 
-            kb = k_buf[slot].reshape(t_blk, num_kv_heads * q_ref.shape[1])
-            vb = v_buf[slot].reshape(t_blk, num_kv_heads * q_ref.shape[1])
-            qf = q_ref[...].astype(jnp.float32)
+        # fresh sequence: reset the flash state
+        is_first = blk == 0
+        m_prev = jnp.where(is_first, jnp.full_like(m_prev, _NEG_INF), m_prev)
+        l_prev = jnp.where(is_first, jnp.zeros_like(l_prev), l_prev)
+        acc = jnp.where(is_first, jnp.zeros_like(acc), acc)
 
-            # scores [H, T_blk]: per-kv-head matmul on the local page block
-            parts = []
-            for k in range(num_kv_heads):
-                qk = qf[k * g : (k + 1) * g, :]                      # [G, Hd]
-                kk = kb[:, k * hd : (k + 1) * hd].astype(jnp.float32)  # [T, Hd]
-                parts.append(
-                    jax.lax.dot_general(
-                        qk, kk,
-                        dimension_numbers=(((1,), (1,)), ((), ())),
-                        preferred_element_type=jnp.float32,
-                    )
-                )
-            s = jnp.concatenate(parts, axis=0) if len(parts) > 1 else parts[0]
+        kb = k_buf[slot].reshape(t_blk, kw)
+        vb = v_buf[slot].reshape(t_blk, kw)
 
-            pos = i * t_blk + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        if ablate == "nocompute":
+            acc = acc + jnp.sum(kb.astype(jnp.float32)) * 0.0
+        else:
+            # fused cache update: inject the new token's K/V row into the
+            # block that owns position `wpos` (the final block), store the
+            # block back and write just that page to HBM
+            do_write = (wpos >= 0) & (blk == jax.lax.div(wpos, t_blk))
+            row = jax.lax.broadcasted_iota(jnp.int32, (t_blk, kw), 0)
+            off = wpos - blk * t_blk
+            inject = do_write & (row == off)
+            kb = jnp.where(inject, knew_ref[seq], kb)
+            vb = jnp.where(inject, vnew_ref[seq], vb)
+
+            @pl.when(do_write)
+            def _store_back():
+                k_buf[slot] = kb.reshape(pages_per_block, page_size, kw)
+                v_buf[slot] = vb.reshape(pages_per_block, page_size, kw)
+                p_local = jax.lax.div(off, page_size)
+                page_id = tables_ref[seq, jax.lax.div(wpos, page_size)]
+                pltpu.make_async_copy(
+                    k_buf.at[slot, p_local], ko_pages_hbm.at[page_id], w_sem
+                ).start()
+                pltpu.make_async_copy(
+                    v_buf.at[slot, p_local], vo_pages_hbm.at[page_id], w_sem
+                ).start()
+                wb_pending[slot] = 1
+
+            # ONE MXU dot for all kv heads: qb rows are zero outside their
+            # head's column block, so cross-head terms vanish
+            s = jax.lax.dot_general(
+                qb_ref[seq].astype(jnp.float32), kb.astype(jnp.float32),
+                dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # [H, T_blk]
+
+            pos = blk * t_blk + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
             s = jnp.where(pos < length, s, _NEG_INF)
 
-            m_prev = m_scr[...]
-            l_prev = l_scr[...]
             m_curr = jnp.max(s, axis=-1, keepdims=True)            # [H, 1]
             m_next = jnp.maximum(m_prev, m_curr)
             p_blk = jnp.exp(s - m_next)                             # [H, T]
             l_curr = jnp.sum(p_blk, axis=-1, keepdims=True)
             alpha = jnp.exp(m_prev - m_next)
             l_next = alpha * l_prev + l_curr
-            m_scr[...] = m_next
-            l_scr[...] = l_next
 
-            outs = []
-            for k in range(num_kv_heads):
-                pv = p_blk[k * g : (k + 1) * g, :]                  # [G, T]
-                vv = vb[:, k * hd : (k + 1) * hd].astype(jnp.float32)
-                outs.append(
-                    jax.lax.dot_general(
-                        pv, vv,
-                        dimension_numbers=(((1,), (0,)), ((), ())),
-                        preferred_element_type=jnp.float32,
-                    )
-                )
-            o_curr = jnp.concatenate(outs, axis=0) if len(outs) > 1 else outs[0]
-            acc[...] = acc[...] * alpha + o_curr
-            return ()
+            # ONE PV dot: [H, T] @ [T, K*Hd]; the caller keeps only each
+            # row's own head-column block
+            o_curr = jax.lax.dot_general(
+                p_blk, vb.astype(jnp.float32),
+                dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            acc = acc * alpha + o_curr
+            m_prev, l_prev = m_next, l_next
 
-        jax.lax.fori_loop(0, n_blocks, body, ())
-        o_ref[...] = (acc[...] / jnp.maximum(l_scr[...], 1e-30)).astype(
-            o_ref.dtype
-        )
+            # last block of this sequence: emit the normalized output
+            n_blocks = lax_cdiv(length, t_blk)
+
+            @pl.when(blk == n_blocks - 1)
+            def _emit():
+                o_ref[seq] = (
+                    acc / jnp.maximum(l_prev, 1e-30)
+                ).astype(o_ref.dtype)
+
+        # refill the ring with the work item NBUF ahead
+        nxt = w + nbuf
+
+        @pl.when(nxt < n_work)
+        def _refill():
+            drain_wb(slot)
+            start_work_dma(nxt, slot)
+
+        return m_prev, l_prev, acc
+
+    m0 = jnp.full((h, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((h, 1), jnp.float32)
+    a0 = jnp.zeros((h, kw), jnp.float32)
+    jax.lax.fori_loop(0, n_work, body, (m0, l0, a0))
+    for j in range(nbuf):
+        drain_wb(j)
 
 
 def lax_cdiv(a, b: int):
@@ -168,70 +241,163 @@ def lax_cdiv(a, b: int):
 
 @functools.partial(
     jax.jit,
-    static_argnames=["page_size", "pages_per_block", "interpret"],
+    static_argnames=["page_size", "pages_per_block", "nbuf", "interpret",
+                     "ablate", "alias_caches"],
 )
-def paged_decode_attention(
+def fused_paged_decode_attention(
     q: jax.Array,             # [B, H, Hd] (rope applied, unscaled)
-    k_cache: jax.Array,       # [num_slots, K, Hd] flat slot pool
+    new_k: jax.Array,         # [B, K*Hd] this step's K rows (rope applied)
+    new_v: jax.Array,         # [B, K*Hd]
+    k_cache: jax.Array,       # [num_slots, K*Hd] flat slot pool
     v_cache: jax.Array,
     block_tables: jax.Array,  # [B, W] i32 page ids (0 = trash page)
-    lengths: jax.Array,       # [B] i32 valid KV positions (0 = inactive row)
+    lengths: jax.Array,       # [B] i32 attended KV count incl. the new token
+    write_pos: jax.Array,     # [B] i32 position to store new_k/new_v (-1 = skip)
     *,
     page_size: int,
-    pages_per_block: int = 8,
+    pages_per_block: int = 4,
+    nbuf: int = 4,
     interpret: bool = False,
-) -> jax.Array:
-    """Flash paged decode attention; returns [B, H, Hd] in q.dtype."""
+    ablate: str = "",
+    alias_caches: bool = True,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Flash paged decode attention fused with the KV-cache update.
+
+    Returns (out [B, H, Hd], k_cache, v_cache); the caches are updated
+    in place (aliased) — the new token's row is injected into its page in
+    VMEM and only that page is written back, so there is no XLA scatter
+    anywhere on the decode path."""
     b, h, hd = q.shape
-    num_slots, kh, hd_k = k_cache.shape
-    assert hd == hd_k and h % kh == 0
+    num_slots, kw = k_cache.shape
+    assert kw % hd == 0
+    kh = kw // hd
+    assert h % kh == 0
+    g = h // kh
     num_pages = num_slots // page_size
+    t_blk = pages_per_block * page_size
 
     w = block_tables.shape[1]
     if w % pages_per_block:
         pad = pages_per_block - w % pages_per_block
         block_tables = jnp.pad(block_tables, ((0, 0), (0, pad)))
+    max_blocks = block_tables.shape[1] // pages_per_block
 
-    k_pages = k_cache.reshape(num_pages, page_size, kh * hd)
-    v_pages = v_cache.reshape(num_pages, page_size, kh * hd)
+    # flat work list: (sequence, page-block) pairs, empty rows skipped —
+    # the kernel's DMA ring stays full across sequence boundaries
+    lengths = lengths.astype(jnp.int32)
+    bps = (lengths + t_blk - 1) // t_blk                   # blocks per seq
+    csum = jnp.cumsum(bps)
+    n_work = csum[-1]
+    widx = jnp.arange(b * max_blocks, dtype=jnp.int32)
+    work_seq = jnp.searchsorted(csum, widx, side="right").astype(jnp.int32)
+    safe_seq = jnp.minimum(work_seq, b - 1)
+    work_blk = widx - (csum[safe_seq] - bps[safe_seq])
+    work_seq = jnp.where(widx < n_work, safe_seq, 0)
+    work_blk = jnp.where(widx < n_work, work_blk, 0).astype(jnp.int32)
 
+    # free bitcast: [N, K*Hd] row-major -> page-major view
+    k_pages = k_cache.reshape(num_pages, page_size, kw)
+    v_pages = v_cache.reshape(num_pages, page_size, kw)
+    new_k = new_k.reshape(b, 1, kw)
+    new_v = new_v.reshape(b, 1, kw)
+
+    # block-diagonal queries [B, H, K*Hd]: row r (a query head) carries its
+    # values in its kv head's column block, zeros elsewhere — one MXU dot
+    # then computes every head's scores with no cross-head leakage
     scale = hd ** -0.5
-    q = (q * scale).astype(q.dtype)
+    qs = (q * scale).astype(q.dtype)
+    q_tiled = jnp.tile(qs, (1, 1, kh))                       # [B, H, K*Hd]
+    col_head = (jnp.arange(kw, dtype=jnp.int32) // hd)[None, None, :]
+    row_head = (jnp.arange(h, dtype=jnp.int32) // g)[None, :, None]
+    qb = jnp.where(col_head == row_head, q_tiled, 0).astype(q.dtype)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
-        grid=(b,),
+        num_scalar_prefetch=6,
+        grid=(1,),
         in_specs=[
-            pl.BlockSpec((None, h, hd), lambda b_, *_: (b_, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
             pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec(memory_space=pl.ANY),
         ],
-        out_specs=pl.BlockSpec((None, h, hd), lambda b_, *_: (b_, 0, 0)),
+        out_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
         scratch_shapes=[
-            pltpu.VMEM((2, pages_per_block, page_size, kh * hd), k_cache.dtype),
-            pltpu.VMEM((2, pages_per_block, page_size, kh * hd), v_cache.dtype),
-            pltpu.SemaphoreType.DMA((2,)),
-            pltpu.SemaphoreType.DMA((2,)),
-            pltpu.VMEM((h, hd), jnp.float32),
-            pltpu.VMEM((h, 1), jnp.float32),
-            pltpu.VMEM((h, 1), jnp.float32),
+            pltpu.VMEM((nbuf, pages_per_block, page_size, kw), k_cache.dtype),
+            pltpu.VMEM((nbuf, pages_per_block, page_size, kw), v_cache.dtype),
+            pltpu.SemaphoreType.DMA((nbuf,)),
+            pltpu.SemaphoreType.DMA((nbuf,)),
+            pltpu.SemaphoreType.DMA,
+            pltpu.SMEM((nbuf,), jnp.int32),
         ],
     )
 
     kernel = functools.partial(
         _decode_kernel,
-        num_kv_heads=kh,
+        batch=b,
         page_size=page_size,
         pages_per_block=pages_per_block,
+        nbuf=nbuf,
+        ablate=ablate,
     )
-    out = pl.pallas_call(
+    out_full, k2, v2 = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((b, h, hd), q.dtype),
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("arbitrary",),
-        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, kw), q.dtype),
+            jax.ShapeDtypeStruct(k_pages.shape, k_cache.dtype),
+            jax.ShapeDtypeStruct(v_pages.shape, v_cache.dtype),
+        ],
+        # inputs: 0..5 = scalar prefetch, 6 = qb, 7/8 = new_k/new_v,
+        # 9/10 = k_pages/v_pages — aliased onto outputs 1/2 (skipped for
+        # read-only callers that keep using their input caches: aliasing
+        # would force XLA to defensively copy both pools)
+        input_output_aliases={9: 1, 10: 2} if alias_caches else {},
         interpret=interpret,
-    )(lengths.astype(jnp.int32), block_tables.astype(jnp.int32), q,
-      k_pages, v_pages)
+    )(lengths, block_tables.astype(jnp.int32), write_pos.astype(jnp.int32),
+      work_seq, work_blk, n_work[None], qb, new_k, new_v, k_pages, v_pages)
+
+    # block-diagonal slice: row r keeps its own head's column block
+    out = out_full.astype(jnp.float32).reshape(b, kh, g, kh, hd)
+    out = jnp.einsum("bkgkd->bkgd", out).reshape(b, h, hd).astype(q.dtype)
+    return (
+        out,
+        k2.reshape(num_slots, kw),
+        v2.reshape(num_slots, kw),
+    )
+
+
+def paged_decode_attention(
+    q: jax.Array,             # [B, H, Hd] (rope applied, unscaled)
+    k_cache: jax.Array,       # [num_slots, K*Hd] flat slot pool
+    v_cache: jax.Array,
+    block_tables: jax.Array,  # [B, W] i32 page ids (0 = trash page)
+    lengths: jax.Array,       # [B] i32 valid KV positions (0 = inactive row)
+    *,
+    page_size: int,
+    pages_per_block: int = 4,
+    interpret: bool = False,
+) -> jax.Array:
+    """Read-only flash paged decode attention (KV already written);
+    returns [B, H, Hd] in q.dtype."""
+    b = q.shape[0]
+    kw = k_cache.shape[1]
+    out, _, _ = fused_paged_decode_attention(
+        q,
+        jnp.zeros((b, kw), k_cache.dtype),
+        jnp.zeros((b, kw), v_cache.dtype),
+        k_cache,
+        v_cache,
+        block_tables,
+        lengths,
+        jnp.full((b,), -1, jnp.int32),
+        page_size=page_size,
+        pages_per_block=pages_per_block,
+        interpret=interpret,
+        alias_caches=False,
+    )
     return out

@@ -130,9 +130,11 @@ def param_shardings(cfg: ModelConfig, mesh: Mesh) -> dict:
 
 
 def kv_cache_sharding(mesh: Mesh) -> NamedSharding:
-    """KV pools [L, N_slots, K, Hd]: KV heads over tp — gathers/scatters
-    stay shard-local, no collectives on the KV path."""
-    return NamedSharding(mesh, P(None, None, "tp", None))
+    """Per-layer KV pools [N_slots, K*Hd]: the folded head dim over tp
+    (contiguous Hd-sized blocks per KV head, so tp shards land on whole
+    heads) — gathers/scatters stay shard-local, no collectives on the KV
+    path."""
+    return NamedSharding(mesh, P(None, "tp"))
 
 
 def token_sharding(mesh: Mesh) -> NamedSharding:

@@ -1,0 +1,131 @@
+"""Ablation profile of one decode step at serving batch size.
+
+Tunnel-aware: chain N donated dispatches, fetch one element once (see
+profile_decode.py docstring). Run: python scripts/profile_ablate.py [B]
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dynamo_tpu.models import llama
+from dynamo_tpu.models.config import get_config
+from dynamo_tpu.ops.sampling import sample_tokens
+
+B = int(sys.argv[1]) if len(sys.argv) > 1 else 64
+CFG = get_config("llama-3.2-1b")
+PAGE = 16
+MAX_LEN = 608
+W = -(-MAX_LEN // PAGE)
+NUM_SLOTS = (B * W + 17) * PAGE
+DTYPE = jnp.bfloat16
+
+
+def chain_kv(name, fn, kv, n=10):
+    kv = fn(kv)
+    _ = np.asarray(jax.tree.leaves(kv)[0].ravel()[:1])
+    t0 = time.perf_counter()
+    for _ in range(n):
+        kv = fn(kv)
+    _ = np.asarray(jax.tree.leaves(kv)[0].ravel()[:1])
+    dt = (time.perf_counter() - t0) / n
+    print(f"{name:52s} {dt*1000:9.2f} ms", flush=True)
+    return kv, dt
+
+
+def main():
+    params = llama.init_params(CFG, jax.random.PRNGKey(0), dtype=DTYPE)
+    kv = jax.device_put(llama.init_kv_cache(CFG, NUM_SLOTS, dtype=DTYPE))
+
+    tables_np = np.stack([np.arange(1 + i * W, 1 + (i + 1) * W) for i in range(B)])
+    tables = jnp.asarray(tables_np, jnp.int32)
+    tokens = jnp.ones((B,), jnp.int32)
+    positions = jnp.full((B,), 500, jnp.int32)
+    lengths = jnp.full((B,), 501, jnp.int32)
+    wpos = jnp.full((B,), 500, jnp.int32)
+    temp = jnp.zeros((B,), jnp.float32)
+    topk = jnp.zeros((B,), jnp.int32)
+    topp = jnp.ones((B,), jnp.float32)
+    key = jax.random.PRNGKey(0)
+    wslots = (
+        jnp.take_along_axis(tables, (positions // PAGE)[:, None], axis=1)[:, 0]
+        * PAGE + positions % PAGE
+    ).astype(jnp.int32)
+    smat = (tables[:, :, None] * PAGE + jnp.arange(PAGE, dtype=jnp.int32)).reshape(B, -1)
+
+    def mk_step(spec, with_logits=True, with_attn=True):
+        def step(params, kv):
+            hidden, kv = llama.forward(
+                params, CFG, tokens[:, None], positions[:, None], kv, wslots, spec
+            )
+            if with_logits:
+                lg = llama.logits(params, CFG, hidden[:, 0])
+                toks = sample_tokens(lg, key, temp, topk, topp)
+            else:
+                toks = jnp.sum(hidden)
+            return toks, kv
+        j = jax.jit(step, donate_argnums=(1,))
+        return lambda kv: j(params, kv)[1]
+
+    spec_g = llama.AttnSpec.gather(smat)
+    spec_f = llama.AttnSpec.pallas_decode(tables, lengths, PAGE, write_pos=wpos)
+
+    kv, _ = chain_kv("full step gather", mk_step(spec_g), kv)
+    kv, _ = chain_kv("full step fused-pallas (ppb=8)", mk_step(spec_f), kv)
+    kv, _ = chain_kv("gather step, no logits/sampling", mk_step(spec_g, with_logits=False), kv)
+
+    # attention+write fully ablated (keeps qkv/mlp/norm weights streaming)
+    import dynamo_tpu.ops.attention as A
+    real_write, real_attn = A.write_kv_slots, A.paged_attention
+    llama_write, llama_attn = llama.write_kv_slots, llama.paged_attention
+    try:
+        A.write_kv_slots = lambda kc, vc, s, nk, nv: (kc, vc)
+        llama.write_kv_slots = A.write_kv_slots
+        fake = lambda q, kc, vc, sm, pos: q
+        A.paged_attention = fake
+        llama.paged_attention = fake
+        kv, _ = chain_kv("step, attention+write ablated", mk_step(spec_g), kv)
+        kv, _ = chain_kv("step, attn+write+logits ablated",
+                         mk_step(spec_g, with_logits=False), kv)
+    finally:
+        A.write_kv_slots, A.paged_attention = real_write, real_attn
+        llama.write_kv_slots, llama.paged_attention = llama_write, llama_attn
+
+    # pallas kernel ppb variants, standalone chained via q feedback
+    from dynamo_tpu.ops.pallas_attention import fused_paged_decode_attention
+
+    for ppb in (4, 8, 16, 32):
+        if W % ppb and ppb > W:
+            continue
+        q0 = jnp.ones((B, CFG.num_heads, CFG.head_dim), DTYPE)
+        nk = jnp.ones((B, CFG.num_kv_heads, CFG.head_dim), DTYPE)
+
+        def attn_only(q, kvk, kvv):
+            o, kvk, kvv = fused_paged_decode_attention(
+                q, nk, nk, kvk, kvv, tables, lengths, wpos,
+                page_size=PAGE, pages_per_block=ppb)
+            return o, kvk, kvv
+
+        j = jax.jit(attn_only, donate_argnums=(1, 2))
+        kk, vv = kv.k[0], kv.v[0]
+        q, kk, vv = j(q0, kk, vv)
+        _ = np.asarray(q[0, 0, :1])
+        t0 = time.perf_counter()
+        for _ in range(20):
+            q, kk, vv = j(q, kk, vv)
+        _ = np.asarray(q[0, 0, :1])
+        t = (time.perf_counter() - t0) / 20
+        kv_read = B * 501 * CFG.num_kv_heads * CFG.head_dim * 2 * 2
+        print(f"{'fused kernel alone ppb=%d' % ppb:52s} {t*1000:9.2f} ms"
+              f"  ({kv_read/t/1e9:6.1f} GB/s, x{CFG.num_layers} = {t*1000*CFG.num_layers:6.1f} ms)",
+              flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,81 @@
+"""Profile the engine's real _decode_fn across batch sizes and backends.
+
+Tunnel-aware methodology (the bench chip sits behind an RPC tunnel with
+~120 ms fetch RTT, ~1.4 ms per-dispatch overhead, and a block_until_ready
+that does NOT wait for execution): chain N donated dispatches and fetch one
+element once, so per-iter = compute + dispatch overhead and the RTT
+amortizes away. Run: python scripts/profile_decode.py
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dynamo_tpu.engine import EngineConfig, JaxEngine
+
+ISL, OSL = 512, 64
+
+
+def time_decode(engine: JaxEngine, n=10):
+    cfg = engine.config
+    b = cfg.max_batch_size
+    w = cfg.max_pages_per_seq
+    tables = np.stack([np.arange(1 + i * w, 1 + (i + 1) * w) for i in range(b)])
+    args = (
+        jnp.ones((b,), jnp.int32),
+        jnp.full((b,), ISL, jnp.int32),
+        jnp.asarray(tables, jnp.int32),
+        jnp.ones((b,), bool),
+        jnp.zeros((b,), jnp.float32),
+        jnp.zeros((b,), jnp.int32),
+        jnp.ones((b,), jnp.float32),
+        jax.random.PRNGKey(0),
+    )
+    kv = engine.kv
+    out, kv = engine._decode_fn(engine.params, kv, *args)
+    _ = np.asarray(out[-1, :1])  # force warmup completion
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out, kv = engine._decode_fn(engine.params, kv, *args)
+    _ = np.asarray(out[-1, :1])
+    dt = (time.perf_counter() - t0) / n
+    engine.kv = kv
+    return dt
+
+
+def main():
+    for backend in ("pallas", "gather"):
+        for b in (8, 32, 64, 128):
+            eng = JaxEngine(
+                EngineConfig(
+                    model="llama-3.2-1b",
+                    dtype="bfloat16",
+                    page_size=16,
+                    max_batch_size=b,
+                    max_model_len=ISL + OSL + 32,
+                    prefill_chunk=ISL,
+                    decode_steps=16,
+                    attn_backend=backend,
+                )
+            )
+            try:
+                dt = time_decode(eng)
+                per_tok = dt / eng.config.decode_steps
+                print(
+                    f"backend={backend:7s} B={b:4d}  dispatch={dt*1000:8.2f} ms  "
+                    f"per-step={per_tok*1000:7.2f} ms  "
+                    f"toks/s={b/per_tok:10.1f}",
+                    flush=True,
+                )
+            finally:
+                del eng
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,95 @@
+"""Raw Pallas page-DMA microbenchmark: how fast can one program stream
+scattered pages HBM->VMEM at varying buffer depth and page size?
+Bounds the paged-attention kernel. Run: python scripts/profile_dma.py
+"""
+
+import functools
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def make_bench(num_pages_total, page, kw, n_pages, nbuf, dtype):
+    def kernel(tables_ref, pages_hbm, out_ref, bufs, sems):
+        # prologue: fill the pipeline
+        for j in range(nbuf):
+            pltpu.make_async_copy(
+                pages_hbm.at[tables_ref[j]], bufs.at[j], sems.at[j]
+            ).start()
+
+        def body(i, acc):
+            slot = jax.lax.rem(i, nbuf)
+            pltpu.make_async_copy(
+                pages_hbm.at[0], bufs.at[slot], sems.at[slot]
+            ).wait()
+            # touch the buffer so the copy isn't dead
+            acc = acc + jnp.sum(bufs[slot, 0].astype(jnp.float32)) * 0.0
+            nxt = i + nbuf
+
+            @pl.when(nxt < n_pages)
+            def _():
+                pltpu.make_async_copy(
+                    pages_hbm.at[tables_ref[nxt]], bufs.at[slot], sems.at[slot]
+                ).start()
+
+            return acc
+
+        acc = jax.lax.fori_loop(0, n_pages, body, 0.0)
+        out_ref[0, 0] = acc
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(1,),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.SMEM),
+        scratch_shapes=[
+            pltpu.VMEM((nbuf, page, kw), dtype),
+            pltpu.SemaphoreType.DMA((nbuf,)),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((1, 1), jnp.float32),
+    )
+
+
+def main():
+    kw = 512
+    dtype = jnp.bfloat16
+    rng = np.random.RandomState(0)
+    for page in (16, 64, 128, 256):
+        total_pages = (1 << 24) // (page * kw * 2)  # 16MB pool? use 512MB
+        total_pages = max(total_pages, 4096)
+        pool = jnp.zeros((total_pages, page, kw), dtype)
+        n_pages = min(total_pages, (64 * 1024 * 1024) // (page * kw * 2))  # stream 64MB
+        for nbuf in (2, 4, 8, 16):
+            tables = jnp.asarray(
+                rng.permutation(total_pages)[:n_pages], jnp.int32
+            )
+            bench = make_bench(total_pages, page, kw, n_pages, nbuf, dtype)
+            f = jax.jit(lambda t, p: bench(t, p))
+            o = f(tables, pool)
+            _ = np.asarray(o)
+            t0 = time.perf_counter()
+            for _ in range(10):
+                o = f(tables, pool)
+            _ = np.asarray(o)
+            t = (time.perf_counter() - t0) / 10
+            data = n_pages * page * kw * 2
+            print(
+                f"page={page:4d} ({page*kw*2//1024:4d}KB) nbuf={nbuf:3d}: "
+                f"{t*1000:7.2f} ms for {data>>20} MB -> {data/t/1e9:7.1f} GB/s",
+                flush=True,
+            )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,108 @@
+"""In-scan ablation: time the engine-shaped 16-step decode scan with
+components knocked out, at serving batch. The scan amortizes dispatch
+overhead so numbers are stable through the tunnel.
+Run: python scripts/profile_scan_ablate.py [B]
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dynamo_tpu.models import llama
+from dynamo_tpu.models.config import get_config
+from dynamo_tpu.ops.sampling import sample_tokens
+
+B = int(sys.argv[1]) if len(sys.argv) > 1 else 64
+CFG = get_config("llama-3.2-1b")
+PAGE = int(os.environ.get("PROF_PAGE", "16"))
+MAX_LEN = 640
+W = -(-MAX_LEN // PAGE)
+NUM_SLOTS = (B * W + 17) * PAGE
+DTYPE = jnp.bfloat16
+STEPS = 16
+
+
+def scan_step(mode, with_logits, with_attn, ppb=8):
+    tables_np = np.stack([np.arange(1 + i * W, 1 + (i + 1) * W) for i in range(B)])
+    tables = jnp.asarray(tables_np, jnp.int32)
+    temp = jnp.zeros((B,), jnp.float32)
+    topk = jnp.zeros((B,), jnp.int32)
+    topp = jnp.ones((B,), jnp.float32)
+    smat = (tables[:, :, None] * PAGE + jnp.arange(PAGE, dtype=jnp.int32)).reshape(B, -1)
+
+    def multi(params, kv, tokens, positions, key):
+        def body(carry, _):
+            tokens, positions, kv, key = carry
+            key, sub = jax.random.split(key)
+            wslots = (
+                jnp.take_along_axis(tables, (positions // PAGE)[:, None], axis=1)[:, 0]
+                * PAGE + positions % PAGE
+            ).astype(jnp.int32)
+            if mode == "fused":
+                spec = llama.AttnSpec.pallas_decode(
+                    tables, positions + 1, PAGE, write_pos=positions)
+                spec.pages_per_block = ppb
+            else:
+                spec = llama.AttnSpec.gather(smat)
+            hidden, kv = llama.forward(
+                params, CFG, tokens[:, None], positions[:, None], kv, wslots, spec
+            )
+            if with_logits:
+                lg = llama.logits(params, CFG, hidden[:, 0])
+                toks = sample_tokens(lg, sub, temp, topk, topp)
+            else:
+                toks = tokens
+            return (toks, positions + 1, kv, key), toks
+
+        (_, _, kv, _), out = jax.lax.scan(
+            body, (tokens, positions, kv, key), None, length=STEPS)
+        return out, kv
+
+    return multi
+
+
+def run(name, mode, with_logits=True, with_attn=True, n=6):
+    import dynamo_tpu.ops.attention as A
+    params = llama.init_params(CFG, jax.random.PRNGKey(0), dtype=DTYPE)
+    kv = jax.device_put(llama.init_kv_cache(CFG, NUM_SLOTS, dtype=DTYPE))
+    tokens = jnp.ones((B,), jnp.int32)
+    positions = jnp.full((B,), 480, jnp.int32)
+    key = jax.random.PRNGKey(0)
+
+    real_write, real_attn = A.write_kv_slots, A.paged_attention
+    lw, la = llama.write_kv_slots, llama.paged_attention
+    try:
+        if not with_attn:
+            A.write_kv_slots = lambda kc, vc, s, nk, nv: (kc, vc)
+            llama.write_kv_slots = A.write_kv_slots
+            fake = lambda q, kc, vc, sm, pos: q
+            A.paged_attention = fake
+            llama.paged_attention = fake
+        f = jax.jit(scan_step(mode, with_logits, with_attn), donate_argnums=(1,))
+        out, kv = f(params, kv, tokens, positions, key)
+        _ = np.asarray(out[-1, :1])
+        t0 = time.perf_counter()
+        for _ in range(n):
+            out, kv = f(params, kv, tokens, positions, key)
+        _ = np.asarray(out[-1, :1])
+        dt = (time.perf_counter() - t0) / n / STEPS
+        print(f"{name:55s} {dt*1000:8.2f} ms/step  ({B/dt:8.0f} tok/s)", flush=True)
+    finally:
+        A.write_kv_slots, A.paged_attention = real_write, real_attn
+        llama.write_kv_slots, llama.paged_attention = lw, la
+    del params, kv
+
+
+if __name__ == "__main__":
+    print(f"B={B}")
+    run("gather full", "gather")
+    run("gather, no attention/write", "gather", with_attn=False)
+    run("gather, no logits/sampling", "gather", with_logits=False)
+    run("no attn, no logits (weights floor)", "gather", with_logits=False, with_attn=False)
+    run("fused-pallas full", "fused")

@@ -79,7 +79,7 @@ async def test_prefill_extract_inject_roundtrip():
     )
 
     first, k, v = await prefill_engine.prefill_only(greedy(prompt, 6))
-    assert k.shape == (CFG.num_layers, 40, CFG.num_kv_heads, CFG.head_dim)
+    assert k.shape == (CFG.num_layers, 40, CFG.num_kv_heads * CFG.head_dim)
     assert first == ref_tokens[0]
 
     tokens, frames = await collect(

@@ -49,8 +49,8 @@ def test_tp_forward_matches_single_device():
     sp = meshmod.shard_params(params, CFG, m)
     kv = llama.init_kv_cache(CFG, 64, dtype=jnp.float32)
     kv = llama.KVCache(
-        k=jax.device_put(kv.k, meshmod.kv_cache_sharding(m)),
-        v=jax.device_put(kv.v, meshmod.kv_cache_sharding(m)),
+        k=tuple(jax.device_put(x, meshmod.kv_cache_sharding(m)) for x in kv.k),
+        v=tuple(jax.device_put(x, meshmod.kv_cache_sharding(m)) for x in kv.v),
     )
     with jax.set_mesh(m):
         tp_logits, kv_out = run(sp, kv)
@@ -59,8 +59,8 @@ def test_tp_forward_matches_single_device():
         np.asarray(tp_logits), np.asarray(ref_logits), rtol=1e-4, atol=1e-4
     )
     # KV pools kept their sharding (no accidental gather-to-host-layout)
-    assert kv_out.k.sharding.is_equivalent_to(
-        meshmod.kv_cache_sharding(m), kv_out.k.ndim
+    assert kv_out.k[0].sharding.is_equivalent_to(
+        meshmod.kv_cache_sharding(m), kv_out.k[0].ndim
     )
 
 

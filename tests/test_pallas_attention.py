@@ -10,7 +10,10 @@ import numpy as np
 import pytest
 
 from dynamo_tpu.ops.attention import paged_attention, slots_from_pages
-from dynamo_tpu.ops.pallas_attention import paged_decode_attention
+from dynamo_tpu.ops.pallas_attention import (
+    fused_paged_decode_attention,
+    paged_decode_attention,
+)
 
 PAGE = 16
 
@@ -19,8 +22,8 @@ def _setup(b, h, kh, hd, w, lengths, seed=0):
     rng = np.random.RandomState(seed)
     num_pages = b * w + 1
     num_slots = num_pages * PAGE
-    k_cache = rng.randn(num_slots, kh, hd).astype(np.float32)
-    v_cache = rng.randn(num_slots, kh, hd).astype(np.float32)
+    k_cache = rng.randn(num_slots, kh * hd).astype(np.float32)
+    v_cache = rng.randn(num_slots, kh * hd).astype(np.float32)
     q = rng.randn(b, h, hd).astype(np.float32)
     # per-sequence page tables: disjoint pages, 0-padded tails
     tables = np.zeros((b, w), np.int32)
@@ -84,6 +87,48 @@ def test_bf16_inputs_close():
     want = _oracle(q, kc, vc, tables, lens)
     np.testing.assert_allclose(
         np.asarray(got, np.float32), np.asarray(want), rtol=0.05, atol=0.05
+    )
+
+
+@pytest.mark.parametrize(
+    "b,h,kh,hd,w,wpos",
+    [
+        # mid-page, page-boundary (next write = first slot of its page),
+        # inactive, block-boundary (first slot of block 2)
+        (4, 8, 2, 64, 16, [37, 47, -1, 128]),
+        (2, 32, 8, 64, 16, [0, 200]),   # very first token; long seq
+    ],
+)
+def test_fused_write_matches_scatter_oracle(b, h, kh, hd, w, wpos):
+    """The fused kernel must (a) leave the caches exactly as a scatter
+    would and (b) attend over the cache *including* the new token."""
+    wpos = np.asarray(wpos, np.int32)
+    lengths = np.where(wpos >= 0, wpos + 1, 0).astype(np.int32)
+    q, kc, vc, tables, lens = _setup(b, h, kh, hd, w, lengths.tolist())
+    rng = np.random.RandomState(1)
+    new_k = jnp.asarray(rng.randn(b, kh * hd).astype(np.float32))
+    new_v = jnp.asarray(rng.randn(b, kh * hd).astype(np.float32))
+
+    got, k2, v2 = fused_paged_decode_attention(
+        q, new_k, new_v, kc, vc, tables, lens, jnp.asarray(wpos),
+        page_size=PAGE, pages_per_block=4, interpret=True,
+    )
+
+    # oracle: scatter the rows, then gather-attention
+    ek, ev = np.asarray(kc).copy(), np.asarray(vc).copy()
+    tb = np.asarray(tables)
+    for i in range(b):
+        if wpos[i] >= 0:
+            slot = tb[i, wpos[i] // PAGE] * PAGE + wpos[i] % PAGE
+            ek[slot] = np.asarray(new_k)[i]
+            ev[slot] = np.asarray(new_v)[i]
+    np.testing.assert_array_equal(np.asarray(k2), ek)
+    np.testing.assert_array_equal(np.asarray(v2), ev)
+
+    want = _oracle(q, jnp.asarray(ek), jnp.asarray(ev), tables, lens)
+    active = lengths > 0
+    np.testing.assert_allclose(
+        np.asarray(got)[active], np.asarray(want)[active], rtol=2e-5, atol=2e-5
     )
 
 
