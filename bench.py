@@ -27,7 +27,7 @@ _8B_PARAMS = 8.03e9
 
 ISL = int(os.environ.get("BENCH_ISL", "512"))
 OSL = int(os.environ.get("BENCH_OSL", "64"))
-CONCURRENCY = int(os.environ.get("BENCH_CONCURRENCY", "8"))
+CONCURRENCY = int(os.environ.get("BENCH_CONCURRENCY", "256"))
 DECODE_STEPS = int(os.environ.get("BENCH_DECODE_STEPS", "16"))
 
 
@@ -52,7 +52,6 @@ def main() -> None:
         EngineConfig(
             model=cfg,
             dtype="bfloat16",
-            page_size=16,
             max_batch_size=CONCURRENCY,
             max_model_len=ISL + OSL + 32,
             prefill_chunk=ISL,
@@ -87,10 +86,14 @@ def main() -> None:
         record["tokens"] = len(ticks)
 
     async def run():
-        # warmup compiles prefill + decode shapes; a distinct prompt so no
-        # measured request rides the warmup's prefix cache
-        warm = {}
-        await one(rng.randint(1, cfg.vocab_size, size=ISL).tolist(), warm)
+        # warmup at FULL concurrency so every compiled shape family
+        # (prefill group sizes, decode batch) is built before measuring;
+        # distinct prompts so no measured request rides the prefix cache
+        warm_prompts = [
+            rng.randint(1, cfg.vocab_size, size=ISL).tolist()
+            for _ in range(CONCURRENCY)
+        ]
+        await asyncio.gather(*(one(p, {}) for p in warm_prompts))
         t0 = time.perf_counter()
         records = [dict() for _ in prompts]
         await asyncio.gather(*(one(p, r) for p, r in zip(prompts, records)))

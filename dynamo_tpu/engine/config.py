@@ -16,7 +16,10 @@ class EngineConfig:
     mesh: MeshConfig = field(default_factory=MeshConfig)
     dtype: str = "bfloat16"
 
-    page_size: int = 16           # tokens per KV page (block_size in KV events)
+    # tokens per KV page (= block_size in KV events). 64 keeps page DMAs
+    # >= 64 KB on the fused decode kernel's critical path; drop to 16 for
+    # finer prefix-cache granularity at some decode-bandwidth cost
+    page_size: int = 64
     num_pages: Optional[int] = None  # total pages incl. trash page 0; None = auto from HBM
     hbm_utilization: float = 0.85    # fraction of free HBM given to KV when auto-sizing
 
@@ -27,6 +30,10 @@ class EngineConfig:
     max_batch_size: int = 8       # decode slots
     max_model_len: int = 2048     # context limit per sequence
     prefill_chunk: int = 512      # longest single prefill call (longer prompts chunk)
+    # activation-memory cap: total tokens (rows x bucket) in one batched
+    # prefill dispatch — bounds the [n, bucket, heads, hd] temporaries a
+    # big admission wave would otherwise OOM on
+    prefill_group_tokens: int = 32768
     decode_steps: int = 8         # decode steps per jit dispatch (lax.scan):
     # amortizes host<->device round trips; finished sequences overshoot at
     # most decode_steps-1 positions (discarded host-side)

@@ -16,14 +16,16 @@ for XLA's compile-once regime:
   allocator, slots and queues.
 
 Scheduling (one loop tick): admit waiting sequences into free slots, run at
-most ONE prefill chunk, then one decode dispatch — so a long prompt never
-stalls active decode streams for more than a chunk (the reference's disagg
-rationale, reference docs/disagg_serving.md:1-10, applied to aggregated
-serving).
+most ONE prefill chunk per sequence — same-bucket chunks batched into one
+`[n, bucket]` dispatch, capped by `prefill_group_tokens` — then one decode
+dispatch, so a long prompt never stalls active decode streams for more than
+a chunk (the reference's disagg rationale, reference
+docs/disagg_serving.md:1-10, applied to aggregated serving).
 
-Decode is **pipelined**: dispatch N+1 is enqueued (using the on-device
-sampled tokens of dispatch N as carry — no host round trip) before N's
-tokens are fetched for emission, so host work overlaps device compute.
+Decode is **pipelined**: dispatch N+1 is enqueued in a worker thread (using
+the on-device sampled tokens of dispatch N as carry — no host round trip)
+while N's tokens are fetched for emission, so host work overlaps device
+compute.
 Overshoot tokens of sequences that finished in N are discarded at sync;
 their trailing writes land in pages that are never hash-registered, so the
 prefix cache stays sound.
@@ -39,6 +41,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import threading
 from collections import deque
 from typing import AsyncIterator, Callable, Optional
 
@@ -74,6 +77,18 @@ class _Dispatch:
         self.out_dev = out_dev          # [steps, B] device array
         self.snapshot = snapshot        # list[(slot_index, Sequence)]
         self.steps = steps
+
+
+class _DecodeBuild:
+    """Host-built inputs for one decode dispatch (see
+    JaxEngine._maybe_dispatch_decode)."""
+
+    __slots__ = ("positions", "tables", "act", "temp", "topk", "topp",
+                 "overrides", "active", "steps", "all_greedy")
+
+    def __init__(self, **kw):
+        for k, v in kw.items():
+            setattr(self, k, v)
 
 
 class JaxEngine:
@@ -153,8 +168,13 @@ class JaxEngine:
         self._prefilling: deque[Sequence] = deque()
         self._inflight: Optional[_Dispatch] = None
         self._carry_toks = jnp.zeros(config.max_batch_size, jnp.int32)
-        self._overrides: dict[int, object] = {}   # slot -> device scalar | int
-        self._pending_first: list[tuple[Sequence, object]] = []
+        # slot -> first-token carry override: (device token vector, row)
+        # from a batched prefill dispatch, or a host int (disagg inject)
+        self._overrides: dict[int, object] = {}
+        # serializes the donated self.kv (and self._key) between the
+        # decode worker thread and prefill dispatches the event-loop
+        # thread may run concurrently via the public prefill_only path
+        self._kv_lock = threading.Lock()
         self._wake = asyncio.Event()
         self._loop_task: Optional[asyncio.Task] = None
         self._closed = False
@@ -164,10 +184,16 @@ class JaxEngine:
         # slot-matrix width: whole context in token slots (gather prefill)
         self._smat_width = config.max_pages_per_seq * config.page_size
 
-        # one jitted step; jax retraces per (B, T, C) shape family
-        self._step_fn = jax.jit(self._model_step, donate_argnums=(1,))
+        # one jitted step; jax retraces per (B, T, C) shape family (and
+        # per all_greedy variant — static so the pure-greedy batch skips
+        # the sampling shortlist entirely)
+        self._step_fn = jax.jit(
+            self._model_step, donate_argnums=(1,), static_argnums=(11,)
+        )
         # multi-step decode: `decode_steps` iterations per dispatch
-        self._decode_fn = jax.jit(self._decode_multi, donate_argnums=(1,))
+        self._decode_fn = jax.jit(
+            self._decode_multi, donate_argnums=(1,), static_argnums=(10,)
+        )
         # disagg KV transfer: in-place scatter of received blocks / gather
         # of computed blocks (reference: the NIXL read/write data plane,
         # patch nixl.py — here device<->host staged, see llm/disagg);
@@ -241,7 +267,7 @@ class JaxEngine:
     # compiled steps
 
     def _model_step(self, params, kv, tokens, positions, write_slots, slot_matrix,
-                    last_idx, temp, topk, topp, key):
+                    last_idx, temp, topk, topp, key, all_greedy=False):
         hidden, kv = llama.forward(
             params, self.model_cfg, tokens, positions, kv, write_slots, slot_matrix
         )
@@ -249,11 +275,11 @@ class JaxEngine:
             hidden, last_idx[:, None, None].astype(jnp.int32), axis=1
         )[:, 0]  # [B, D]
         lg = llama.logits(params, self.model_cfg, last_h)
-        toks = sample_tokens(lg, key, temp, topk, topp)
+        toks = sample_tokens(lg, key, temp, topk, topp, all_greedy=all_greedy)
         return toks, kv
 
     def _decode_multi(self, params, kv, tokens, positions, block_tables, active,
-                      temp, topk, topp, key):
+                      temp, topk, topp, key, all_greedy=False):
         """`decode_steps` decode iterations in ONE dispatch (lax.scan with
         on-device token feedback + slot computation) — the antidote to
         per-token host round trips, which dominate wall clock when the
@@ -305,14 +331,17 @@ class JaxEngine:
                 kv, wslots, attn,
             )
             lg = llama.logits(params, self.model_cfg, hidden[:, 0])
-            toks = sample_tokens(lg, sub, temp, topk, topp)
+            toks = sample_tokens(lg, sub, temp, topk, topp, all_greedy=all_greedy)
             return (toks, positions + 1, kv, key), toks
 
         (_, _, kv, _), out = jax.lax.scan(
             body, (tokens, positions, kv, key), None,
             length=self.config.decode_steps,
         )
-        return out, kv
+        # row 0 = the input carry (prefill first tokens ride in via slot
+        # overrides): syncing the dispatch delivers them with no separate
+        # fetch — a per-sequence fetch costs a full tunnel RTT
+        return jnp.concatenate([tokens[None], out], axis=0), kv
 
     # ------------------------------------------------------------------
     # engine protocol
@@ -444,20 +473,27 @@ class JaxEngine:
         try:
             while not self._closed:
                 progressed = self._admit_new()
-                # device queue per tick: decode dispatch N+1 first, then a
-                # bounded burst of prefill chunks — all enqueued before the
-                # (blocking) sync of dispatch N, so host work and new
-                # compute overlap
-                new = self._maybe_dispatch_decode()
-                progressed |= new is not None
+                # per tick: prefill chunks enqueue first (they own self.kv
+                # until their dispatch call returns), then decode dispatch
+                # N+1 runs in a worker thread WHILE the loop fetches
+                # dispatch N's tokens — the device tunnel blocks each jit
+                # call until prior work drains, so dispatch and the
+                # result-fetch RTT must overlap in separate threads or
+                # the loop serializes at ~2x device time per dispatch
                 progressed |= await self._prefill_tick()
-                old, self._inflight = self._inflight, new
+                new_task = None
+                snapshot = self._maybe_dispatch_decode()
+                if snapshot is not None:
+                    new_task = asyncio.create_task(
+                        asyncio.to_thread(self._run_decode_dispatch, snapshot)
+                    )
+                    progressed = True
+                old, self._inflight = self._inflight, None
                 if old is not None:
                     await self._sync_dispatch(old)
                     progressed = True
-                elif self._pending_first:
-                    await self._flush_first_tokens()
-                    progressed = True
+                if new_task is not None:
+                    self._inflight = await new_task
                 if progressed:
                     # yield so producers/consumers interleave with the loop
                     await asyncio.sleep(0)
@@ -561,70 +597,138 @@ class JaxEngine:
         return seq.page_ids[pos // self.page_size] * self.page_size + pos % self.page_size
 
     async def _prefill_tick(self) -> bool:
-        """Run ONE chunk of the oldest prefilling sequence (bounded work so
-        decode streams keep flowing under long prompts)."""
+        """Dispatch ONE chunk for EVERY prefilling sequence, batching
+        same-bucket chunks into one [n, bucket] model step — per-dispatch
+        host cost (~9 ms through the device tunnel) dominated the prefill
+        wave when each prompt dispatched alone. Bounding each sequence to
+        one chunk per tick keeps decode streams flowing under long
+        prompts."""
         if not self._prefilling:
             return False
-        seq = self._prefilling[0]
-        if seq.ctx.is_stopped():
-            self._prefilling.popleft()
-            self._finish(seq, FINISH_REASON_CANCELLED)
-            return True
-        try:
+        progressed = False
+        groups: dict[int, list[Sequence]] = {}
+        for _ in range(len(self._prefilling)):
+            if not self._prefilling:
+                break
+            seq = self._prefilling.popleft()
+            if seq.ctx.is_stopped():
+                self._finish(seq, FINISH_REASON_CANCELLED)
+                progressed = True
+                continue
             if seq.preloaded is not None:
-                tok = self._inject_chunk(seq)
-            else:
-                tok = self._prefill_chunk_dispatch(seq)
-        except Exception:
-            # contain per-sequence failures (e.g. a malformed remote KV
-            # payload): fail this request, keep the loop and batch alive
-            log.exception("prefill of seq %s failed", seq.seq_id)
-            self._prefilling.popleft()
-            self._finish(seq, FINISH_REASON_ERROR)
-            return True
-        if tok is not None:
-            # final chunk dispatched: sequence becomes decode-ready with
-            # its first token carried on device (or a host int from the
-            # disagg inject path) — no sync here
-            self._prefilling.popleft()
-            seq.prefilling = False
-            seq.device_pos = seq.num_computed
-            self._overrides[seq.slot] = tok
-            self._pending_first.append((seq, tok))
-            if hasattr(tok, "copy_to_host_async"):
-                tok.copy_to_host_async()
+                try:
+                    tok = self._inject_chunk(seq)
+                except Exception:
+                    # contain per-sequence failures (e.g. a malformed
+                    # remote KV payload): fail this request, keep the
+                    # loop alive
+                    log.exception("prefill of seq %s failed", seq.seq_id)
+                    self._finish(seq, FINISH_REASON_ERROR)
+                    progressed = True
+                    continue
+                progressed = True
+                if tok is None:
+                    self._prefilling.append(seq)
+                else:
+                    self._mark_decode_ready(seq, tok)
+                continue
+            chunk = min(
+                seq.total_tokens - seq.num_computed, self.config.prefill_chunk
+            )
+            groups.setdefault(self._bucket_for(chunk), []).append(seq)
+        for bucket, seqs in groups.items():
+            progressed = True
+            # split oversized groups: rows x bucket tokens of activations
+            # per dispatch, capped by prefill_group_tokens (a [256, 512]
+            # admission wave in one step OOMs on f32 temporaries)
+            cap = max(1, self.config.prefill_group_tokens // bucket)
+            # round down to a power of two: row counts pad UP to a power
+            # of two, so a non-pow2 cap would overshoot the token budget
+            cap = 1 << (cap.bit_length() - 1)
+            for off in range(0, len(seqs), cap):
+                part = seqs[off : off + cap]
+                try:
+                    toks = self._prefill_group_dispatch(part, bucket)
+                except Exception:
+                    log.exception(
+                        "prefill group of %d seqs failed", len(part)
+                    )
+                    for seq in part:
+                        self._finish(seq, FINISH_REASON_ERROR)
+                    continue
+                for j, seq in enumerate(part):
+                    if seq.num_computed >= seq.total_tokens:
+                        # final chunk: first token rides into the next
+                        # decode dispatch as the slot's carry override,
+                        # emitted from that dispatch's row 0 at sync — no
+                        # per-seq fetch
+                        self._mark_decode_ready(seq, (toks, j))
+                    else:
+                        self._prefilling.append(seq)
         await asyncio.sleep(0)
-        return True
+        return progressed
+
+    def _mark_decode_ready(self, seq: Sequence, tok) -> None:
+        seq.prefilling = False
+        seq.device_pos = seq.num_computed
+        self._overrides[seq.slot] = tok
+        seq.carry_pending = True
+
+    def _prefill_group_dispatch(self, seqs: list[Sequence], bucket: int):
+        """Dispatch one chunk for each sequence in ONE [n, bucket] model
+        step; returns the sampled-token vector [n] (valid at rows whose
+        chunk was final). n is padded to a power of two so the set of
+        compiled graphs stays bounded (padding rows write the trash
+        page)."""
+        n = 1 << (len(seqs) - 1).bit_length()
+        smat = np.zeros((n, self._smat_width), np.int32)
+        tok_arr = np.zeros((n, bucket), np.int32)
+        pos_arr = np.zeros((n, bucket), np.int32)
+        wslots = np.zeros((n, bucket), np.int32)
+        last_idx = np.zeros(n, np.int32)
+        temp = np.zeros(n, np.float32)
+        topk = np.zeros(n, np.int32)
+        topp = np.ones(n, np.float32)
+        ps = self.page_size
+        for j, seq in enumerate(seqs):
+            tokens = seq.tokens
+            start = seq.num_computed
+            chunk = min(len(tokens) - start, bucket)
+            smat[j] = self._slot_matrix_row(seq)
+            tok_arr[j, :chunk] = tokens[start : start + chunk]
+            idx = np.arange(start, start + chunk)
+            pos_arr[j, :chunk] = idx
+            pages = np.asarray(seq.page_ids, np.int32)
+            wslots[j, :chunk] = pages[idx // ps] * ps + idx % ps
+            last_idx[j] = chunk - 1
+            temp[j] = seq.temperature
+            topk[j] = seq.top_k
+            topp[j] = seq.top_p
+        with self._kv_lock:
+            self._key, sub = jax.random.split(self._key)
+            toks, self.kv = self._step_fn(
+                self.params, self.kv,
+                jnp.asarray(tok_arr), jnp.asarray(pos_arr),
+                jnp.asarray(wslots.reshape(-1)),
+                jnp.asarray(smat), jnp.asarray(last_idx),
+                jnp.asarray(temp), jnp.asarray(topk), jnp.asarray(topp),
+                sub,
+                bool((temp <= 0.0).all()),
+            )
+        for j, seq in enumerate(seqs):
+            chunk = min(seq.total_tokens - seq.num_computed, bucket)
+            seq.num_computed += chunk
+            self._register_full_pages(seq)
+        return toks
 
     def _prefill_chunk_dispatch(self, seq: Sequence):
-        """Dispatch one prefill chunk; returns the sampled-token device
-        array when this was the final chunk, else None."""
-        tokens = seq.tokens
-        t = len(tokens)
-        start = seq.num_computed
-        chunk = min(t - start, self.config.prefill_chunk)
-        bucket = self._bucket_for(chunk)
-        smat = self._slot_matrix_row(seq)[None]
-        tok_arr = np.zeros((1, bucket), np.int32)
-        pos_arr = np.zeros((1, bucket), np.int32)
-        wslots = np.zeros(bucket, np.int32)
-        tok_arr[0, :chunk] = tokens[start : start + chunk]
-        pos_arr[0, :chunk] = np.arange(start, start + chunk)
-        for i in range(chunk):
-            wslots[i] = self._write_slot(seq, start + i)
-        self._key, sub = jax.random.split(self._key)
-        toks, self.kv = self._step_fn(
-            self.params, self.kv,
-            jnp.asarray(tok_arr), jnp.asarray(pos_arr), jnp.asarray(wslots),
-            jnp.asarray(smat), jnp.asarray([chunk - 1]),
-            jnp.asarray([seq.temperature], jnp.float32),
-            jnp.asarray([seq.top_k], jnp.int32),
-            jnp.asarray([seq.top_p], jnp.float32),
-            sub,
-        )
-        seq.num_computed += chunk
-        self._register_full_pages(seq)
-        return toks[0] if seq.num_computed >= t else None
+        """Single-sequence chunk dispatch (disagg prefill_only path);
+        returns the sampled-token device vector [1] when this was the
+        final chunk, else None."""
+        toks = self._prefill_group_dispatch([seq], self._bucket_for(
+            min(seq.total_tokens - seq.num_computed, self.config.prefill_chunk)
+        ))
+        return toks[:1] if seq.num_computed >= seq.total_tokens else None
 
     async def _prefill_forward(self, seq: Sequence) -> int:
         """Blocking chunked prefill (disagg prefill_only path): writes KV,
@@ -634,7 +738,7 @@ class JaxEngine:
             tok = self._prefill_chunk_dispatch(seq)
             await asyncio.sleep(0)
         out = await asyncio.to_thread(np.asarray, tok)
-        return int(out)
+        return int(out.ravel()[0])
 
     def _inject_chunk(self, seq: Sequence) -> Optional[int]:
         """Scatter one chunk of remotely-computed KV into the sequence's
@@ -653,9 +757,10 @@ class JaxEngine:
             nv = np.zeros_like(nk)
             nk[:, :chunk] = k_arr[:, start : start + chunk]
             nv[:, :chunk] = v_arr[:, start : start + chunk]
-            self.kv = self._inject_fn(
-                self.kv, jnp.asarray(slots), jnp.asarray(nk), jnp.asarray(nv)
-            )
+            with self._kv_lock:
+                self.kv = self._inject_fn(
+                    self.kv, jnp.asarray(slots), jnp.asarray(nk), jnp.asarray(nv)
+                )
             seq.num_computed += chunk
             self._register_full_pages(seq)
         if seq.num_computed >= t:
@@ -666,9 +771,13 @@ class JaxEngine:
 
     # ---- decode -------------------------------------------------------
 
-    def _maybe_dispatch_decode(self) -> Optional[_Dispatch]:
-        """Build and enqueue the next decode dispatch (device token carry),
-        after a cancellation sweep; returns None when nothing is decode-ready."""
+    def _maybe_dispatch_decode(self) -> Optional["_DecodeBuild"]:
+        """Host-side build of the next decode dispatch (cancellation
+        sweep, page growth, input tables); returns None when nothing is
+        decode-ready. The jax calls happen in `_run_decode_dispatch`,
+        which the loop runs in a worker thread — the device tunnel blocks
+        dispatch while the device is busy, and that wait must overlap the
+        previous dispatch's result fetch."""
         if self._closed:
             return None
         ready = [
@@ -682,9 +791,7 @@ class JaxEngine:
         ready = [(i, s) for i, s in ready if self.slots[i] is s]
         if not ready:
             return None
-        return self._dispatch_decode(ready)
 
-    def _dispatch_decode(self, ready) -> Optional[_Dispatch]:
         b = len(self.slots)
         k_steps = self.config.decode_steps
         # ensure every ready sequence has pages for all positions this
@@ -719,47 +826,75 @@ class JaxEngine:
             temp[i] = seq.temperature
             topk[i] = seq.top_k
             topp[i] = seq.top_p
+            seq.device_pos += k_steps
 
-        toks = self._carry_toks
-        for slot, val in self._overrides.items():
-            if act[slot]:
-                toks = toks.at[slot].set(val)
+        overrides = {
+            slot: val for slot, val in self._overrides.items() if act[slot]
+        }
         self._overrides.clear()
+        return _DecodeBuild(
+            positions=positions, tables=tables, act=act, temp=temp,
+            topk=topk, topp=topp, overrides=overrides, active=active,
+            steps=k_steps,
+            all_greedy=bool((temp[act] <= 0.0).all()) if act.any() else True,
+        )
 
+    def _run_decode_dispatch(self, bld: "_DecodeBuild") -> _Dispatch:
+        """The jax half of a decode dispatch — runs in a worker thread
+        under _kv_lock (the loop awaits it before its own next kv use,
+        but the public prefill_only path can dispatch concurrently)."""
+        with self._kv_lock:
+            return self._run_decode_dispatch_locked(bld)
+
+    def _run_decode_dispatch_locked(self, bld: "_DecodeBuild") -> _Dispatch:
+        toks = self._carry_toks
+        if bld.overrides:
+            # batch the carry overrides into one scatter per source
+            # vector — a per-slot .at[].set is a separate dispatch (~ms
+            # each through the tunnel)
+            by_vec: dict[int, tuple] = {}
+            ints: list[tuple[int, int]] = []
+            for slot, val in bld.overrides.items():
+                if isinstance(val, tuple):
+                    vec, row = val
+                    ent = by_vec.setdefault(id(vec), (vec, [], []))
+                    ent[1].append(slot)
+                    ent[2].append(row)
+                else:
+                    ints.append((slot, int(val)))
+            for vec, slots, rows in by_vec.values():
+                toks = toks.at[jnp.asarray(slots, jnp.int32)].set(
+                    vec[jnp.asarray(rows, jnp.int32)]
+                )
+            if ints:
+                toks = toks.at[jnp.asarray([s for s, _ in ints], jnp.int32)].set(
+                    jnp.asarray([v for _, v in ints], jnp.int32)
+                )
         self._key, sub = jax.random.split(self._key)
         out, self.kv = self._decode_fn(
             self.params, self.kv,
-            toks, jnp.asarray(positions), jnp.asarray(tables), jnp.asarray(act),
-            jnp.asarray(temp), jnp.asarray(topk), jnp.asarray(topp),
-            sub,
+            toks, jnp.asarray(bld.positions), jnp.asarray(bld.tables),
+            jnp.asarray(bld.act), jnp.asarray(bld.temp),
+            jnp.asarray(bld.topk), jnp.asarray(bld.topp),
+            sub, bld.all_greedy,
         )
         self._step_count += 1
         self._carry_toks = out[-1]
         out.copy_to_host_async()
-        for i, seq in active:
-            seq.device_pos += k_steps
-        return _Dispatch(out, active, k_steps)
-
-    async def _flush_first_tokens(self) -> None:
-        """Emit prefill first tokens (device scalars or disagg host ints),
-        in stream order before any decode tokens of the same sequence."""
-        pending, self._pending_first = self._pending_first, []
-        for seq, tok in pending:
-            if seq.slot < 0 or self.slots[seq.slot] is not seq:
-                continue  # finished/preempted before emission: dropped
-            val = (
-                int(await asyncio.to_thread(np.asarray, tok))
-                if hasattr(tok, "copy_to_host_async")
-                else int(tok)
-            )
-            seq.num_computed = seq.total_tokens  # prefill KV all valid
-            self._append_token(seq, val, extra_meta=seq.first_meta)
-            seq.first_meta = None
+        return _Dispatch(out, bld.active, bld.steps)
 
     async def _sync_dispatch(self, d: _Dispatch) -> None:
-        await self._flush_first_tokens()
-        out = await asyncio.to_thread(np.asarray, d.out_dev)  # [K, B]
-        for step in range(out.shape[0]):
+        out = await asyncio.to_thread(np.asarray, d.out_dev)  # [K+1, B]
+        # row 0 is the dispatch's input carry: sequences that entered with
+        # a freshly-prefilled first token emit it here, in stream order
+        # before their decode tokens — one fetch covers everything
+        for i, seq in d.snapshot:
+            if self.slots[i] is seq and seq.carry_pending:
+                seq.carry_pending = False
+                seq.num_computed = seq.total_tokens  # prefill KV all valid
+                self._append_token(seq, int(out[0, i]), extra_meta=seq.first_meta)
+                seq.first_meta = None
+        for step in range(1, out.shape[0]):
             for i, seq in d.snapshot:
                 if self.slots[i] is not seq:
                     # finished/preempted earlier: overshoot discarded

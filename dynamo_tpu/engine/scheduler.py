@@ -51,6 +51,8 @@ class Sequence:
     finish: Optional[str] = None
     prefilling: bool = False   # admitted but prompt KV not yet complete
     device_pos: int = 0        # next position a decode dispatch will write
+    carry_pending: bool = False  # prefill first token awaiting emission
+    # (it rides the next decode dispatch's input carry, emitted at sync)
     # metadata attached to the first emitted token (prefix-hit stats etc.)
     first_meta: Optional[dict] = None
     # disagg: (first_token, k [L,T,Kh*Hd], v) delivered by a remote prefill

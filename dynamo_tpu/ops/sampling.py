@@ -25,11 +25,19 @@ def sample_tokens(
     temperature: jnp.ndarray,  # [B] f32 (<= 0 treated as greedy)
     top_k: jnp.ndarray,        # [B] i32 (<= 0 means disabled)
     top_p: jnp.ndarray,        # [B] f32 (>= 1 means disabled)
+    all_greedy: bool = False,  # static: whole batch greedy -> argmax only
 ) -> jnp.ndarray:
-    """Returns sampled token ids [B] int32."""
+    """Returns sampled token ids [B] int32.
+
+    `all_greedy` is a trace-time flag the engine sets when no live slot
+    samples (the common serving case): it skips the shortlist machinery
+    entirely — approx_max_k costs ~2 ms at [64, 128k] on v5e, argmax
+    fuses into the logits matmul."""
     b, v = logits.shape
     logits = logits.astype(jnp.float32)
     greedy_ids = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    if all_greedy:
+        return greedy_ids
 
     is_greedy = temperature <= 0.0
     temp = jnp.where(is_greedy, 1.0, temperature)

@@ -38,11 +38,11 @@ def time_decode(engine: JaxEngine, n=10):
         jax.random.PRNGKey(0),
     )
     kv = engine.kv
-    out, kv = engine._decode_fn(engine.params, kv, *args)
+    out, kv = engine._decode_fn(engine.params, kv, *args, True)
     _ = np.asarray(out[-1, :1])  # force warmup completion
     t0 = time.perf_counter()
     for _ in range(n):
-        out, kv = engine._decode_fn(engine.params, kv, *args)
+        out, kv = engine._decode_fn(engine.params, kv, *args, True)
     _ = np.asarray(out[-1, :1])
     dt = (time.perf_counter() - t0) / n
     engine.kv = kv
@@ -56,7 +56,7 @@ def main():
                 EngineConfig(
                     model="llama-3.2-1b",
                     dtype="bfloat16",
-                    page_size=16,
+                    page_size=64,
                     max_batch_size=b,
                     max_model_len=ISL + OSL + 32,
                     prefill_chunk=ISL,

@@ -1,0 +1,91 @@
+"""Time the engine loop phases under a bench-like load (greedy, fixed
+ISL/OSL) by monkeypatching the phase methods with timers.
+Run: python scripts/profile_engine_loop.py [CONC]
+"""
+
+import asyncio
+import os
+import sys
+import time
+from collections import defaultdict
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from dynamo_tpu.engine import EngineConfig, JaxEngine
+from dynamo_tpu.llm.protocols.common import (
+    PreprocessedRequest, SamplingOptions, StopConditions,
+)
+from dynamo_tpu.runtime.pipeline.context import Context
+
+CONC = int(sys.argv[1]) if len(sys.argv) > 1 else 64
+ISL, OSL = 512, 64
+
+times = defaultdict(float)
+counts = defaultdict(int)
+
+
+def wrap(obj, name):
+    fn = getattr(obj, name)
+    if asyncio.iscoroutinefunction(fn):
+        async def timed(*a, **kw):
+            t0 = time.perf_counter()
+            r = await fn(*a, **kw)
+            times[name] += time.perf_counter() - t0
+            counts[name] += 1
+            return r
+    else:
+        def timed(*a, **kw):
+            t0 = time.perf_counter()
+            r = fn(*a, **kw)
+            times[name] += time.perf_counter() - t0
+            counts[name] += 1
+            return r
+    setattr(obj, name, timed)
+
+
+def main():
+    engine = JaxEngine(EngineConfig(
+        model="llama-3.2-1b", dtype="bfloat16",
+        max_batch_size=CONC, max_model_len=ISL + OSL + 32,
+        prefill_chunk=ISL, decode_steps=int(os.environ.get("PROF_STEPS", "16")),
+    ))
+    for name in ("_admit_new", "_maybe_dispatch_decode", "_prefill_tick",
+                 "_sync_dispatch",
+                 "_prefill_chunk_dispatch", "_run_decode_dispatch"):
+        wrap(engine, name)
+
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(1, 100000, ISL).tolist() for _ in range(CONC)]
+
+    async def one(p):
+        pre = PreprocessedRequest(
+            token_ids=p,
+            stop_conditions=StopConditions(max_tokens=OSL, ignore_eos=True),
+            sampling_options=SamplingOptions(greedy=True),
+        )
+        n = 0
+        async for f in await engine.generate(Context(pre.to_dict())):
+            if f.get("token_ids"):
+                n += 1
+        return n
+
+    async def run():
+        await asyncio.gather(*(one(rng.randint(1, 100000, ISL).tolist()) for _ in range(CONC)))  # warmup all shapes
+        for k in list(times):
+            times[k] = 0.0
+            counts[k] = 0
+        t0 = time.perf_counter()
+        out = await asyncio.gather(*(one(p) for p in prompts))
+        wall = time.perf_counter() - t0
+        print(f"wall {wall:.2f}s  tokens {sum(out)}  -> {sum(out)/wall:.0f} tok/s")
+        for k in sorted(times, key=times.get, reverse=True):
+            print(f"  {k:28s} {times[k]*1000:9.1f} ms total  x{counts[k]:5d}  "
+                  f"({times[k]/max(counts[k],1)*1000:7.2f} ms/call)")
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()
