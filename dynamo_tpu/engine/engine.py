@@ -441,10 +441,13 @@ class JaxEngine:
             slots = np.asarray(
                 [self._write_slot(seq, p) for p in range(t)], np.int32
             )
-            k, v = self._extract_fn(self.kv, jnp.asarray(slots))
-            k_host, v_host = await asyncio.to_thread(
-                lambda: (np.asarray(k), np.asarray(v))
-            )
+
+            def _extract():
+                with self._kv_lock:  # vs the decode thread donating kv
+                    k, v = self._extract_fn(self.kv, jnp.asarray(slots))
+                return np.asarray(k), np.asarray(v)
+
+            k_host, v_host = await asyncio.to_thread(_extract)
             return first_token, k_host, v_host
         finally:
             self.allocator.release(seq.page_ids)
@@ -597,19 +600,30 @@ class JaxEngine:
         return seq.page_ids[pos // self.page_size] * self.page_size + pos % self.page_size
 
     async def _prefill_tick(self) -> bool:
-        """Dispatch ONE chunk for EVERY prefilling sequence, batching
-        same-bucket chunks into one [n, bucket] model step — per-dispatch
-        host cost (~9 ms through the device tunnel) dominated the prefill
-        wave when each prompt dispatched alone. Bounding each sequence to
-        one chunk per tick keeps decode streams flowing under long
-        prompts."""
+        """Dispatch up to `prefill_group_tokens` worth of prefill chunks,
+        batching same-bucket chunks into one [n, bucket] model step —
+        per-dispatch host cost (~9 ms through the device tunnel) dominated
+        the prefill wave when each prompt dispatched alone. The per-tick
+        token budget bounds how long active decode streams stall: one
+        group dispatch per tick, decode interleaves between waves."""
         if not self._prefilling:
             return False
         progressed = False
         groups: dict[int, list[Sequence]] = {}
-        for _ in range(len(self._prefilling)):
-            if not self._prefilling:
-                break
+
+        def padded_cost() -> int:
+            # dispatch cost in activation tokens: row counts pad UP to a
+            # power of two, and padding rows cost as much as real ones
+            return sum(
+                (1 << (len(seqs) - 1).bit_length()) * bucket
+                for bucket, seqs in groups.items()
+            )
+
+        budget = self.config.prefill_group_tokens
+        scanned = 0
+        n_queued = len(self._prefilling)
+        while self._prefilling and scanned < n_queued:
+            scanned += 1
             seq = self._prefilling.popleft()
             if seq.ctx.is_stopped():
                 self._finish(seq, FINISH_REASON_CANCELLED)
@@ -635,36 +649,50 @@ class JaxEngine:
             chunk = min(
                 seq.total_tokens - seq.num_computed, self.config.prefill_chunk
             )
-            groups.setdefault(self._bucket_for(chunk), []).append(seq)
+            bucket = self._bucket_for(chunk)
+            groups.setdefault(bucket, []).append(seq)
+            if padded_cost() > budget:
+                groups[bucket].pop()
+                if not groups[bucket]:
+                    del groups[bucket]
+                if groups:
+                    self._prefilling.appendleft(seq)  # next tick, same order
+                    break
+                # a single chunk over budget still must run (tiny budget
+                # misconfiguration) — dispatch it alone
+                groups[bucket] = [seq]
+                break
         for bucket, seqs in groups.items():
             progressed = True
-            # split oversized groups: rows x bucket tokens of activations
-            # per dispatch, capped by prefill_group_tokens (a [256, 512]
-            # admission wave in one step OOMs on f32 temporaries)
-            cap = max(1, self.config.prefill_group_tokens // bucket)
-            # round down to a power of two: row counts pad UP to a power
-            # of two, so a non-pow2 cap would overshoot the token budget
-            cap = 1 << (cap.bit_length() - 1)
-            for off in range(0, len(seqs), cap):
-                part = seqs[off : off + cap]
-                try:
-                    toks = self._prefill_group_dispatch(part, bucket)
-                except Exception:
-                    log.exception(
-                        "prefill group of %d seqs failed", len(part)
-                    )
-                    for seq in part:
+            try:
+                toks = self._prefill_group_dispatch(seqs, bucket)
+            except Exception:
+                log.exception(
+                    "prefill group of %d seqs failed; retrying singly",
+                    len(seqs),
+                )
+                # contain the failure to the offending request(s): retry
+                # each sequence in its own dispatch
+                for seq in seqs:
+                    try:
+                        tok1 = self._prefill_group_dispatch([seq], bucket)
+                    except Exception:
+                        log.exception("prefill of seq %s failed", seq.seq_id)
                         self._finish(seq, FINISH_REASON_ERROR)
-                    continue
-                for j, seq in enumerate(part):
+                        continue
                     if seq.num_computed >= seq.total_tokens:
-                        # final chunk: first token rides into the next
-                        # decode dispatch as the slot's carry override,
-                        # emitted from that dispatch's row 0 at sync — no
-                        # per-seq fetch
-                        self._mark_decode_ready(seq, (toks, j))
+                        self._mark_decode_ready(seq, (tok1, 0))
                     else:
                         self._prefilling.append(seq)
+                continue
+            for j, seq in enumerate(seqs):
+                if seq.num_computed >= seq.total_tokens:
+                    # final chunk: first token rides into the next decode
+                    # dispatch as the slot's carry override, emitted from
+                    # that dispatch's row 0 at sync — no per-seq fetch
+                    self._mark_decode_ready(seq, (toks, j))
+                else:
+                    self._prefilling.append(seq)
         await asyncio.sleep(0)
         return progressed
 
@@ -735,8 +763,9 @@ class JaxEngine:
         returns the token sampled at the final position."""
         tok = None
         while tok is None:
-            tok = self._prefill_chunk_dispatch(seq)
-            await asyncio.sleep(0)
+            # worker thread: the _kv_lock acquire can wait out a whole
+            # in-flight decode dispatch — never block the event loop on it
+            tok = await asyncio.to_thread(self._prefill_chunk_dispatch, seq)
         out = await asyncio.to_thread(np.asarray, tok)
         return int(out.ravel()[0])
 

@@ -1,0 +1,66 @@
+"""`python -m dynamo_tpu.sdk serve graph:Entry [-f config.yaml]` — the
+`dynamo serve` CLI (reference: deploy/dynamo/sdk/cli serve command →
+serve_dynamo_graph, serving.py:307).
+
+Spawns one process group per service in the graph reachable from the entry
+and supervises it until Ctrl-C. With no --hub, an in-process hub (the
+etcd+NATS equivalent) is started so a bare host works out of the box.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import sys
+
+from dynamo_tpu.sdk.config import ServiceConfig
+from dynamo_tpu.sdk.supervisor import Supervisor, load_entry
+from dynamo_tpu.utils.logging import configure_logging
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(prog="python -m dynamo_tpu.sdk")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    serve = sub.add_parser("serve", help="serve a component graph")
+    serve.add_argument("entry", help="'module:EntryService' or 'file.py:EntryService'")
+    serve.add_argument("-f", "--config-file", help="YAML {Service: {key: value}}")
+    serve.add_argument("--hub", help="hub address host:port (default: spawn one)")
+    serve.add_argument(
+        "--set",
+        action="append",
+        default=[],
+        metavar="Service.key=value",
+        help="config override (repeatable)",
+    )
+    args = p.parse_args(argv)
+    configure_logging()
+
+    cfg = (
+        ServiceConfig.from_yaml(args.config_file)
+        if args.config_file
+        else ServiceConfig()
+    )
+    for item in args.set:
+        target, _, value = item.partition("=")
+        svc, _, key = target.partition(".")
+        if not key:
+            p.error(f"--set wants Service.key=value, got '{item}'")
+        cfg.set(svc, key, value)
+
+    entry_cls = load_entry(args.entry)
+    sup = Supervisor.for_graph(
+        args.entry, entry_cls, config=cfg, hub_addr=args.hub
+    )
+
+    async def run() -> None:
+        await sup.start()
+        names = ", ".join(sup.watchers)
+        print(f"serving [{names}] via hub {sup.hub_addr} — Ctrl-C to stop")
+        await sup.run_until_interrupt()
+
+    asyncio.run(run())
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

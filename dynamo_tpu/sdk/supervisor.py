@@ -1,0 +1,312 @@
+"""Asyncio process supervisor: the SDK's circus-arbiter equivalent.
+
+The reference serves a component graph as a circus arbiter with one watcher
+per service, each running N worker processes (reference:
+deploy/dynamo/sdk/cli/serving.py:71-127 create_dynamo_watcher,
+cli/circus.py create_circus_watcher/arbiter). This is the same process
+model on plain asyncio subprocesses: a `Watcher` owns the workers of one
+service (spawn, restart-on-crash with backoff, graceful stop, live
+rescale); a `Supervisor` owns the watchers and the optional in-process hub.
+
+Worker processes run `python -m dynamo_tpu.sdk.worker <entry> --service-name
+<name> --worker-id <n>` (the serve_dynamo.py equivalent) and inherit
+resolved service config via the DYNAMO_SERVICE_CONFIG env var and hub
+coordinates via DYN_HUB_ADDR.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import signal
+import sys
+from typing import Optional
+
+from dynamo_tpu.sdk.allocator import TpuAllocator
+from dynamo_tpu.sdk.config import ENV_VAR as CONFIG_ENV_VAR
+from dynamo_tpu.sdk.config import ServiceConfig
+from dynamo_tpu.sdk.service import ServiceSpec, discover_graph, get_spec
+
+log = logging.getLogger("dynamo_tpu.sdk.supervisor")
+
+GRACE_PERIOD_S = 10.0
+
+
+class Watcher:
+    """All worker processes of one service (reference: circus Watcher)."""
+
+    def __init__(
+        self,
+        name: str,
+        args: list[str],
+        env: dict[str, str],
+        numprocesses: int = 1,
+        max_restarts: int = 5,
+        restart_backoff_s: float = 1.0,
+    ):
+        self.name = name
+        self.args = args
+        self.env = env
+        self.numprocesses = numprocesses
+        self.max_restarts = max_restarts
+        self.restart_backoff_s = restart_backoff_s
+        self._tasks: dict[int, asyncio.Task] = {}
+        self._procs: dict[int, asyncio.subprocess.Process] = {}
+        self._stopping = False
+
+    async def start(self) -> None:
+        self._stopping = False
+        self._reap()
+        while len(self._tasks) < self.numprocesses:
+            self._spawn_slot()
+
+    def _reap(self) -> None:
+        """Drop finished runner tasks so their worker-ids (and the chip
+        ranges keyed off them) are reusable."""
+        self._tasks = {w: t for w, t in self._tasks.items() if not t.done()}
+
+    def _spawn_slot(self) -> None:
+        # lowest free wid: worker-id keys the worker's TPU chip slice, so
+        # ids must be stable and dense across restarts/rescales
+        wid = next(i for i in range(len(self._tasks) + 1) if i not in self._tasks)
+        self._tasks[wid] = asyncio.create_task(
+            self._run_worker(wid), name=f"{self.name}[{wid}]"
+        )
+
+    async def _run_worker(self, wid: int) -> None:
+        restarts = 0
+        while not self._stopping:
+            proc = await asyncio.create_subprocess_exec(
+                *self.args,
+                "--worker-id",
+                str(wid),
+                env={**os.environ, **self.env},
+            )
+            self._procs[wid] = proc
+            log.info("%s[%d] started pid=%d", self.name, wid, proc.pid)
+            rc = await proc.wait()
+            self._procs.pop(wid, None)
+            if self._stopping or rc == 0:
+                log.info("%s[%d] exited rc=%s", self.name, wid, rc)
+                return
+            restarts += 1
+            if restarts > self.max_restarts:
+                log.error(
+                    "%s[%d] crashed rc=%s; max restarts (%d) exhausted",
+                    self.name, wid, rc, self.max_restarts,
+                )
+                return
+            backoff = self.restart_backoff_s * min(2 ** (restarts - 1), 16)
+            log.warning(
+                "%s[%d] crashed rc=%s; restart %d/%d in %.1fs",
+                self.name, wid, rc, restarts, self.max_restarts, backoff,
+            )
+            await asyncio.sleep(backoff)
+
+    def max_workers(self) -> Optional[int]:
+        """Upper scale bound from the chip allocation, if any."""
+        chips = self.env.get("DYN_TPU_CHIPS")
+        if not chips:
+            return None
+        per = int(self.env.get("DYN_TPU_CHIPS_PER_WORKER", "1"))
+        return len([c for c in chips.split(",") if c]) // per
+
+    async def scale(self, n: int) -> None:
+        """Rescale to n workers: spawn extras, SIGTERM the highest surplus
+        (the planner's add/remove component primitive, reference:
+        components/planner local_connector.py:105-322)."""
+        bound = self.max_workers()
+        if bound is not None and n > bound:
+            raise ValueError(
+                f"{self.name}: scale({n}) exceeds the {bound}-worker TPU "
+                "chip allocation made at graph build time"
+            )
+        self.numprocesses = n
+        self._reap()
+        while len(self._tasks) < n:
+            self._spawn_slot()
+        live = sorted(self._tasks)
+        for wid in live[n:]:
+            await self._stop_worker(wid)
+
+    async def _stop_worker(self, wid: int, grace: float = GRACE_PERIOD_S) -> None:
+        task = self._tasks.pop(wid, None)
+        proc = self._procs.get(wid)
+        if proc is not None and proc.returncode is None:
+            # mark this one slot non-restarting by cancelling its runner
+            # after the process exits gracefully
+            try:
+                proc.terminate()
+            except ProcessLookupError:
+                pass
+            try:
+                await asyncio.wait_for(proc.wait(), grace)
+            except asyncio.TimeoutError:
+                log.warning("%s[%d] ignored SIGTERM; killing", self.name, wid)
+                proc.kill()
+        if task is not None:
+            task.cancel()
+            try:
+                await task
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+
+    def alive_count(self) -> int:
+        return sum(1 for p in self._procs.values() if p.returncode is None)
+
+    async def stop(self, grace: float = GRACE_PERIOD_S) -> None:
+        self._stopping = True
+        procs = [p for p in self._procs.values() if p.returncode is None]
+        for p in procs:
+            try:
+                p.terminate()
+            except ProcessLookupError:
+                pass
+        if procs:
+            done = asyncio.gather(*(p.wait() for p in procs))
+            try:
+                await asyncio.wait_for(done, grace)
+            except asyncio.TimeoutError:
+                for p in procs:
+                    if p.returncode is None:
+                        log.warning("%s pid=%d ignored SIGTERM; killing",
+                                    self.name, p.pid)
+                        p.kill()
+        for task in self._tasks.values():
+            task.cancel()
+        for task in self._tasks.values():
+            try:
+                await task
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+        self._tasks.clear()
+
+
+def _worker_args(entry_ident: str, service_name: str) -> list[str]:
+    return [
+        sys.executable, "-m", "dynamo_tpu.sdk.worker",
+        entry_ident, "--service-name", service_name,
+    ]
+
+
+class Supervisor:
+    """The arbiter: one Watcher per service in the graph, plus an optional
+    in-process hub so `serve` works on a bare host (the reference assumes
+    etcd+NATS are already running)."""
+
+    def __init__(self, hub_addr: Optional[str] = None):
+        self.hub_addr = hub_addr
+        self.watchers: dict[str, Watcher] = {}
+        self._hub_server = None
+        self._stop_evt: Optional[asyncio.Event] = None
+
+    @classmethod
+    def for_graph(
+        cls,
+        entry_ident: str,
+        entry_cls: type,
+        config: Optional[ServiceConfig] = None,
+        hub_addr: Optional[str] = None,
+        allocator: Optional[TpuAllocator] = None,
+    ) -> "Supervisor":
+        """Build watchers for every service reachable from the entry
+        (reference: serve_dynamo_graph, serving.py:307-420)."""
+        self = cls(hub_addr=hub_addr)
+        config = (config or ServiceConfig()).merged_with_env()
+        allocator = allocator or TpuAllocator()
+        for spec in discover_graph(entry_cls):
+            svc_cfg = config.for_service(spec.name)
+            workers = int(svc_cfg.get("workers", spec.workers))
+            chips_per = int(
+                svc_cfg.get("tpu", spec.resources.get("tpu", 0))
+            )
+            chip_env: dict[str, str] = {}
+            if chips_per:
+                ids = allocator.assign(chips_per * workers)
+                if ids is None:
+                    raise RuntimeError(
+                        f"service {spec.name} wants {chips_per * workers} TPU "
+                        f"chips; host has {allocator.total_chips}"
+                    )
+                # each worker slices its disjoint range by worker-id (the
+                # worker entry applies TPU_VISIBLE_DEVICES per its wid)
+                chip_env["DYN_TPU_CHIPS"] = ",".join(map(str, ids))
+                chip_env["DYN_TPU_CHIPS_PER_WORKER"] = str(chips_per)
+            else:
+                chip_env.update(TpuAllocator.env_for([]))
+            env = {CONFIG_ENV_VAR: config.to_env(), **chip_env}
+            self.watchers[spec.name] = Watcher(
+                name=f"{spec.namespace}_{spec.name}",
+                args=_worker_args(entry_ident, spec.name),
+                env=env,
+                numprocesses=workers,
+            )
+        return self
+
+    async def start(self) -> None:
+        if self.hub_addr is None:
+            from dynamo_tpu.runtime.hub.server import HubServer
+
+            self._hub_server = HubServer()
+            await self._hub_server.start("127.0.0.1", 0)
+            self.hub_addr = f"127.0.0.1:{self._hub_server.port}"
+            log.info("started in-process hub at %s", self.hub_addr)
+        for w in self.watchers.values():
+            w.env.setdefault("DYN_HUB_ADDR", self.hub_addr)
+            await w.start()
+
+    async def stop(self) -> None:
+        # reverse declaration order: dependents first, dependencies last
+        for w in reversed(list(self.watchers.values())):
+            await w.stop()
+        if self._hub_server is not None:
+            await self._hub_server.stop()
+            self._hub_server = None
+        if self._stop_evt is not None:
+            self._stop_evt.set()
+
+    async def scale(self, service: str, n: int) -> None:
+        await self.watchers[service].scale(n)
+
+    async def run_until_interrupt(self) -> None:
+        self._stop_evt = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            loop.add_signal_handler(sig, self._stop_evt.set)
+        await self._stop_evt.wait()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            loop.remove_signal_handler(sig)
+        await self.stop()
+
+
+def load_entry(ident: str):
+    """Resolve 'pkg.module:Class' or 'path/to/file.py:Class' to the entry
+    @service class (reference: find_and_load_service, sdk lib/loader.py)."""
+    mod_part, _, cls_part = ident.partition(":")
+    if not cls_part:
+        raise ValueError(f"entry '{ident}' must be 'module:ClassName'")
+    if mod_part.endswith(".py") or os.path.sep in mod_part:
+        import importlib.util
+
+        name = os.path.splitext(os.path.basename(mod_part))[0]
+        spec = importlib.util.spec_from_file_location(name, mod_part)
+        if spec is None or spec.loader is None:
+            raise ImportError(f"cannot load {mod_part}")
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules.setdefault(name, mod)
+        spec.loader.exec_module(mod)
+    else:
+        import importlib
+
+        mod = importlib.import_module(mod_part)
+    cls = getattr(mod, cls_part)
+    get_spec(cls)  # raises TypeError unless it is a @service
+    return cls
+
+
+def find_spec(entry_cls, service_name: str) -> ServiceSpec:
+    for spec in discover_graph(entry_cls):
+        if spec.name == service_name:
+            return spec
+    raise KeyError(f"service '{service_name}' not in graph of {entry_cls.__name__}")

@@ -1,0 +1,111 @@
+"""Worker process entry: host one service of a component graph.
+
+`python -m dynamo_tpu.sdk.worker <entry_ident> --service-name S --worker-id N`
+— the serve_dynamo.py equivalent (reference:
+deploy/dynamo/sdk/cli/serve_dynamo.py:186-300): connect the distributed
+runtime, instantiate the service class, resolve its depends() edges to live
+clients, run @async_on_start hooks, then serve every @endpoint method on
+`dyn://{namespace}.{service}.{endpoint}` until SIGTERM.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import os
+import signal
+from typing import Any, AsyncIterator
+
+from dynamo_tpu.utils.logging import configure_logging
+
+log = logging.getLogger("dynamo_tpu.sdk.worker")
+
+
+class _BoundEngine:
+    """AsyncEngine over a bound @endpoint method."""
+
+    def __init__(self, fn):
+        self._fn = fn
+
+    async def generate(self, request) -> AsyncIterator[Any]:
+        return await self._fn(request)
+
+
+def _apply_chip_env(worker_id: int) -> None:
+    """Slice this worker's disjoint chip range out of the watcher's
+    allocation (reference: ResourceAllocator.assign_gpus setting
+    CUDA_VISIBLE_DEVICES per worker, sdk cli/allocator.py:54-251)."""
+    chips = os.environ.get("DYN_TPU_CHIPS")
+    if not chips:
+        return
+    per = int(os.environ.get("DYN_TPU_CHIPS_PER_WORKER", "1"))
+    ids = [c for c in chips.split(",") if c]
+    mine = ids[worker_id * per : (worker_id + 1) * per]
+    os.environ["TPU_VISIBLE_DEVICES"] = ",".join(mine)
+
+
+async def amain(entry_ident: str, service_name: str, worker_id: int) -> None:
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+    from dynamo_tpu.sdk.config import ServiceConfig
+    from dynamo_tpu.sdk.service import collect_on_start
+    from dynamo_tpu.sdk.supervisor import find_spec, load_entry
+
+    entry_cls = load_entry(entry_ident)
+    spec = find_spec(entry_cls, service_name)
+    cfg = ServiceConfig.from_env().for_service(spec.name)
+
+    drt = await DistributedRuntime.from_settings()  # DYN_HUB_ADDR
+    stop_evt = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop_evt.set)
+
+    instance = spec.cls.__new__(spec.cls)
+    # runtime context available to __init__ and hooks (reference:
+    # dynamo_context in serve_dynamo.py)
+    instance.dynamo_context = {
+        "runtime": drt,
+        "service": spec.name,
+        "namespace": spec.namespace,
+        "worker_id": worker_id,
+        "config": cfg,
+    }
+    instance.__init__()
+
+    for dep in spec.dependencies.values():
+        await dep.resolve(drt)
+    for hook in collect_on_start(instance):
+        result = hook()
+        if asyncio.iscoroutine(result):
+            await result
+
+    comp = drt.namespace(spec.namespace).component(spec.name)
+    served = []
+    for ep_name in spec.endpoints:
+        ep = comp.endpoint(ep_name)
+        served.append(
+            await ep.serve_engine(_BoundEngine(getattr(instance, ep_name)))
+        )
+        log.info("%s[%d]: serving %s", spec.name, worker_id, ep.subject)
+
+    await stop_evt.wait()
+    log.info("%s[%d]: draining", spec.name, worker_id)
+    for s in served:
+        await s.shutdown()
+    await drt.shutdown()
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(prog="dynamo_tpu.sdk.worker")
+    p.add_argument("entry")
+    p.add_argument("--service-name", required=True)
+    p.add_argument("--worker-id", type=int, default=0)
+    args = p.parse_args()
+    configure_logging()
+    _apply_chip_env(args.worker_id)
+    asyncio.run(amain(args.entry, args.service_name, args.worker_id))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,46 @@
+"""Two-component echo graph for the SDK e2e test (the reference's
+examples/llm/graphs/agg.py shape: Frontend depends on a backend worker)."""
+
+from __future__ import annotations
+
+from dynamo_tpu.sdk import async_on_start, depends, endpoint, service
+
+
+@service(name="EchoBackend", namespace="sdktest")
+class EchoBackend:
+    def __init__(self):
+        self.prefix = self.dynamo_context["config"].get("prefix", "")
+
+    @endpoint()
+    async def generate(self, request):
+        text = request.payload["text"]
+
+        async def stream():
+            for word in text.split():
+                yield {"word": self.prefix + word}
+
+        return stream()
+
+
+@service(name="EchoFrontend", namespace="sdktest")
+class EchoFrontend:
+    backend = depends(EchoBackend)
+
+    def __init__(self):
+        self.ready = False
+
+    @async_on_start
+    async def wait_backend(self):
+        await self.backend.wait_for_instances()
+        self.ready = True
+
+    @endpoint()
+    async def generate(self, request):
+        upstream = await self.backend.generate(request.payload)
+
+        async def stream():
+            assert self.ready
+            async for item in upstream:
+                yield {"word": item["word"].upper()}
+
+        return stream()
