@@ -1,0 +1,274 @@
+"""Planner: the serving autoscaler.
+
+Watches the two load signals of a (possibly disaggregated) deployment —
+prefill queue depth and decode KV-cache utilization — and scales each
+worker pool up or down one replica at a time under a chip budget
+(reference: examples/llm/components/planner.py:51-359 Planner.collect_
+metrics/make_adjustments; components/planner/src/dynamo/planner/
+local_connector.py:105-322 LocalConnector add/remove_component).
+
+Design deltas from the reference, on purpose:
+- the connector scales through the SDK `Supervisor` (process group
+  rescale + lease-revoke drain) instead of circus state files;
+- metrics ride the existing stats plane (`Client.scrape_stats` via
+  KvMetricsAggregator) and the hub prefill queue — no extra transport;
+- decisions are pure functions of a metrics window (`PlannerDecision`),
+  so the policy is unit-testable without processes.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import statistics
+from dataclasses import dataclass, field
+from typing import Optional, Protocol
+
+from dynamo_tpu.llm.disagg import PrefillQueue
+from dynamo_tpu.llm.kv_router.metrics_aggregator import KvMetricsAggregator
+
+log = logging.getLogger("dynamo_tpu.planner")
+
+
+@dataclass
+class PlannerConfig:
+    namespace: str = "dynamo"
+    decode_component: str = "backend"
+    prefill_component: str = "prefill"
+    decode_endpoint: str = "generate"
+
+    metric_pull_interval_s: float = 1.0
+    adjustment_interval_s: float = 10.0
+
+    # thresholds (reference planner.py defaults)
+    prefill_queue_scale_up_threshold: float = 5.0
+    prefill_queue_scale_down_threshold: float = 0.2
+    decode_kv_scale_up_threshold: float = 0.9
+    decode_kv_scale_down_threshold: float = 0.2
+
+    min_endpoint: int = 1
+    max_chip_budget: int = 8
+    prefill_engine_num_chips: int = 1
+    decode_engine_num_chips: int = 1
+
+    # scale-down needs this many consecutive eligible rounds (grace, so a
+    # fresh scale-up isn't immediately reverted by a transient lull)
+    scale_down_grace_rounds: int = 1
+
+    disagg: bool = True  # False: aggregated serving, no prefill pool
+
+
+class ScaleConnector(Protocol):
+    """The planner's actuation surface (reference: LocalConnector)."""
+
+    async def add_component(self, component: str) -> bool: ...
+
+    async def remove_component(self, component: str) -> bool: ...
+
+
+class SupervisorConnector:
+    """Scale via the SDK Supervisor's watchers (in-process equivalent of
+    the reference's circus-arbiter state-file dance,
+    local_connector.py:105-322). Removal is graceful: the worker gets
+    SIGTERM, drains its endpoints and revokes its lease."""
+
+    def __init__(self, supervisor, component_to_watcher: dict[str, str]):
+        self.supervisor = supervisor
+        self.map = component_to_watcher
+
+    def _watcher(self, component: str):
+        return self.supervisor.watchers[self.map.get(component, component)]
+
+    async def add_component(self, component: str) -> bool:
+        w = self._watcher(component)
+        bound = w.max_workers()
+        if bound is not None and w.numprocesses + 1 > bound:
+            return False
+        await w.scale(w.numprocesses + 1)
+        return True
+
+    async def remove_component(self, component: str) -> bool:
+        w = self._watcher(component)
+        if w.numprocesses <= 0:
+            return False
+        await w.scale(w.numprocesses - 1)
+        return True
+
+
+@dataclass
+class MetricsWindow:
+    """One adjustment interval's samples."""
+
+    prefill_queue: list[float] = field(default_factory=list)
+    kv_load: list[float] = field(default_factory=list)
+    num_prefill: int = 0
+    num_decode: int = 0
+
+    @property
+    def avg_queue(self) -> float:
+        return statistics.fmean(self.prefill_queue) if self.prefill_queue else 0.0
+
+    @property
+    def avg_kv_load(self) -> float:
+        return statistics.fmean(self.kv_load) if self.kv_load else 0.0
+
+
+@dataclass
+class PlannerDecision:
+    add_prefill: bool = False
+    remove_prefill: bool = False
+    add_decode: bool = False
+    remove_decode: bool = False
+
+    def __bool__(self) -> bool:
+        return any(
+            (self.add_prefill, self.remove_prefill, self.add_decode, self.remove_decode)
+        )
+
+
+def decide(
+    cfg: PlannerConfig, win: MetricsWindow, decode_grace_left: int
+) -> PlannerDecision:
+    """Pure scaling policy over one window (reference:
+    make_adjustments, planner.py:202-320): scale down idle pools first,
+    then scale up the bottleneck — prefill before decode, since a backed-up
+    prefill queue also inflates decode KV load."""
+    d = PlannerDecision()
+    chips_used = (
+        win.num_prefill * cfg.prefill_engine_num_chips
+        + win.num_decode * cfg.decode_engine_num_chips
+    )
+    if (
+        cfg.disagg
+        and win.avg_queue < cfg.prefill_queue_scale_down_threshold
+        and win.num_prefill > cfg.min_endpoint
+    ):
+        d.remove_prefill = True
+        chips_used -= cfg.prefill_engine_num_chips
+    if (
+        win.avg_kv_load < cfg.decode_kv_scale_down_threshold
+        and win.num_decode > cfg.min_endpoint
+        and decode_grace_left <= 0
+    ):
+        d.remove_decode = True
+        chips_used -= cfg.decode_engine_num_chips
+    if (
+        cfg.disagg
+        and win.avg_queue > cfg.prefill_queue_scale_up_threshold
+        and chips_used + cfg.prefill_engine_num_chips <= cfg.max_chip_budget
+    ):
+        d.add_prefill = True
+        d.remove_prefill = False
+        chips_used += cfg.prefill_engine_num_chips
+    if (
+        win.avg_kv_load > cfg.decode_kv_scale_up_threshold
+        and chips_used + cfg.decode_engine_num_chips <= cfg.max_chip_budget
+    ):
+        d.add_decode = True
+        d.remove_decode = False
+    return d
+
+
+class Planner:
+    def __init__(self, runtime, connector: ScaleConnector, cfg: PlannerConfig):
+        self.runtime = runtime
+        self.connector = connector
+        self.cfg = cfg
+        self.queue = PrefillQueue(
+            runtime.hub, cfg.namespace, cfg.prefill_component
+        )
+        self._decode_client = None
+        self.aggregator: Optional[KvMetricsAggregator] = None
+        self._win = MetricsWindow()
+        self._decode_grace_left = 0
+        self._task: Optional[asyncio.Task] = None
+        self.adjustments: int = 0  # decision rounds taken (observability)
+
+    async def start(self) -> None:
+        ep = (
+            self.runtime.namespace(self.cfg.namespace)
+            .component(self.cfg.decode_component)
+            .endpoint(self.cfg.decode_endpoint)
+        )
+        self._decode_client = await ep.client()
+        self.aggregator = KvMetricsAggregator(
+            self._decode_client, poll_interval=self.cfg.metric_pull_interval_s
+        )
+        await self.aggregator.start()
+        self._task = asyncio.create_task(self._run())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+        if self.aggregator is not None:
+            await self.aggregator.close()
+
+    async def _collect(self) -> None:
+        if self.cfg.disagg:
+            try:
+                self._win.prefill_queue.append(float(await self.queue.size()))
+            except Exception:  # noqa: BLE001 — queue may not exist yet
+                pass
+        snap = self.aggregator.current
+        if snap.endpoints:
+            self._win.kv_load.append(
+                statistics.fmean(
+                    m.gpu_cache_usage_perc + 0.02 * m.num_requests_waiting
+                    for m in snap.endpoints.values()
+                )
+            )
+        self._win.num_decode = len(snap.endpoints)
+
+    async def _adjust(self) -> None:
+        win, self._win = self._win, MetricsWindow()
+        win.num_prefill = await self._count_prefill()
+        win.num_decode = len(self.aggregator.current.endpoints)
+        decision = decide(self.cfg, win, self._decode_grace_left)
+        self.adjustments += 1
+        self._decode_grace_left = max(0, self._decode_grace_left - 1)
+        if not decision:
+            return
+        log.info(
+            "planner: queue=%.2f kv=%.2f p=%d d=%d -> %s",
+            win.avg_queue, win.avg_kv_load, win.num_prefill, win.num_decode,
+            decision,
+        )
+        if decision.remove_prefill:
+            await self.connector.remove_component(self.cfg.prefill_component)
+        if decision.remove_decode:
+            await self.connector.remove_component(self.cfg.decode_component)
+        if decision.add_prefill:
+            await self.connector.add_component(self.cfg.prefill_component)
+        if decision.add_decode:
+            if await self.connector.add_component(self.cfg.decode_component):
+                self._decode_grace_left = self.cfg.scale_down_grace_rounds
+        win.num_prefill = await self._count_prefill()
+
+    async def _count_prefill(self) -> int:
+        if not self.cfg.disagg:
+            return 0
+        try:
+            comp = self.runtime.namespace(self.cfg.namespace).component(
+                self.cfg.prefill_component
+            )
+            return len(await comp.list_instances())
+        except Exception:  # noqa: BLE001
+            return 0
+
+    async def _run(self) -> None:
+        last_adjust = asyncio.get_running_loop().time()
+        while True:
+            await asyncio.sleep(self.cfg.metric_pull_interval_s)
+            await self._collect()
+            now = asyncio.get_running_loop().time()
+            if now - last_adjust >= self.cfg.adjustment_interval_s:
+                last_adjust = now
+                try:
+                    await self._adjust()
+                except Exception:  # noqa: BLE001 — a failed actuation must
+                    # not kill the control loop
+                    log.exception("planner adjustment failed")

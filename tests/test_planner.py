@@ -1,0 +1,207 @@
+"""Planner/autoscaler: pure policy unit tests + a live control loop over
+the stats plane (reference behavior: examples/llm/components/planner.py
+collect_metrics/make_adjustments)."""
+
+from __future__ import annotations
+
+import asyncio
+
+from dynamo_tpu.llm.disagg import PrefillQueue, RemotePrefillRequest
+from dynamo_tpu.llm.planner import (
+    MetricsWindow,
+    Planner,
+    PlannerConfig,
+    SupervisorConnector,
+    decide,
+)
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+from .helpers import hub_server
+
+CFG = PlannerConfig(
+    namespace="plan",
+    decode_component="decoder",
+    prefill_component="prefiller",
+    min_endpoint=1,
+    max_chip_budget=4,
+)
+
+
+def win(queue=0.0, kv=0.0, p=1, d=1) -> MetricsWindow:
+    return MetricsWindow(
+        prefill_queue=[queue], kv_load=[kv], num_prefill=p, num_decode=d
+    )
+
+
+def test_decide_scale_up_prefill_under_queue_pressure():
+    d = decide(CFG, win(queue=10.0), 0)
+    assert d.add_prefill and not d.remove_prefill
+    assert not d.add_decode and not d.remove_decode
+
+
+def test_decide_scale_up_decode_under_kv_pressure():
+    d = decide(CFG, win(kv=0.95), 0)
+    assert d.add_decode and not d.remove_decode
+
+
+def test_decide_scale_down_idle_pools():
+    d = decide(CFG, win(queue=0.0, kv=0.0, p=2, d=2), 0)
+    assert d.remove_prefill and d.remove_decode
+
+
+def test_decide_min_endpoint_floor():
+    d = decide(CFG, win(queue=0.0, kv=0.0, p=1, d=1), 0)
+    assert not d
+
+
+def test_decide_respects_chip_budget():
+    # budget 4, already 2 prefill + 2 decode chips used: no room to grow
+    d = decide(CFG, win(queue=10.0, kv=0.95, p=2, d=2), 0)
+    assert not d.add_prefill and not d.add_decode
+
+
+def test_decide_scale_down_waits_for_grace():
+    assert not decide(CFG, win(kv=0.0, d=2, queue=5.0), 1).remove_decode
+    assert decide(CFG, win(kv=0.0, d=2, queue=5.0), 0).remove_decode
+
+
+def test_decide_aggregated_mode_ignores_prefill():
+    cfg = PlannerConfig(disagg=False, min_endpoint=1, max_chip_budget=4)
+    d = decide(cfg, win(queue=50.0, kv=0.95, p=0, d=1), 0)
+    assert d.add_decode and not d.add_prefill
+
+
+class _RecordingConnector:
+    def __init__(self):
+        self.calls: list[tuple[str, str]] = []
+
+    async def add_component(self, component: str) -> bool:
+        self.calls.append(("add", component))
+        return True
+
+    async def remove_component(self, component: str) -> bool:
+        self.calls.append(("remove", component))
+        return True
+
+
+async def test_planner_loop_scales_on_live_metrics():
+    """Queue pressure on the hub + high KV load in worker stats must drive
+    add_component calls within one adjustment interval; draining both must
+    then drive the scale-down (after the grace round)."""
+    async with hub_server() as server:
+        hub_addr = f"127.0.0.1:{server.port}"
+        worker = await DistributedRuntime.from_settings(hub_addr=hub_addr)
+        observer = await DistributedRuntime.from_settings(hub_addr=hub_addr)
+        try:
+            load = {"kv": 0.95}
+
+            class _Echo:
+                async def generate(self, ctx):
+                    async def s():
+                        yield {}
+
+                    return s()
+
+            ep = (
+                worker.namespace("plan").component("decoder").endpoint("generate")
+            )
+            await ep.endpoint_builder().engine(_Echo()).stats_handler(
+                lambda: {
+                    "gpu_cache_usage_perc": load["kv"],
+                    "request_active_slots": 4,
+                    "request_total_slots": 4,
+                }
+            ).start()
+
+            # one live prefill instance so the scale-down path has
+            # something above the min_endpoint floor to remove
+            pep = (
+                worker.namespace("plan").component("prefiller").endpoint("generate")
+            )
+            await pep.endpoint_builder().engine(_Echo()).start()
+
+            q = PrefillQueue(observer.hub, "plan", "prefiller")
+            for i in range(8):
+                await q.push(
+                    RemotePrefillRequest(
+                        request_id=str(i), pre={}, decode_address="", ingest_subject=""
+                    )
+                )
+
+            cfg = PlannerConfig(
+                namespace="plan",
+                decode_component="decoder",
+                prefill_component="prefiller",
+                metric_pull_interval_s=0.05,
+                adjustment_interval_s=0.3,
+                min_endpoint=0,
+                max_chip_budget=8,
+                scale_down_grace_rounds=1,
+            )
+            connector = _RecordingConnector()
+            planner = Planner(observer, connector, cfg)
+            await planner.start()
+            try:
+                for _ in range(100):
+                    if ("add", "prefiller") in connector.calls and (
+                        "add",
+                        "decoder",
+                    ) in connector.calls:
+                        break
+                    await asyncio.sleep(0.1)
+                assert ("add", "prefiller") in connector.calls
+                assert ("add", "decoder") in connector.calls
+
+                # drain pressure: queue empty + idle KV -> scale down
+                while await q.size() > 0:
+                    await q.pop(timeout=0.1)
+                load["kv"] = 0.0
+                connector.calls.clear()
+                for _ in range(100):
+                    if ("remove", "decoder") in connector.calls:
+                        break
+                    await asyncio.sleep(0.1)
+                assert ("remove", "prefiller") in connector.calls
+                assert ("remove", "decoder") in connector.calls
+            finally:
+                await planner.stop()
+        finally:
+            await worker.shutdown()
+            await observer.shutdown()
+
+
+async def test_supervisor_connector_scales_watchers():
+    """SupervisorConnector must actuate real Watcher rescale (the
+    LocalConnector equivalent), including the TPU-chip bound."""
+    from dynamo_tpu.sdk.supervisor import Supervisor, Watcher
+
+    sup = Supervisor(hub_addr="unused")
+    import sys
+
+    sup.watchers["decoder"] = Watcher(
+        name="t_decoder",
+        # the watcher appends "--worker-id N"; -c scripts absorb it in argv
+        args=[sys.executable, "-c", "import time; time.sleep(60)"],
+        env={},
+        numprocesses=1,
+    )
+    conn = SupervisorConnector(sup, {"decode": "decoder"})
+    await sup.watchers["decoder"].start()
+    try:
+        assert await conn.add_component("decode")
+        assert sup.watchers["decoder"].numprocesses == 2
+        for _ in range(50):
+            if sup.watchers["decoder"].alive_count() == 2:
+                break
+            await asyncio.sleep(0.1)
+        assert sup.watchers["decoder"].alive_count() == 2
+        assert await conn.remove_component("decode")
+        assert sup.watchers["decoder"].numprocesses == 1
+
+        # chip-bound: 2 chips / 1 per worker -> bound 2
+        sup.watchers["decoder"].env["DYN_TPU_CHIPS"] = "0,1"
+        sup.watchers["decoder"].env["DYN_TPU_CHIPS_PER_WORKER"] = "1"
+        assert await conn.add_component("decode")
+        assert not await conn.add_component("decode")
+    finally:
+        await sup.watchers["decoder"].stop()
