@@ -109,18 +109,21 @@ class JaxEngine:
         self._kv_sharding = meshmod.kv_cache_sharding(self.mesh)
 
         backend = jax.default_backend()
+        # the serving engine's mesh is tp-only (dp = separate workers, sp
+        # for long prefill, pp/ep future); the pallas decode kernel runs
+        # under tp via shard_map (AttnSpec.mesh) — other axes fall back
+        mc = config.mesh
+        tp_only = mc.num_devices == mc.tp
         if config.attn_backend == "auto":
-            # pallas kernel needs shard_map integration for tp>1; single
-            # device on TPU is the supported fast path today
-            self._attn_pallas = (
-                backend == "tpu" and config.mesh.num_devices == 1
-            )
+            self._attn_pallas = backend == "tpu" and tp_only
             self._attn_interpret = False
         elif config.attn_backend == "pallas":
-            if config.mesh.num_devices > 1:
+            if not tp_only:
                 raise ValueError(
-                    "attn_backend='pallas' requires a single-device mesh for "
-                    "now (shard_map integration pending); use 'auto'"
+                    "attn_backend='pallas' supports single-device or "
+                    "tp-only meshes (got "
+                    f"{dict(dp=mc.dp, sp=mc.sp, pp=mc.pp, ep=mc.ep)}); "
+                    "use 'auto'"
                 )
             self._attn_pallas = True
             self._attn_interpret = backend != "tpu"
@@ -132,6 +135,8 @@ class JaxEngine:
                 f"unknown attn_backend {config.attn_backend!r}; "
                 "expected 'auto', 'pallas' or 'gather'"
             )
+        # mesh for shard_map'ing the kernel; None on a single device
+        self._attn_mesh = self.mesh if mc.num_devices > 1 else None
 
         if params is None:
             if config.checkpoint_dir:
@@ -311,6 +316,7 @@ class JaxEngine:
                         active & (positions < max_len), positions, -1
                     ).astype(jnp.int32),
                     interpret=self._attn_interpret,
+                    mesh=self._attn_mesh,
                 )
             else:
                 page_idx = jnp.minimum(positions // s, w - 1)

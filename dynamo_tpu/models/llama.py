@@ -16,6 +16,7 @@ are `x @ w` — the natural MXU orientation.
 
 from __future__ import annotations
 
+import functools
 from typing import Any, NamedTuple
 
 import jax
@@ -45,18 +46,26 @@ class AttnSpec:
     - pallas decode, read-only: as above with `write_pos=None`; KV is
       scattered first (oracle write), the kernel only reads.
 
-    Registered as a pytree with `page_size`/`interpret` as static aux data
-    so they stay Python values under jit.
+    Registered as a pytree with `page_size`/`interpret`/`mesh` as static
+    aux data so they stay Python values under jit.
+
+    `mesh` (optional, static — jax Mesh objects hash) requests tensor-
+    parallel execution of the pallas kernel: the caller's q/new-KV/pools
+    are head-sharded over the mesh's `tp` axis, and `_attn_block` wraps
+    the kernel in `jax.shard_map` so each shard runs it on its local KV
+    heads (attention is per-head; no collectives needed inside).
     """
 
     def __init__(self, slot_matrix=None, block_tables=None, lengths=None,
-                 write_pos=None, page_size: int = 16, interpret: bool = False):
+                 write_pos=None, page_size: int = 16, interpret: bool = False,
+                 mesh=None):
         self.slot_matrix = slot_matrix
         self.block_tables = block_tables
         self.lengths = lengths
         self.write_pos = write_pos
         self.page_size = page_size
         self.interpret = interpret
+        self.mesh = mesh
 
     @classmethod
     def gather(cls, slot_matrix):
@@ -64,13 +73,14 @@ class AttnSpec:
 
     @classmethod
     def pallas_decode(cls, block_tables, lengths, page_size, write_pos=None,
-                      interpret=False):
+                      interpret=False, mesh=None):
         return cls(
             block_tables=block_tables,
             lengths=lengths,
             write_pos=write_pos,
             page_size=page_size,
             interpret=interpret,
+            mesh=mesh,
         )
 
 
@@ -78,11 +88,11 @@ jax.tree_util.register_pytree_node(
     AttnSpec,
     lambda s: (
         (s.slot_matrix, s.block_tables, s.lengths, s.write_pos),
-        (s.page_size, s.interpret),
+        (s.page_size, s.interpret, s.mesh),
     ),
     lambda aux, children: AttnSpec(
         slot_matrix=children[0], block_tables=children[1], lengths=children[2],
-        write_pos=children[3], page_size=aux[0], interpret=aux[1],
+        write_pos=children[3], page_size=aux[0], interpret=aux[1], mesh=aux[2],
     ),
 )
 
@@ -158,7 +168,29 @@ def _attn_block(
     if attn.block_tables is not None and attn.write_pos is not None:
         from dynamo_tpu.ops.pallas_attention import fused_paged_decode_attention
 
-        out, kv_k, kv_v = fused_paged_decode_attention(
+        fused = functools.partial(
+            fused_paged_decode_attention,
+            page_size=attn.page_size,
+            interpret=attn.interpret,
+        )
+        if attn.mesh is not None:
+            # tensor parallel: every array argument that carries heads is
+            # tp-sharded (q over H, new rows / pools over the folded K*Hd
+            # — whole KV heads per shard by layout); tables/lengths/
+            # write_pos replicate. Each shard runs the kernel on its
+            # local heads — attention has no cross-head math.
+            P = jax.sharding.PartitionSpec
+            fused = jax.shard_map(
+                fused,
+                mesh=attn.mesh,
+                in_specs=(
+                    P(None, "tp", None), P(None, "tp"), P(None, "tp"),
+                    P(None, "tp"), P(None, "tp"), P(), P(), P(),
+                ),
+                out_specs=(P(None, "tp", None), P(None, "tp"), P(None, "tp")),
+                check_vma=False,
+            )
+        out, kv_k, kv_v = fused(
             q[:, 0],
             k[:, 0].reshape(b, kh * hd),
             v[:, 0].reshape(b, kh * hd),
@@ -167,8 +199,6 @@ def _attn_block(
             attn.block_tables,
             attn.lengths,
             attn.write_pos,
-            page_size=attn.page_size,
-            interpret=attn.interpret,
         )
         out = out[:, None]
     else:
@@ -179,14 +209,29 @@ def _attn_block(
         if attn.block_tables is not None:
             from dynamo_tpu.ops.pallas_attention import paged_decode_attention
 
-            out = paged_decode_attention(
+            ro = functools.partial(
+                paged_decode_attention,
+                page_size=attn.page_size,
+                interpret=attn.interpret,
+            )
+            if attn.mesh is not None:
+                P = jax.sharding.PartitionSpec
+                ro = jax.shard_map(
+                    ro,
+                    mesh=attn.mesh,
+                    in_specs=(
+                        P(None, "tp", None), P(None, "tp"), P(None, "tp"),
+                        P(), P(),
+                    ),
+                    out_specs=P(None, "tp", None),
+                    check_vma=False,
+                )
+            out = ro(
                 q[:, 0],
                 kv_k,
                 kv_v,
                 attn.block_tables,
                 attn.lengths,
-                page_size=attn.page_size,
-                interpret=attn.interpret,
             )[:, None]
         else:
             out = paged_attention(q, kv_k, kv_v, attn.slot_matrix, positions)

@@ -228,3 +228,24 @@ async def test_prompt_exceeding_kv_pool_rejected():
     except ValueError as e:
         assert "KV pool" in str(e)
     await engine.close()
+
+
+async def test_tp2_pallas_matches_gather():
+    """The shard_map'd pallas decode kernel under tp=2 (interpret mode on
+    the virtual CPU mesh) must reproduce the gather oracle bit-exactly in
+    f32 — the flagship multi-chip path must not change results."""
+    from dynamo_tpu.parallel.mesh import MeshConfig
+
+    prompt = [5, 17, 42, 9, 88, 3, 14]
+    outs = {}
+    for backend in ("gather", "pallas"):
+        engine = make_engine(
+            mesh=MeshConfig(tp=2), attn_backend=backend, decode_steps=4
+        )
+        tokens, finish, _ = await collect(
+            engine, greedy_request(prompt, max_tokens=8)
+        )
+        outs[backend] = tokens
+        assert finish == "length"
+        await engine.close()
+    assert outs["pallas"] == outs["gather"], outs
