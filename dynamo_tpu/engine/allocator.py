@@ -58,12 +58,16 @@ class PageAllocator:
         num_pages: int,
         page_size: int,
         on_event: Optional[Callable[[dict], None]] = None,
+        on_cached: Optional[Callable[[int, "PageMeta"], None]] = None,
     ):
         if num_pages < 2:
             raise ValueError("need at least 2 pages (page 0 is reserved)")
         self.page_size = page_size
         self.num_pages = num_pages
         self.on_event = on_event
+        # called when a hashed page's refcount drops to 0 (it became
+        # reusable-and-evictable) — the offload tier's write-through hook
+        self.on_cached = on_cached
         self._free: deque[int] = deque(range(1, num_pages))
         self._meta: dict[int, PageMeta] = {}
         self._by_hash: dict[int, int] = {}  # sequence_hash -> page_id
@@ -98,16 +102,25 @@ class PageAllocator:
         pages: list[int] = []
         for h in sequence_hashes:
             self.lookups += 1
-            pid = self._by_hash.get(h)
+            pid = self.pin(h)
             if pid is None:
                 break
             self.hits += 1
-            meta = self._meta[pid]
-            if meta.refs == 0:
-                self._lru.pop(h, None)
-            meta.refs += 1
             pages.append(pid)
         return pages
+
+    def pin(self, sequence_hash: int) -> Optional[int]:
+        """Take a reference on a cached page by hash (the cached->active
+        transition; also keeps a page unevictable while the offload tier
+        copies it out); pair with `release`."""
+        pid = self._by_hash.get(sequence_hash)
+        if pid is None:
+            return None
+        meta = self._meta[pid]
+        if meta.refs == 0:
+            self._lru.pop(sequence_hash, None)
+        meta.refs += 1
+        return pid
 
     def peek_prefix_tokens(self, token_ids: list[int]) -> int:
         """Non-destructive longest-cached-prefix length in tokens (no
@@ -181,6 +194,8 @@ class PageAllocator:
                 continue
             if meta.sequence_hash is not None and self._by_hash.get(meta.sequence_hash) == pid:
                 self._lru[meta.sequence_hash] = pid
+                if self.on_cached:
+                    self.on_cached(pid, meta)
             else:
                 del self._meta[pid]
                 self._free.append(pid)

@@ -27,6 +27,13 @@ class EngineConfig:
     # "pallas": force the kernel (interpret mode off-TPU); "gather": oracle
     attn_backend: str = "auto"
 
+    # HBM->host KV offload tier (reference: lib/llm/src/kv reuse/manager):
+    # 0 disables; else pages whose refcount hits 0 are write-through
+    # copied to a host-RAM pool of this many pages, restored on prefix
+    # hit after HBM eviction
+    host_kv_pages: int = 0
+    offload_batch_pages: int = 16  # pages per background gather dispatch
+
     max_batch_size: int = 8       # decode slots
     max_model_len: int = 2048     # context limit per sequence
     prefill_chunk: int = 512      # longest single prefill call (longer prompts chunk)

@@ -165,8 +165,24 @@ class JaxEngine:
         self._event_seq = 0
         self._event_subscribers: list[Callable[[dict], None]] = []
         self.allocator = PageAllocator(
-            self.num_pages, self.page_size, on_event=self._emit_event
+            self.num_pages, self.page_size, on_event=self._emit_event,
+            on_cached=self._on_page_cached if config.host_kv_pages else None,
         )
+        # HBM->host offload tier (engine/offload.py); None when disabled
+        self.host_pool = None
+        self._pending_offload: dict[int, tuple[int, Optional[int]]] = {}
+        self._offload_task: Optional[asyncio.Task] = None
+        if config.host_kv_pages:
+            from dynamo_tpu.engine.offload import HostKvPool
+
+            self.host_pool = HostKvPool(
+                config.host_kv_pages,
+                self.model_cfg.num_layers,
+                self.page_size,
+                self.model_cfg.num_kv_heads * self.model_cfg.head_dim,
+                dtype=self._dtype.dtype,
+                on_event=self._emit_event,
+            )
 
         self.waiting: deque[Sequence] = deque()
         self.slots: list[Optional[Sequence]] = [None] * config.max_batch_size
@@ -470,6 +486,12 @@ class JaxEngine:
                 await self._loop_task
             except asyncio.CancelledError:
                 pass
+        if self._offload_task is not None and not self._offload_task.done():
+            self._offload_task.cancel()
+            try:
+                await self._offload_task
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
         for seq in list(self.waiting) + [s for s in self.slots if s]:
             seq.out_queue.put_nowait(
                 EngineOutput.final(FINISH_REASON_CANCELLED).to_dict()
@@ -481,6 +503,9 @@ class JaxEngine:
     async def _loop(self) -> None:
         try:
             while not self._closed:
+                # offload first: pending write-through copies must pin
+                # their pages before this tick's admission can evict them
+                self._maybe_start_offload()
                 progressed = self._admit_new()
                 # per tick: prefill chunks enqueue first (they own self.kv
                 # until their dispatch call returns), then decode dispatch
@@ -569,22 +594,37 @@ class JaxEngine:
         return progressed
 
     def _reserve_pages(self, seq: Sequence) -> bool:
-        """Prefix-match then allocate pages covering all current tokens."""
+        """Prefix-match (HBM, then host tier) and allocate pages covering
+        all current tokens; host-tier hits are restored by H2D scatter."""
         t = seq.total_tokens
-        matched = self.allocator.match_prefix(seq.blocks.sequence_hashes())
-        if len(matched) * self.page_size >= t:
-            # fully cached: recompute the last page so there is >=1 query
-            self.allocator.release([matched[-1]])
-            matched = matched[:-1]
+        hashes = seq.blocks.sequence_hashes()
+        matched = self.allocator.match_prefix(hashes)
+        host_run: list[int] = []
+        if self.host_pool is not None:
+            host_run = self.host_pool.match_prefix(hashes[len(matched):])
+        # ensure >=1 token is computed (there must be a query position)
+        while (len(matched) + len(host_run)) * self.page_size >= t:
+            if host_run:
+                host_run.pop()
+            else:
+                self.allocator.release([matched[-1]])
+                matched = matched[:-1]
         need = -(-t // self.page_size) - len(matched)
         fresh = self.allocator.allocate(need) if need else []
         if fresh is None:
             self.allocator.release(matched)
             return False
+        if host_run:
+            try:
+                self._restore_from_host(seq, fresh[: len(host_run)], len(matched))
+            except Exception:
+                # restore is an optimization; fall back to recompute
+                log.exception("host-tier restore failed; recomputing")
+                host_run = []
         seq.page_ids = matched + fresh
-        seq.num_cached = len(matched) * self.page_size
+        seq.num_cached = (len(matched) + len(host_run)) * self.page_size
         seq.num_computed = seq.num_cached
-        seq.registered_pages = len(matched)
+        seq.registered_pages = len(matched) + len(host_run)
         return True
 
     # ---- prefill ------------------------------------------------------
@@ -983,6 +1023,120 @@ class JaxEngine:
             parent_hash=blocks[0].parent_sequence_hash if blocks else None,
         )
         seq.registered_pages = full
+
+    def peek_prefix_tokens(self, token_ids: list[int]) -> int:
+        """Non-destructive cached-prefix length across BOTH tiers (HBM,
+        then host continuation) — the disagg/router decision input must
+        agree with what _reserve_pages would actually reuse."""
+        from dynamo_tpu.llm.tokens import compute_block_hashes
+
+        hashes = compute_block_hashes(token_ids, self.page_size)
+        n = 0
+        for h in hashes:
+            if h in self.allocator._by_hash:
+                n += 1
+            else:
+                break
+        if self.host_pool is not None:
+            for h in hashes[n:]:
+                if h in self.host_pool:
+                    n += 1
+                else:
+                    break
+        return n * self.page_size
+
+    # ---- HBM->host offload tier --------------------------------------
+
+    def _on_page_cached(self, pid: int, meta) -> None:
+        """Allocator hook: a hashed page just hit refs==0 — queue its
+        write-through copy to the host tier (reference: reuse.rs
+        return-to-pool path feeding the offload manager)."""
+        if meta.sequence_hash in self.host_pool:
+            return
+        self._pending_offload[meta.sequence_hash] = (
+            meta.local_hash, meta.parent_hash
+        )
+
+    def _maybe_start_offload(self) -> None:
+        """Launch one background offload batch if work is queued and no
+        batch is in flight (single-flight keeps device pressure bounded)."""
+        if not self._pending_offload:
+            return
+        if self._offload_task is not None and not self._offload_task.done():
+            return
+        batch: list[tuple[int, int, Optional[int], int, object]] = []
+        for sh in list(self._pending_offload):
+            if len(batch) >= self.config.offload_batch_pages:
+                break
+            lh, parent = self._pending_offload.pop(sh)
+            # pin BEFORE reserving a buffer: reserve() may LRU-evict a
+            # live host entry, which must not happen for a page that is
+            # already gone from HBM (nothing to copy — pure data loss)
+            pid = self.allocator.pin(sh)
+            if pid is None:
+                continue
+            buf = self.host_pool.reserve()
+            if buf is None:
+                self.allocator.release([pid])
+                self._pending_offload[sh] = (lh, parent)
+                break
+            batch.append((sh, lh, parent, pid, buf))
+        if batch:
+            self._offload_task = asyncio.create_task(self._offload_batch(batch))
+
+    async def _offload_batch(self, batch) -> None:
+        ps = self.page_size
+        slots = np.concatenate(
+            [pid * ps + np.arange(ps, dtype=np.int32) for *_, pid, _b in batch]
+        )
+
+        def _gather():
+            with self._kv_lock:
+                k, v = self._extract_fn(self.kv, jnp.asarray(slots))
+            return np.asarray(k), np.asarray(v)  # [L, n*ps, kw]
+
+        consumed = 0
+        try:
+            k, v = await asyncio.to_thread(_gather)
+            for i, (sh, lh, parent, pid, buf) in enumerate(batch):
+                buf.value[0] = k[:, i * ps : (i + 1) * ps]
+                buf.value[1] = v[:, i * ps : (i + 1) * ps]
+                self.host_pool.put(sh, lh, parent, buf)  # consumes buf
+                consumed = i + 1
+        except Exception:
+            log.exception("offload gather failed; dropping batch")
+        finally:
+            # CancelledError (engine close) must not leak buffers or pins
+            for _, _, _, _, buf in batch[consumed:]:
+                buf.release()
+            self.allocator.release([pid for _, _, _, pid, _ in batch])
+            # re-arm the loop: remaining pending entries must offload
+            # before admission traffic can evict their HBM pages
+            self._wake.set()
+
+    def _restore_from_host(self, seq: Sequence, page_ids: list[int], start_block: int) -> None:
+        """Scatter host-tier pages back into freshly allocated device
+        pages and index them (reference: manager.rs tiered onboard +
+        layer.rs CopyStream H2D)."""
+        ps = self.page_size
+        blocks = seq.blocks.blocks[start_block : start_block + len(page_ids)]
+        nk = np.stack([self.host_pool.get(b.sequence_hash)[0] for b in blocks], axis=1)
+        nv = np.stack([self.host_pool.get(b.sequence_hash)[1] for b in blocks], axis=1)
+        # [L, n, ps, kw] -> [L, n*ps, kw]
+        nk = nk.reshape(nk.shape[0], -1, nk.shape[-1])
+        nv = nv.reshape(nv.shape[0], -1, nv.shape[-1])
+        slots = np.concatenate(
+            [pid * ps + np.arange(ps, dtype=np.int32) for pid in page_ids]
+        )
+        with self._kv_lock:
+            self.kv = self._inject_fn(
+                self.kv, jnp.asarray(slots), jnp.asarray(nk), jnp.asarray(nv)
+            )
+        self.allocator.register(
+            page_ids,
+            [(b.sequence_hash, b.local_hash) for b in blocks],
+            parent_hash=blocks[0].parent_sequence_hash if blocks else None,
+        )
 
     def _append_token(self, seq: Sequence, token: int, extra_meta: Optional[dict] = None) -> None:
         seq.blocks.extend([token])

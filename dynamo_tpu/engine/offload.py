@@ -1,0 +1,127 @@
+"""HBM→host KV offload tier.
+
+TPU-native equivalent of the reference's multi-tier KV block manager
+(reference: lib/llm/src/kv/reuse.rs:50-638 reuse pool, manager.rs:22-120
+tiered lookup, layer.rs CopyStream device<->host copies): pages whose
+refcount drops to zero are write-through copied to a host-RAM pool in
+batched background gathers, so when the HBM prefix cache later evicts
+them, a new request with the same prefix restores the pages from host RAM
+with one scatter instead of recomputing prefill — the +40% TTFT offload
+win in BASELINE.md.
+
+Buffer management rides `dynamo_tpu.utils.pool.Pool` (the reference's
+RAII pool, lib/runtime/src/utils/pool.rs): host page buffers are
+preallocated numpy arrays checked out per offloaded page and returned on
+LRU eviction, so steady-state offload does zero host allocation.
+
+Event plane: the host tier emits the same stored/removed KV events as the
+device tier, tagged `"tier": "host"`, so routers can weight host-tier
+hits differently (device-tier events carry no tag).
+"""
+
+from __future__ import annotations
+
+import logging
+from collections import OrderedDict
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+import numpy as np
+
+from dynamo_tpu.engine.allocator import removed_event, stored_event
+from dynamo_tpu.utils.pool import Pool, PoolItem
+
+log = logging.getLogger("dynamo_tpu.engine.offload")
+
+
+@dataclass
+class HostPageEntry:
+    local_hash: int
+    parent_hash: Optional[int]
+    buf: PoolItem  # .value: np.ndarray [2, L, page_size, K*Hd] (k, v)
+
+
+class HostKvPool:
+    """LRU host-RAM pool of KV pages keyed by chained sequence hash."""
+
+    def __init__(
+        self,
+        capacity_pages: int,
+        num_layers: int,
+        page_size: int,
+        kv_width: int,
+        dtype=np.float32,
+        on_event: Optional[Callable[[dict], None]] = None,
+    ):
+        self.capacity = capacity_pages
+        shape = (2, num_layers, page_size, kv_width)
+        self._buffers: Pool[np.ndarray] = Pool(
+            factory=lambda: np.empty(shape, dtype), capacity=capacity_pages
+        )
+        self._entries: "OrderedDict[int, HostPageEntry]" = OrderedDict()
+        self.on_event = on_event
+        self.lookups = 0
+        self.hits = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def __contains__(self, sequence_hash: int) -> bool:
+        return sequence_hash in self._entries
+
+    def reserve(self) -> Optional[PoolItem]:
+        """A free page buffer, LRU-evicting if at capacity."""
+        item = self._buffers.try_acquire()
+        if item is not None:
+            return item
+        if not self._entries:
+            return None
+        evicted_hash, entry = self._entries.popitem(last=False)
+        entry.buf.release()
+        if self.on_event:
+            self.on_event({**removed_event([evicted_hash]), "tier": "host"})
+        return self._buffers.try_acquire()
+
+    def put(
+        self,
+        sequence_hash: int,
+        local_hash: int,
+        parent_hash: Optional[int],
+        buf: PoolItem,
+    ) -> None:
+        """Index a filled buffer (from `reserve`) under its hash."""
+        if sequence_hash in self._entries:
+            buf.release()
+            return
+        self._entries[sequence_hash] = HostPageEntry(local_hash, parent_hash, buf)
+        if self.on_event:
+            self.on_event(
+                {
+                    **stored_event(
+                        [(sequence_hash, local_hash, -1)], parent_hash=parent_hash
+                    ),
+                    "tier": "host",
+                }
+            )
+
+    def match_prefix(self, sequence_hashes: list[int]) -> list[int]:
+        """Length of the leading run present in the pool, as hash list."""
+        out = []
+        for h in sequence_hashes:
+            self.lookups += 1
+            if h not in self._entries:
+                break
+            self.hits += 1
+            self._entries.move_to_end(h)
+            out.append(h)
+        return out
+
+    def get(self, sequence_hash: int) -> Optional[np.ndarray]:
+        entry = self._entries.get(sequence_hash)
+        if entry is None:
+            return None
+        self._entries.move_to_end(sequence_hash)
+        return entry.buf.value
+
+    def hit_rate(self) -> float:
+        return self.hits / self.lookups if self.lookups else 0.0

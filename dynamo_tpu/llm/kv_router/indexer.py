@@ -36,7 +36,10 @@ class OverlapScores:
 
 @dataclass
 class _Node:
-    workers: set[int] = field(default_factory=set)
+    # worker -> tiers ("device"/"host") holding the block; a worker keeps
+    # the block while ANY tier has it (the offload pool restores host-tier
+    # blocks with one H2D scatter, far cheaper than recompute)
+    workers: dict[int, set[str]] = field(default_factory=dict)
     parent: Optional[int] = None
 
 
@@ -49,13 +52,14 @@ class RadixTree:
     def apply_event(self, ev: RouterEvent) -> None:
         self.event_count += 1
         worker, e = ev.worker_id, ev.event
+        tier = getattr(e, "tier", "device") or "device"
         if e.type == "stored":
             parent = e.parent_hash
             for blk in e.blocks:
                 node = self._nodes.get(blk.block_hash)
                 if node is None:
                     node = self._nodes[blk.block_hash] = _Node(parent=parent)
-                node.workers.add(worker)
+                node.workers.setdefault(worker, set()).add(tier)
                 self._worker_blocks[worker].add(blk.block_hash)
                 parent = blk.block_hash
         elif e.type == "removed":
@@ -63,8 +67,13 @@ class RadixTree:
                 node = self._nodes.get(h)
                 if node is None:
                     continue
-                node.workers.discard(worker)
-                self._worker_blocks[worker].discard(h)
+                tiers = node.workers.get(worker)
+                if tiers is None:
+                    continue
+                tiers.discard(tier)
+                if not tiers:
+                    del node.workers[worker]
+                    self._worker_blocks[worker].discard(h)
                 if not node.workers:
                     del self._nodes[h]
 
@@ -75,7 +84,7 @@ class RadixTree:
             node = self._nodes.get(h)
             if node is None:
                 continue
-            node.workers.discard(worker_id)
+            node.workers.pop(worker_id, None)
             if not node.workers:
                 del self._nodes[h]
 
@@ -86,7 +95,8 @@ class RadixTree:
             node = self._nodes.get(h)
             if node is None:
                 break
-            active = set(node.workers) if active is None else active & node.workers
+            holders = set(node.workers)
+            active = holders if active is None else active & holders
             if not active:
                 break
             out.matched_blocks += 1

@@ -35,7 +35,11 @@ class StoredBlock:
 
 @dataclass
 class KvCacheEvent:
-    """type: "stored" | "removed" (reference: KvCacheEventData)."""
+    """type: "stored" | "removed" (reference: KvCacheEventData).
+
+    `tier` distinguishes where the blocks live on the worker: "device"
+    (HBM) or "host" (the offload pool, engine/offload.py) — a worker
+    holds a block as long as ANY tier does."""
 
     type: str
     event_id: int = 0
@@ -43,6 +47,7 @@ class KvCacheEvent:
     blocks: list[StoredBlock] = field(default_factory=list)   # stored
     block_hashes: list[int] = field(default_factory=list)      # removed
     block_size: int = 0
+    tier: str = "device"
 
     def to_dict(self) -> dict:
         return asdict(self)
@@ -56,6 +61,7 @@ class KvCacheEvent:
             blocks=[StoredBlock.from_dict(b) for b in d.get("blocks") or []],
             block_hashes=list(d.get("block_hashes") or []),
             block_size=d.get("block_size", 0),
+            tier=d.get("tier", "device"),
         )
 
 

@@ -1,0 +1,92 @@
+"""HBM->host KV offload tier (reference: lib/llm/src/kv/reuse.rs:50-638,
+manager.rs:22-120 tiered lookup, layer.rs CopyStream): write-through to
+host RAM at refs==0, restore-on-prefix-hit after HBM eviction."""
+
+from __future__ import annotations
+
+import asyncio
+
+import numpy as np
+
+from dynamo_tpu.engine.offload import HostKvPool
+from dynamo_tpu.llm.tokens import TokenBlockSequence
+
+from .test_engine import collect, greedy_request, make_engine
+
+
+def test_host_pool_lru_and_buffer_reuse():
+    events = []
+    pool = HostKvPool(
+        capacity_pages=2, num_layers=1, page_size=4, kv_width=8,
+        on_event=events.append,
+    )
+    for h in (10, 20, 30):
+        buf = pool.reserve()
+        assert buf is not None
+        buf.value[:] = float(h)
+        pool.put(h, h * 2, None, buf)
+    # capacity 2: hash 10 LRU-evicted, its buffer recycled (no growth)
+    assert len(pool) == 2
+    assert 10 not in pool and 20 in pool and 30 in pool
+    assert pool._buffers.total <= 2
+    removed = [e for e in events if e["type"] == "removed"]
+    assert removed and removed[0]["block_hashes"] == [10]
+    assert all(e.get("tier") == "host" for e in events)
+    # match_prefix walks the leading run only
+    assert pool.match_prefix([20, 30, 99]) == [20, 30]
+    assert pool.match_prefix([99, 20]) == []
+    assert np.all(pool.get(20) == 20.0)
+
+
+async def test_host_tier_restores_evicted_prefix():
+    """After the HBM cache is fully evicted by other traffic, a repeat of
+    the original prompt must (a) hit the host tier, (b) skip the restored
+    pages' prefill compute, and (c) produce identical greedy tokens."""
+    engine = make_engine(
+        num_pages=12,            # tiny HBM pool: 11 usable pages
+        host_kv_pages=32,
+        offload_batch_pages=8,
+        max_batch_size=2,
+        prefill_chunk=16,
+    )
+    prompt = list(range(2, 2 + 24))  # 3 full pages at page_size=8
+    tokens_first, _, frames_first = await collect(
+        engine, greedy_request(prompt, max_tokens=4)
+    )
+    meta_first = frames_first[0].get("meta") or {}
+    assert meta_first.get("prefix_cached_tokens") == 0
+
+    # wait for the write-through offload of the finished request's pages
+    for _ in range(100):
+        if len(engine.host_pool) >= 3:
+            break
+        engine._maybe_start_offload()
+        await asyncio.sleep(0.05)
+    assert len(engine.host_pool) >= 3
+
+    # unrelated traffic evicts the HBM prefix cache completely
+    for i in range(4):
+        filler = list(range(100 + 24 * i, 100 + 24 * (i + 1)))
+        await collect(engine, greedy_request(filler, max_tokens=2))
+    engine.allocator.clear_cache()
+    prompt_hashes = TokenBlockSequence(prompt, 8).sequence_hashes()
+    assert engine.allocator.match_prefix(prompt_hashes) == []
+
+    # repeat: host tier must restore the prefix (2 pages: the rule keeps
+    # >=1 token computed, so the 3rd page recomputes at most)
+    tokens_again, _, frames_again = await collect(
+        engine, greedy_request(prompt, max_tokens=4)
+    )
+    meta = frames_again[0].get("meta") or {}
+    assert meta.get("prefix_cached_tokens", 0) >= 16, meta
+    assert engine.host_pool.hits >= 2
+    assert tokens_again == tokens_first
+    await engine.close()
+
+
+async def test_offload_disabled_by_default():
+    engine = make_engine()
+    assert engine.host_pool is None
+    tokens, _, _ = await collect(engine, greedy_request([5, 6, 7], max_tokens=3))
+    assert len(tokens) == 3
+    await engine.close()
