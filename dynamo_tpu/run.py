@@ -61,6 +61,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-local-prefill-length", type=int, default=128)
     p.add_argument("--max-tokens", type=int, default=256,
                    help="default generation budget for text/stdin/batch inputs")
+    # multi-host bootstrap (reference: launch/dynamo-run/src/lib.rs:232-276
+    # --num-nodes/--node-rank; here jax.distributed instead of Ray/MPI)
+    p.add_argument("--num-nodes", type=int, default=1)
+    p.add_argument("--node-rank", type=int, default=0)
+    p.add_argument("--coordinator",
+                   help="host:port of node 0 (required when --num-nodes > 1)")
     return p
 
 
@@ -307,6 +313,17 @@ def main(argv: Optional[list[str]] = None) -> None:
     configure_logging()
     args = build_parser().parse_args(argv)
     inp, out = parse_io(args.io)
+
+    if args.num_nodes > 1:
+        from dynamo_tpu.parallel.multihost import MultiHostConfig, initialize
+
+        initialize(
+            MultiHostConfig(
+                num_nodes=args.num_nodes,
+                node_rank=args.node_rank,
+                coordinator=args.coordinator,
+            )
+        )
 
     if inp == "http":
         coro = run_http(args, out)

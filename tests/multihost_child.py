@@ -1,0 +1,93 @@
+"""Child process for the multi-host bootstrap test: joins a 2-process
+jax.distributed group (8 virtual CPU devices each -> 16 global), proves a
+cross-host collective works on a global dp-sharded mesh, then serves one
+request from a local JaxEngine (the dp-across-hosts topology: one engine
+worker per host). Run via tests/test_multihost.py, not directly."""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+def main() -> None:
+    coordinator, num_nodes, node_rank = (
+        sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+    )
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from dynamo_tpu.parallel.multihost import MultiHostConfig, initialize
+
+    initialize(
+        MultiHostConfig(
+            num_nodes=num_nodes, node_rank=node_rank, coordinator=coordinator
+        )
+    )
+    assert jax.local_device_count() == 8, jax.local_device_count()
+    assert jax.device_count() == 16, jax.device_count()
+
+    # cross-host collective on a global mesh: dp spans both hosts
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from dynamo_tpu.parallel.mesh import MeshConfig
+    from dynamo_tpu.parallel.multihost import global_mesh
+
+    mesh = global_mesh(MeshConfig(dp=16))
+    x = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P("dp")),
+        np.full((8,), float(node_rank + 1), np.float32),
+        (16,),
+    )
+    total = jax.jit(
+        lambda a: jnp.sum(a), out_shardings=NamedSharding(mesh, P())
+    )(x)
+    # ranks contribute 8*1 + 8*2 = 24
+    got = float(np.asarray(total.addressable_data(0)))
+    assert got == 24.0, got
+    print(f"rank {node_rank}: global psum ok ({got})", flush=True)
+
+    # dp-across-hosts serving: each host runs its own engine on its LOCAL
+    # devices — no cross-host collectives on the serving path
+    import asyncio
+
+    from dynamo_tpu.engine import EngineConfig, JaxEngine
+    from dynamo_tpu.llm.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+    from dynamo_tpu.models.config import get_config
+    from dynamo_tpu.runtime.pipeline.context import Context
+
+    engine = JaxEngine(
+        EngineConfig(
+            model=get_config("tiny"), dtype="float32", page_size=8,
+            num_pages=32, max_batch_size=2, max_model_len=64,
+            prefill_chunk=16, decode_steps=2,
+        ),
+        devices=jax.local_devices()[:1],
+    )
+
+    async def serve_one():
+        pre = PreprocessedRequest(
+            token_ids=[7, 11, 13],
+            stop_conditions=StopConditions(max_tokens=4, ignore_eos=True),
+            sampling_options=SamplingOptions(greedy=True),
+        )
+        toks = []
+        async for frame in await engine.generate(Context(pre.to_dict())):
+            toks.extend(frame.get("token_ids") or [])
+        await engine.close()
+        return toks
+
+    toks = asyncio.run(serve_one())
+    assert len(toks) == 4, toks
+    print(f"rank {node_rank}: engine served {toks}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
