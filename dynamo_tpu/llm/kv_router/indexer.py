@@ -118,7 +118,7 @@ class KvIndexer:
     `kv_events` subject and applies events as they arrive; instance-down
     notifications purge workers."""
 
-    def __init__(self, component, block_size: int):
+    def __init__(self, component, block_size: int, recorder=None):
         import asyncio
 
         self.component = component
@@ -126,6 +126,9 @@ class KvIndexer:
         self.tree = RadixTree()
         self._task: Optional["asyncio.Task"] = None
         self._sub = None
+        # optional llm.recorder.KvRecorder capturing every applied event
+        # for offline replay (reference: kv_router/recorder.rs)
+        self.recorder = recorder
 
     async def start(self) -> None:
         import asyncio
@@ -140,9 +143,10 @@ class KvIndexer:
 
         async for ev in self._sub:
             try:
-                self.tree.apply_event(
-                    RouterEvent.from_dict(msgpack.unpackb(ev["data"], raw=False))
-                )
+                d = msgpack.unpackb(ev["data"], raw=False)
+                self.tree.apply_event(RouterEvent.from_dict(d))
+                if self.recorder is not None:
+                    self.recorder.record_router_event(d["worker_id"], d["event"])
             except Exception:  # noqa: BLE001 — a bad event must not kill routing
                 import logging
 

@@ -1,0 +1,198 @@
+"""Event recorder + replay: JSONL capture of any event stream.
+
+Equivalent of the reference's `Recorder<T>` (reference:
+lib/llm/src/recorder.rs:38-291: mpsc-fed JSONL writer with file rotation,
+max-count/max-time shutdown, counters) and its KV specialization
+`KvRecorder` (reference: lib/llm/src/kv_router/recorder.rs) whose replay
+side (`send_events`, recorder.rs:281-350) feeds recorded RouterEvents back
+into an indexer — the tooling for debugging routing decisions offline and
+replaying production traffic against a new scheduler.
+
+Python adaptation: an asyncio.Queue feeds a writer task; `record()` is the
+producer surface (sync, non-blocking, drops when the queue is full rather
+than stalling the event source). Events are dicts (already the wire shape
+everywhere in this codebase).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+import time
+from typing import AsyncIterator, Callable, Optional
+
+log = logging.getLogger("dynamo_tpu.recorder")
+
+
+class Recorder:
+    def __init__(
+        self,
+        output_path: str,
+        max_lines_per_file: Optional[int] = None,
+        max_count: Optional[int] = None,
+        max_time_s: Optional[float] = None,
+        queue_size: int = 2048,
+    ):
+        self.output_path = output_path
+        self.max_lines_per_file = max_lines_per_file
+        self.max_count = max_count
+        self.max_time_s = max_time_s
+        self.event_count = 0
+        self.dropped = 0
+        self._queue: asyncio.Queue = asyncio.Queue(maxsize=queue_size)
+        self._task: Optional[asyncio.Task] = None
+        self._file = None
+        self._file_index = 0
+        self._lines_in_file = 0
+        self._first_event_t: Optional[float] = None
+        self.closed = asyncio.Event()
+
+    # ---- producer side ------------------------------------------------
+
+    def record(self, event: dict) -> bool:
+        """Enqueue one event; returns False if dropped (queue full or
+        recorder finished). Never blocks the event source."""
+        if self.closed.is_set():
+            return False
+        try:
+            self._queue.put_nowait(event)
+            return True
+        except asyncio.QueueFull:
+            self.dropped += 1
+            return False
+
+    # ---- writer -------------------------------------------------------
+
+    def _path_for_index(self, idx: int) -> str:
+        if idx == 0:
+            return self.output_path
+        root, ext = os.path.splitext(self.output_path)
+        return f"{root}.{idx}{ext}"
+
+    def _open_next(self) -> None:
+        if self._file is not None:
+            self._file.close()
+        path = self._path_for_index(self._file_index)
+        parent = os.path.dirname(path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        self._file = open(path, "w")
+        self._file_index += 1
+        self._lines_in_file = 0
+
+    async def start(self) -> None:
+        self._open_next()
+        self._task = asyncio.create_task(self._run())
+
+    async def _run(self) -> None:
+        try:
+            while True:
+                if self.max_time_s is not None and self._first_event_t is not None:
+                    remaining = self.max_time_s - (time.monotonic() - self._first_event_t)
+                    if remaining <= 0:
+                        break
+                    try:
+                        event = await asyncio.wait_for(self._queue.get(), remaining)
+                    except asyncio.TimeoutError:
+                        break
+                else:
+                    event = await self._queue.get()
+                if event is None:  # close sentinel
+                    break
+                if self._first_event_t is None:
+                    self._first_event_t = time.monotonic()
+                if (
+                    self.max_lines_per_file is not None
+                    and self._lines_in_file >= self.max_lines_per_file
+                ):
+                    self._open_next()
+                self._file.write(json.dumps(event, separators=(",", ":")) + "\n")
+                self._lines_in_file += 1
+                self.event_count += 1
+                if self.max_count is not None and self.event_count >= self.max_count:
+                    break
+        finally:
+            if self._file is not None:
+                self._file.flush()
+                self._file.close()
+                self._file = None
+            self.closed.set()
+
+    async def close(self) -> None:
+        if self._task is None:
+            return
+        try:
+            self._queue.put_nowait(None)
+        except asyncio.QueueFull:
+            self._task.cancel()
+        try:
+            await self._task
+        except asyncio.CancelledError:
+            pass
+
+    def files(self) -> list[str]:
+        return [self._path_for_index(i) for i in range(self._file_index)]
+
+
+async def read_events(path: str) -> AsyncIterator[dict]:
+    """Stream events back from a JSONL file (reference: recorder.rs:281
+    read side)."""
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            yield json.loads(line)
+            await asyncio.sleep(0)
+
+
+async def send_events(
+    path: str,
+    sink: Callable[[dict], None],
+    timed: bool = False,
+    time_field: str = "ts",
+    max_count: Optional[int] = None,
+) -> int:
+    """Replay recorded events into a sink (e.g. KvIndexer.apply / a
+    RadixTree feed) — reference: recorder.rs send_events. With
+    `timed=True`, inter-event gaps from `time_field` are reproduced."""
+    count = 0
+    prev_t: Optional[float] = None
+    async for event in read_events(path):
+        if timed and time_field in event:
+            t = float(event[time_field])
+            if prev_t is not None and t > prev_t:
+                await asyncio.sleep(t - prev_t)
+            prev_t = t
+        sink(event)
+        count += 1
+        if max_count is not None and count >= max_count:
+            break
+    return count
+
+
+class KvRecorder(Recorder):
+    """RouterEvent specialization (reference: kv_router/recorder.rs):
+    attach() subscribes to a KvIndexer-style event feed and records every
+    RouterEvent dict with a timestamp."""
+
+    def record_router_event(self, worker_id: int, event: dict) -> bool:
+        return self.record(
+            {"ts": time.time(), "worker_id": worker_id, "event": event}
+        )
+
+    @staticmethod
+    async def replay_into(path: str, tree, timed: bool = False) -> int:
+        """Feed recorded events into a RadixTree/KvIndexer."""
+        from dynamo_tpu.llm.kv_router.protocols import RouterEvent
+
+        def sink(d: dict) -> None:
+            tree.apply_event(
+                RouterEvent.from_dict(
+                    {"worker_id": d["worker_id"], "event": d["event"]}
+                )
+            )
+
+        return await send_events(path, sink, timed=timed)
