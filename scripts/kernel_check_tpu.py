@@ -1,0 +1,193 @@
+"""Compiled-mode validation + microbenchmark of the fused paged-decode
+kernel on the real TPU chip (interpret-mode CPU tests cannot validate DMA/
+semaphore semantics or VMEM sizing — this runs the Mosaic-compiled kernel).
+
+Writes KERNEL_TPU.json at the repo root:
+  { "backend", "agree_max_err", "configs": [ {B, pages, GB/s, ms}, ... ] }
+
+Timing methodology: through the axon tunnel, standalone dispatch timing
+carries a fixed ~11 ms artifact and block_until_ready does not block —
+so each config is timed as N chained kernel calls (each consuming the
+previous pool) ended by a value fetch, the same in-scan methodology the
+decode profiles use.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dynamo_tpu.ops.pallas_attention import fused_paged_decode_attention
+
+
+def oracle(q, k_cache, v_cache, tables, lengths, page_size):
+    b, h, hd = q.shape
+    kw = k_cache.shape[1]
+    kh = kw // hd
+    g = h // kh
+    smat = (tables[:, :, None] * page_size + np.arange(page_size)).reshape(b, -1)
+    out = np.zeros((b, h, hd), np.float32)
+    qf = np.asarray(q, np.float32)
+    for i in range(b):
+        n = int(lengths[i])
+        if n == 0:
+            continue
+        slots = smat[i, :n]
+        k = np.asarray(k_cache, np.float32)[slots].reshape(n, kh, hd)
+        v = np.asarray(v_cache, np.float32)[slots].reshape(n, kh, hd)
+        for head in range(h):
+            kh_i = head // g
+            s = (qf[i, head] @ k[:, kh_i].T) / np.sqrt(hd)
+            p = np.exp(s - s.max())
+            p /= p.sum()
+            out[i, head] = p @ v[:, kh_i]
+    return out
+
+
+def main() -> None:
+    backend = jax.default_backend()
+    record: dict = {"backend": backend, "configs": []}
+    if backend != "tpu":
+        print(json.dumps({"error": f"no TPU (backend={backend})"}))
+        return
+
+    rng = np.random.RandomState(0)
+    page, hd, kh, h = 64, 64, 8, 32
+    kw = kh * hd
+
+    # ---- correctness: compiled kernel vs numpy oracle ----------------
+    b, w = 8, 8
+    num_pages = 128
+    k_cache = rng.randn(num_pages * page, kw).astype(np.float32)
+    v_cache = rng.randn(num_pages * page, kw).astype(np.float32)
+    q = rng.randn(b, h, hd).astype(np.float32)
+    tables = rng.permutation(num_pages - 1)[: b * w].reshape(b, w) + 1
+    lengths = rng.randint(1, w * page, size=b).astype(np.int32)
+    ref = oracle(q, k_cache, v_cache, tables, lengths, page)
+    out, _, _ = jax.jit(
+        lambda *a: fused_paged_decode_attention(
+            *a, jnp.full((b,), -1, jnp.int32), page_size=page, alias_caches=False
+        )
+    )(
+        jnp.asarray(q), jnp.zeros((b, kw), jnp.float32),
+        jnp.zeros((b, kw), jnp.float32),
+        jnp.asarray(k_cache), jnp.asarray(v_cache),
+        jnp.asarray(tables, jnp.int32), jnp.asarray(lengths),
+    )
+    err = float(np.abs(np.asarray(out) - ref).max())
+    record["agree_max_err"] = err
+    assert err < 2e-2, f"compiled kernel disagrees with oracle: {err}"
+    print(f"compiled-mode agreement: max err {err:.2e}")
+
+    # ---- bandwidth: engine-shaped 16-layer decode scan, attention cost
+    # isolated by ablation (fused-full minus attention-knocked-out) —
+    # the only methodology that is stable through the tunnel (standalone
+    # single-kernel timing carries a fixed ~11 ms dispatch artifact)
+    import dynamo_tpu.ops.attention as A
+    from dynamo_tpu.models import llama
+    from dynamo_tpu.models.config import get_config
+    from dynamo_tpu.ops.sampling import sample_tokens
+
+    cfg = get_config("llama-3.2-1b")
+    dtype = jnp.bfloat16
+    steps_n = 16
+    kv_len = 480
+
+    def time_scan(b, with_attn):
+        w_pages = -(-(kv_len + steps_n + page) // page)
+        num_slots = (b * w_pages + 17) * page
+        tables = jnp.asarray(
+            np.stack([np.arange(1 + i * w_pages, 1 + (i + 1) * w_pages)
+                      for i in range(b)]), jnp.int32)
+        temp = jnp.zeros((b,), jnp.float32)
+        topk = jnp.zeros((b,), jnp.int32)
+        topp = jnp.ones((b,), jnp.float32)
+
+        def multi(params, kv, tokens, positions, key):
+            def body(carry, _):
+                tokens, positions, kv, key = carry
+                key, sub = jax.random.split(key)
+                wslots = (
+                    jnp.take_along_axis(
+                        tables, (positions // page)[:, None], axis=1
+                    )[:, 0] * page + positions % page
+                ).astype(jnp.int32)
+                spec = llama.AttnSpec.pallas_decode(
+                    tables, positions + 1, page, write_pos=positions
+                )
+                hidden, kv = llama.forward(
+                    params, cfg, tokens[:, None], positions[:, None],
+                    kv, wslots, spec,
+                )
+                lg = llama.logits(params, cfg, hidden[:, 0])
+                toks = sample_tokens(lg, sub, temp, topk, topp, all_greedy=True)
+                return (toks, positions + 1, kv, key), toks
+
+            (_, _, kv, _), out = jax.lax.scan(
+                body, (tokens, positions, kv, key), None, length=steps_n)
+            return out, kv
+
+        params = llama.init_params(cfg, jax.random.PRNGKey(0), dtype=dtype)
+        kv = jax.device_put(llama.init_kv_cache(cfg, num_slots, dtype=dtype))
+        tokens = jnp.ones((b,), jnp.int32)
+        positions = jnp.full((b,), kv_len, jnp.int32)
+        key = jax.random.PRNGKey(0)
+        real = (A.write_kv_slots, llama.write_kv_slots,
+                llama.fused_paged_decode_attention
+                if hasattr(llama, "fused_paged_decode_attention") else None)
+        try:
+            if not with_attn:
+                import dynamo_tpu.ops.pallas_attention as PA
+
+                real_fused = PA.fused_paged_decode_attention
+                PA_fake = lambda q, nk, nv, kc, vc, *a, **kw: (q, kc, vc)
+                PA.fused_paged_decode_attention = PA_fake
+            f = jax.jit(multi, donate_argnums=(1,))
+            out, kv = f(params, kv, tokens, positions, key)
+            _ = np.asarray(out[-1, :1])
+            t0 = time.perf_counter()
+            n = 6
+            for _ in range(n):
+                out, kv = f(params, kv, tokens, positions, key)
+            _ = np.asarray(out[-1, :1])
+            return (time.perf_counter() - t0) / n / steps_n
+        finally:
+            if not with_attn:
+                PA.fused_paged_decode_attention = real_fused
+            del params, kv
+
+    for b in (64, 128, 256):
+        full = time_scan(b, with_attn=True)
+        no_attn = time_scan(b, with_attn=False)
+        attn_ms = (full - no_attn) * 1e3
+        kv_bytes = b * kv_len * kw * 2 * 2 * cfg.num_layers  # K+V bf16, 16 L
+        gbps = kv_bytes / max(full - no_attn, 1e-9) / 1e9
+        record["configs"].append(
+            {
+                "B": b, "kv_len": kv_len, "page": page,
+                "full_ms_per_step": round(full * 1e3, 3),
+                "attn_ms_per_step": round(attn_ms, 3),
+                "attn_GBps": round(gbps, 1),
+                "decode_toks_per_s": round(b / full, 0),
+            }
+        )
+        print(f"B={b}: full {full * 1e3:.2f} ms/step, attention "
+              f"{attn_ms:.2f} ms -> {gbps:.0f} GB/s, {b / full:.0f} tok/s")
+
+    out_path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "KERNEL_TPU.json")
+    with open(out_path, "w") as f:
+        json.dump(record, f, indent=1)
+    print(f"wrote {out_path}")
+
+
+if __name__ == "__main__":
+    main()
