@@ -137,6 +137,15 @@ class JaxEngine:
             )
         # mesh for shard_map'ing the kernel; None on a single device
         self._attn_mesh = self.mesh if mc.num_devices > 1 else None
+        if self._attn_pallas and config.prefill_chunk % config.page_size:
+            # the pallas prefill page-scatter writes WHOLE pages; a
+            # non-page-multiple chunk would end mid-page and the next
+            # chunk's write would clobber it from offset 0
+            raise ValueError(
+                f"prefill_chunk ({config.prefill_chunk}) must be a "
+                f"multiple of page_size ({config.page_size}) on the "
+                "pallas attention backend"
+            )
 
         if params is None:
             if config.checkpoint_dir:
@@ -209,7 +218,7 @@ class JaxEngine:
         # per all_greedy variant — static so the pure-greedy batch skips
         # the sampling shortlist entirely)
         self._step_fn = jax.jit(
-            self._model_step, donate_argnums=(1,), static_argnums=(11,)
+            self._model_step, donate_argnums=(1,), static_argnums=(13,)
         )
         # multi-step decode: `decode_steps` iterations per dispatch
         self._decode_fn = jax.jit(
@@ -288,9 +297,22 @@ class JaxEngine:
     # compiled steps
 
     def _model_step(self, params, kv, tokens, positions, write_slots, slot_matrix,
-                    last_idx, temp, topk, topp, key, all_greedy=False):
+                    last_idx, temp, topk, topp, key, wtables=None,
+                    btables=None, all_greedy=False):
+        if wtables is not None:
+            # pallas prefill: page-scatter write + flash attention over
+            # the streamed pages (the XLA row scatter serializes; the
+            # gather oracle materializes [B,K,G,T,C] f32 logits/probs)
+            attn = llama.AttnSpec.gather(
+                slot_matrix, write_tables=wtables, page_size=self.page_size,
+                interpret=self._attn_interpret, mesh=self._attn_mesh,
+                block_tables=btables, q_pos0=positions[:, 0],
+                lengths=last_idx + 1,
+            )
+        else:
+            attn = llama.AttnSpec.gather(slot_matrix)
         hidden, kv = llama.forward(
-            params, self.model_cfg, tokens, positions, kv, write_slots, slot_matrix
+            params, self.model_cfg, tokens, positions, kv, write_slots, attn
         )
         last_h = jnp.take_along_axis(
             hidden, last_idx[:, None, None].astype(jnp.int32), axis=1
@@ -764,6 +786,20 @@ class JaxEngine:
         topk = np.zeros(n, np.int32)
         topp = np.ones(n, np.float32)
         ps = self.page_size
+        ppc = -(-bucket // ps)  # page blocks per chunk (pallas write path)
+        wtables = np.zeros((n, ppc), np.int32)
+        # attention table width: pages actually attended this chunk,
+        # bucketed to a power of two so compile families stay bounded —
+        # full width would DMA every (mostly trash) page per query tile
+        w_need = max(
+            -(-(seq.num_computed + min(seq.total_tokens - seq.num_computed,
+                                       bucket)) // ps)
+            for seq in seqs
+        )
+        w_b = min(
+            1 << (w_need - 1).bit_length(), self.config.max_pages_per_seq
+        )
+        btables = np.zeros((n, w_b), np.int32)
         for j, seq in enumerate(seqs):
             tokens = seq.tokens
             start = seq.num_computed
@@ -774,6 +810,13 @@ class JaxEngine:
             pos_arr[j, :chunk] = idx
             pages = np.asarray(seq.page_ids, np.int32)
             wslots[j, :chunk] = pages[idx // ps] * ps + idx % ps
+            # chunk starts are page-aligned (prefill_chunk % ps == 0,
+            # cache hits/preemption resume at page boundaries), so chunk
+            # page p covers positions start + [p*ps, (p+1)*ps)
+            n_pages_used = -(-chunk // ps)
+            wtables[j, :n_pages_used] = pages[start // ps : start // ps + n_pages_used]
+            npg = min(len(pages), w_b)
+            btables[j, :npg] = pages[:npg]
             last_idx[j] = chunk - 1
             temp[j] = seq.temperature
             topk[j] = seq.top_k
@@ -787,6 +830,8 @@ class JaxEngine:
                 jnp.asarray(smat), jnp.asarray(last_idx),
                 jnp.asarray(temp), jnp.asarray(topk), jnp.asarray(topp),
                 sub,
+                jnp.asarray(wtables.reshape(-1)) if self._attn_pallas else None,
+                jnp.asarray(btables) if self._attn_pallas else None,
                 bool((temp <= 0.0).all()),
             )
         for j, seq in enumerate(seqs):

@@ -58,7 +58,7 @@ class AttnSpec:
 
     def __init__(self, slot_matrix=None, block_tables=None, lengths=None,
                  write_pos=None, page_size: int = 16, interpret: bool = False,
-                 mesh=None):
+                 mesh=None, write_tables=None, q_pos0=None):
         self.slot_matrix = slot_matrix
         self.block_tables = block_tables
         self.lengths = lengths
@@ -66,10 +66,20 @@ class AttnSpec:
         self.page_size = page_size
         self.interpret = interpret
         self.mesh = mesh
+        # [n_pages] page ids: prefill writes whole pages via the pallas
+        # page-scatter kernel instead of the serialized XLA row scatter
+        self.write_tables = write_tables
+        # [B] chunk start positions (page-aligned): with block_tables +
+        # lengths (=valid chunk rows) selects the pallas flash prefill
+        self.q_pos0 = q_pos0
 
     @classmethod
-    def gather(cls, slot_matrix):
-        return cls(slot_matrix=slot_matrix)
+    def gather(cls, slot_matrix, write_tables=None, page_size: int = 16,
+               interpret: bool = False, mesh=None, block_tables=None,
+               q_pos0=None, lengths=None):
+        return cls(slot_matrix=slot_matrix, write_tables=write_tables,
+                   page_size=page_size, interpret=interpret, mesh=mesh,
+                   block_tables=block_tables, q_pos0=q_pos0, lengths=lengths)
 
     @classmethod
     def pallas_decode(cls, block_tables, lengths, page_size, write_pos=None,
@@ -87,12 +97,14 @@ class AttnSpec:
 jax.tree_util.register_pytree_node(
     AttnSpec,
     lambda s: (
-        (s.slot_matrix, s.block_tables, s.lengths, s.write_pos),
+        (s.slot_matrix, s.block_tables, s.lengths, s.write_pos,
+         s.write_tables, s.q_pos0),
         (s.page_size, s.interpret, s.mesh),
     ),
     lambda aux, children: AttnSpec(
         slot_matrix=children[0], block_tables=children[1], lengths=children[2],
-        write_pos=children[3], page_size=aux[0], interpret=aux[1], mesh=aux[2],
+        write_pos=children[3], write_tables=children[4], q_pos0=children[5],
+        page_size=aux[0], interpret=aux[1], mesh=aux[2],
     ),
 )
 
@@ -201,6 +213,65 @@ def _attn_block(
             attn.write_pos,
         )
         out = out[:, None]
+    elif attn.write_tables is not None:
+        # prefill page-scatter: whole [page, K*Hd] blocks via the pallas
+        # kernel (XLA's row scatter serializes, ~15x slower). Rows pad up
+        # to whole pages; tail garbage lands in the sequence's own
+        # not-yet-valid positions (masked) or the trash page.
+        from dynamo_tpu.ops.pallas_kv_write import paged_kv_write
+
+        ps = attn.page_size
+        t_pad = -(-t // ps) * ps
+        k2 = k.reshape(b, t, kh * hd)
+        v2 = v.reshape(b, t, kh * hd)
+        if t_pad != t:
+            k2 = jnp.pad(k2, ((0, 0), (0, t_pad - t), (0, 0)))
+            v2 = jnp.pad(v2, ((0, 0), (0, t_pad - t), (0, 0)))
+        k_pages = k2.reshape(b * (t_pad // ps), ps, kh * hd)
+        v_pages = v2.reshape(b * (t_pad // ps), ps, kh * hd)
+        wr = functools.partial(
+            paged_kv_write, page_size=ps, interpret=attn.interpret
+        )
+        if attn.mesh is not None:
+            P = jax.sharding.PartitionSpec
+            wr = jax.shard_map(
+                wr,
+                mesh=attn.mesh,
+                in_specs=(
+                    P(None, "tp"), P(None, "tp"), P(),
+                    P(None, None, "tp"), P(None, None, "tp"),
+                ),
+                out_specs=(P(None, "tp"), P(None, "tp")),
+                check_vma=False,
+            )
+        kv_k, kv_v = wr(kv_k, kv_v, attn.write_tables, k_pages, v_pages)
+        if attn.block_tables is not None and attn.q_pos0 is not None:
+            # flash prefill: online softmax over streamed pages — never
+            # materializes the [B, K, G, T, C] logits/probs the gather
+            # oracle pays ~13 GB/layer of HBM traffic for
+            from dynamo_tpu.ops.pallas_prefill import flash_prefill_attention
+
+            fl = functools.partial(
+                flash_prefill_attention,
+                page_size=ps, interpret=attn.interpret,
+            )
+            if attn.mesh is not None:
+                P = jax.sharding.PartitionSpec
+                fl = jax.shard_map(
+                    fl,
+                    mesh=attn.mesh,
+                    in_specs=(
+                        P(None, None, "tp", None), P(None, "tp"),
+                        P(None, "tp"), P(), P(), P(),
+                    ),
+                    out_specs=P(None, None, "tp", None),
+                    check_vma=False,
+                )
+            out = fl(
+                q, kv_k, kv_v, attn.block_tables, attn.q_pos0, attn.lengths
+            )
+        else:
+            out = paged_attention(q, kv_k, kv_v, attn.slot_matrix, positions)
     else:
         kv_k, kv_v = write_kv_slots(
             kv_k, kv_v, write_slots,

@@ -1,0 +1,89 @@
+"""Pallas page-scatter KV write: the prefill-side cache update.
+
+XLA lowers `pool.at[slots].set(rows)` to a scatter the TPU backend
+serializes per row (~0.45 us each) — at a [64, 512] prefill chunk batch
+that is 32k rows x 16 layers ~= 390 ms, the single largest prefill cost.
+This kernel writes whole pages instead: the grid walks the chunk's page
+blocks and an output BlockSpec index_map routed by a scalar-prefetched
+page table lands each [page_size, K*Hd] block in place (input/output
+aliased pools, no copy). Measured 15.7x over the XLA scatter
+(scripts/proto_page_write.py; 1.57 ms vs 24.5 ms per layer).
+
+The TPU-native counterpart of the reference's block-copy kernel
+(reference: lib/llm/src/kernels/block_copy.cu:41-731 — cache-line-chunked
+page copies for the same reason: per-element scatter is the enemy).
+
+Correct-use contract (the engine's chunking guarantees both):
+- chunk starts are page-aligned (prefill_chunk % page_size == 0; prefix
+  cache hits and preemption resumes are page-aligned by construction);
+- rows past the chunk tail inside a page may be garbage — they belong to
+  the same sequence's not-yet-computed positions (masked out of
+  attention) or to the trash page.
+
+Sharding: pools/rows are tp-sharded on the folded K*Hd dim; the caller
+wraps in shard_map next to the decode kernel (llama._attn_block).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _kernel(tbl_ref, kp_ref, vp_ref, src_k_ref, src_v_ref, ok_ref, ov_ref):
+    del kp_ref, vp_ref  # aliased through; only the indexed blocks change
+    ok_ref[...] = src_k_ref[...]
+    ov_ref[...] = src_v_ref[...]
+
+
+@functools.partial(
+    jax.jit, static_argnames=("page_size", "interpret"), donate_argnums=(0, 1)
+)
+def paged_kv_write(
+    k_cache: jax.Array,   # [num_slots, K*Hd]
+    v_cache: jax.Array,
+    page_table: jax.Array,  # [n_pages] i32 destination page ids (0 = trash)
+    new_k: jax.Array,     # [n_pages, page_size, K*Hd] source page blocks
+    new_v: jax.Array,
+    *,
+    page_size: int,
+    interpret: bool = False,
+):
+    """Scatter whole pages into the slot pools, in place (donated)."""
+    num_slots, kw = k_cache.shape
+    num_pages = num_slots // page_size
+    n = page_table.shape[0]
+    kp = k_cache.reshape(num_pages, page_size, kw)
+    vp = v_cache.reshape(num_pages, page_size, kw)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec((1, page_size, kw), lambda i, tbl: (i, 0, 0)),
+            pl.BlockSpec((1, page_size, kw), lambda i, tbl: (i, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, page_size, kw), lambda i, tbl: (tbl[i], 0, 0)),
+            pl.BlockSpec((1, page_size, kw), lambda i, tbl: (tbl[i], 0, 0)),
+        ],
+    )
+    ok, ov = pl.pallas_call(
+        _kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct(kp.shape, kp.dtype),
+            jax.ShapeDtypeStruct(vp.shape, vp.dtype),
+        ],
+        input_output_aliases={1: 0, 2: 1},  # kp -> ok, vp -> ov (in place)
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",),
+        ),
+        interpret=interpret,
+    )(page_table.astype(jnp.int32), kp, vp, new_k, new_v)
+    return ok.reshape(num_slots, kw), ov.reshape(num_slots, kw)

@@ -1,0 +1,122 @@
+"""Prefill ablation at serving shapes: where does the [n, 512] chunked
+prefill step spend its time? Methodology: n chained dispatches (scan over
+independent chunk batches) ended by a value fetch — stable through the
+tunnel. Run: python scripts/profile_prefill.py [n_rows]
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import dynamo_tpu.ops.attention as A
+from dynamo_tpu.models import llama
+from dynamo_tpu.models.config import get_config
+from dynamo_tpu.ops.sampling import sample_tokens
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 64
+T = 512
+CFG = get_config("llama-3.2-1b")
+PAGE = 64
+W = -(-(T + 128) // PAGE)
+C = W * PAGE
+NUM_SLOTS = (N * W + 17) * PAGE
+DTYPE = jnp.bfloat16
+REPS = 4
+SCAN = 4  # chunk batches per dispatch
+
+
+def run(name, *, attn=True, logits=True, write=True):
+    smat_np = np.stack(
+        [np.arange(1 + i * W, 1 + (i + 1) * W) for i in range(N)]
+    )
+    smat = (
+        jnp.asarray(smat_np, jnp.int32)[:, :, None] * PAGE
+        + jnp.arange(PAGE, dtype=jnp.int32)
+    ).reshape(N, -1)
+    wslots = (smat[:, :T]).reshape(-1)
+    temp = jnp.zeros((N,), jnp.float32)
+    topk = jnp.zeros((N,), jnp.int32)
+    topp = jnp.ones((N,), jnp.float32)
+    last = jnp.full((N,), T - 1, jnp.int32)
+
+    pallas_write = os.environ.get("PROF_PALLAS_WRITE") == "1"
+    ppc = T // PAGE
+    wtables = jnp.asarray(smat_np[:, :ppc], jnp.int32).reshape(-1)
+
+    def step(params, kv, tokens, positions, key):
+        def body(carry, _):
+            kv, key = carry
+            key, sub = jax.random.split(key)
+            spec = (
+                llama.AttnSpec.gather(smat, write_tables=wtables, page_size=PAGE)
+                if pallas_write
+                else smat
+            )
+            hidden, kv = llama.forward(
+                params, CFG, tokens, positions, kv, wslots, spec
+            )
+            if logits:
+                lh = jnp.take_along_axis(
+                    hidden, last[:, None, None].astype(jnp.int32), axis=1
+                )[:, 0]
+                lg = llama.logits(params, CFG, lh)
+                toks = sample_tokens(lg, sub, temp, topk, topp, all_greedy=True)
+            else:
+                toks = tokens[:, 0]
+            return (kv, key), toks
+
+        (kv, _), out = jax.lax.scan(body, (kv, key), None, length=SCAN)
+        return out, kv
+
+    params = llama.init_params(CFG, jax.random.PRNGKey(0), dtype=DTYPE)
+    kv = jax.device_put(llama.init_kv_cache(CFG, NUM_SLOTS, dtype=DTYPE))
+    tokens = jnp.asarray(
+        np.random.RandomState(0).randint(1, CFG.vocab_size, (N, T)), jnp.int32
+    )
+    positions = jnp.tile(jnp.arange(T, dtype=jnp.int32)[None], (N, 1))
+    key = jax.random.PRNGKey(0)
+
+    real_attn, real_write = A.paged_attention, A.write_kv_slots
+    la, lw = llama.paged_attention, llama.write_kv_slots
+    try:
+        if not attn:
+            fake = lambda q, kc, vc, sm, pos: q
+            A.paged_attention = fake
+            llama.paged_attention = fake
+        if not write:
+            noww = lambda kc, vc, s, nk, nv: (kc, vc)
+            A.write_kv_slots = noww
+            llama.write_kv_slots = noww
+        f = jax.jit(step, donate_argnums=(1,))
+        out, kv2 = f(params, kv, tokens, positions, key)
+        _ = np.asarray(out[-1, :1])
+        t0 = time.perf_counter()
+        for _ in range(REPS):
+            out, kv2 = f(params, kv2, tokens, positions, key)
+        _ = np.asarray(out[-1, :1])
+        dt = (time.perf_counter() - t0) / REPS / SCAN
+        toks = N * T
+        flops = 2 * 1.24e9 * toks
+        print(
+            f"{name:42s} {dt * 1e3:8.2f} ms/chunk-batch "
+            f"({toks / dt / 1e3:7.1f}k tok/s, {flops / dt / 1e12:5.1f} TF/s)",
+            flush=True,
+        )
+        return dt
+    finally:
+        A.paged_attention, A.write_kv_slots = real_attn, real_write
+        llama.paged_attention, llama.write_kv_slots = la, lw
+
+
+if __name__ == "__main__":
+    print(f"prefill ablation: n={N} T={T} C={C} page={PAGE}")
+    run("full")
+    run("no logits/sampling", logits=False)
+    run("no attention", attn=False, logits=False)
+    run("no attention, no write", attn=False, write=False, logits=False)

@@ -1,0 +1,104 @@
+"""Prototype: page-granular gather/write vs row-granular for prefill.
+Hypothesis: XLA row gather/scatter serializes per row (~0.45us/row), so
+gathering [B, W] whole pages (64x fewer, 64KB each) and writing whole
+pages should cut prefill attention from ~590ms to ~tens of ms.
+Run: python scripts/profile_prefill2.py [n_rows]
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 64
+T = 512
+PAGE = 64
+W = -(-(T + 128) // PAGE)
+C = W * PAGE
+KW = 8 * 64  # K*Hd for llama-1b
+NUM_SLOTS = (N * W + 17) * PAGE
+NUM_PAGES = NUM_SLOTS // PAGE
+DTYPE = jnp.bfloat16
+L = 16  # simulate 16 layers' worth of traffic
+REPS = 4
+
+
+def bench(name, fn, *args):
+    out = fn(*args)
+    _ = np.asarray(jax.tree_util.tree_leaves(out)[0]).ravel()[:1]
+    t0 = time.perf_counter()
+    for _ in range(REPS):
+        out = fn(*args)
+    _ = np.asarray(jax.tree_util.tree_leaves(out)[0]).ravel()[:1]
+    dt = (time.perf_counter() - t0) / REPS
+    print(f"{name:44s} {dt * 1e3 / L:8.3f} ms/layer ({dt * 1e3:7.1f} ms total)",
+          flush=True)
+    return dt
+
+
+def main():
+    rng = np.random.RandomState(0)
+    kc = jnp.asarray(rng.randn(NUM_SLOTS, KW), DTYPE)
+    tables_np = np.stack(
+        [np.arange(1 + i * W, 1 + (i + 1) * W) for i in range(N)]
+    ).astype(np.int32)
+    tables = jnp.asarray(tables_np)
+    smat = (
+        tables[:, :, None] * PAGE + jnp.arange(PAGE, dtype=jnp.int32)
+    ).reshape(N, -1)
+    new_rows = jnp.asarray(rng.randn(N * T, KW), DTYPE)
+    wslots = smat[:, :T].reshape(-1)
+
+    # row gather: [B*C] rows
+    @jax.jit
+    def row_gather(kc):
+        acc = jnp.zeros((), jnp.float32)
+        for _ in range(L):
+            k = kc[smat]                    # [N, C, KW]
+            acc = acc + jnp.sum(k[:, 0, 0].astype(jnp.float32))
+        return acc
+
+    # page gather: [B*W] pages via reshape view
+    @jax.jit
+    def page_gather(kc):
+        acc = jnp.zeros((), jnp.float32)
+        kp = kc.reshape(NUM_PAGES, PAGE, KW)
+        for _ in range(L):
+            k = kp[tables]                  # [N, W, PAGE, KW]
+            acc = acc + jnp.sum(k[:, 0, 0, 0].astype(jnp.float32))
+        return acc
+
+    # row scatter write
+    @jax.jit
+    def row_write(kc, rows):
+        for _ in range(L):
+            kc = kc.at[wslots].set(rows)
+        return kc
+
+    # page scatter write (chunk page-aligned: T covers whole pages)
+    n_full = T // PAGE
+    write_pages = tables[:, :n_full].reshape(-1)  # [N*n_full]
+
+    @jax.jit
+    def page_write(kc, rows):
+        pages = rows.reshape(N, n_full, PAGE, KW).reshape(-1, PAGE, KW)
+        for _ in range(L):
+            kp = kc.reshape(NUM_PAGES, PAGE, KW)
+            kp = kp.at[write_pages].set(pages)
+            kc = kp.reshape(NUM_SLOTS, KW)
+        return kc
+
+    print(f"n={N} T={T} W={W} pages_gathered={N * W} rows_gathered={N * C}")
+    bench("row gather  (16x [N*C] rows)", row_gather, kc)
+    bench("page gather (16x [N*W] pages)", page_gather, kc)
+    bench("row write   (16x [N*T] rows)", row_write, kc, new_rows)
+    bench("page write  (16x [N*T/page] pages)", page_write, kc, new_rows)
+
+
+if __name__ == "__main__":
+    main()

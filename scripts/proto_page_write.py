@@ -1,0 +1,121 @@
+"""Prototype: Pallas page-scatter write kernel vs XLA row scatter.
+Writes [n_pages, PAGE, KW] source blocks into a [NUM_PAGES, PAGE, KW]
+pool view at scalar-prefetched page ids, aliased in-place.
+Run: python scripts/proto_page_write.py
+"""
+
+import functools
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+PAGE = 64
+KW = 512
+N = 64
+T = 512
+W = 10
+NUM_PAGES = N * W + 17
+NUM_SLOTS = NUM_PAGES * PAGE
+L = 16
+REPS = 4
+
+
+def _kernel(tbl_ref, kp_ref, vp_ref, src_k_ref, src_v_ref, ok_ref, ov_ref):
+    del kp_ref, vp_ref  # aliased through; only the indexed blocks change
+    ok_ref[...] = src_k_ref[...]
+    ov_ref[...] = src_v_ref[...]
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def pallas_page_write(k_cache, v_cache, tables, new_k, new_v):
+    """k_cache/v_cache [NUM_SLOTS, KW]; tables [n_pages] page ids;
+    new_k/new_v [n_pages, PAGE, KW]."""
+    n_pages = tables.shape[0]
+    kp = k_cache.reshape(NUM_PAGES, PAGE, KW)
+    vp = v_cache.reshape(NUM_PAGES, PAGE, KW)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n_pages,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec((1, PAGE, KW), lambda i, tbl: (i, 0, 0)),
+            pl.BlockSpec((1, PAGE, KW), lambda i, tbl: (i, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, PAGE, KW), lambda i, tbl: (tbl[i], 0, 0)),
+            pl.BlockSpec((1, PAGE, KW), lambda i, tbl: (tbl[i], 0, 0)),
+        ],
+    )
+    ok, ov = pl.pallas_call(
+        _kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct(kp.shape, kp.dtype),
+            jax.ShapeDtypeStruct(vp.shape, vp.dtype),
+        ],
+        input_output_aliases={1: 0, 2: 1},  # (after scalar) kp->ok, vp->ov
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",),
+        ),
+    )(tables, kp, vp, new_k, new_v)
+    return ok.reshape(NUM_SLOTS, KW), ov.reshape(NUM_SLOTS, KW)
+
+
+def main():
+    rng = np.random.RandomState(0)
+    kc = jnp.asarray(rng.randn(NUM_SLOTS, KW), jnp.bfloat16)
+    vc = jnp.asarray(rng.randn(NUM_SLOTS, KW), jnp.bfloat16)
+    n_full = T // PAGE
+    tables_np = np.concatenate(
+        [np.arange(1 + i * W, 1 + i * W + n_full) for i in range(N)]
+    ).astype(np.int32)
+    tables = jnp.asarray(tables_np)
+    src_k = jnp.asarray(rng.randn(N * n_full, PAGE, KW), jnp.bfloat16)
+    src_v = jnp.asarray(rng.randn(N * n_full, PAGE, KW), jnp.bfloat16)
+
+    # correctness (on copies: the write donates its pool inputs)
+    kc_host = np.asarray(kc)
+    ok, ov = pallas_page_write(
+        jnp.asarray(kc_host), jnp.array(vc), tables, src_k, src_v
+    )
+    ref_pages = kc_host.copy().reshape(NUM_PAGES, PAGE, KW)
+    ref_pages[tables_np] = np.asarray(src_k)
+    got = np.asarray(ok).reshape(NUM_PAGES, PAGE, KW)
+    assert np.array_equal(got, ref_pages), "write mismatch"
+    print("correctness ok")
+    kc2 = jnp.asarray(kc_host)
+    vc2 = jnp.array(vc)
+
+    # speed: L chained writes (kernel)
+    @jax.jit
+    def many_pallas(kc, vc, tables, sk, sv):
+        def body(carry, _):
+            kc, vc = carry
+            kc, vc = pallas_page_write(kc, vc, tables, sk, sv)
+            return (kc, vc), kc[0, 0]
+        (kc, vc), o = jax.lax.scan(body, (kc, vc), None, length=L)
+        return o, kc, vc
+
+    o, kc3, vc3 = many_pallas(kc2, vc2, tables, src_k, src_v)
+    _ = np.asarray(o[-1])
+    t0 = time.perf_counter()
+    for _ in range(REPS):
+        o, kc3, vc3 = many_pallas(kc3, vc3, tables, src_k, src_v)
+    _ = np.asarray(o[-1])
+    dt = (time.perf_counter() - t0) / REPS / L
+    gb = 2 * N * n_full * PAGE * KW * 2 / 1e9
+    print(f"pallas page write: {dt * 1e3:.3f} ms/layer "
+          f"({gb / dt:.0f} GB/s) vs XLA row scatter ~24.5 ms/layer")
+
+
+if __name__ == "__main__":
+    main()
