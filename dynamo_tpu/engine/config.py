@@ -44,6 +44,13 @@ class EngineConfig:
     decode_steps: int = 8         # decode steps per jit dispatch (lax.scan):
     # amortizes host<->device round trips; finished sequences overshoot at
     # most decode_steps-1 positions (discarded host-side)
+    # prefill-priority gate: during a PURE admission wave (prompts still
+    # prefilling, no stream has emitted a token yet), hold the decode
+    # dispatch until this fraction of slots is decode-ready — a
+    # quarter-full decode dispatch costs the same device time as a full
+    # one (fixed [max_batch] shape), so waves would otherwise run decode
+    # at ~2x the needed steps. Never delays running streams. 0 disables.
+    decode_ready_frac: float = 1.0
     seed: int = 0
 
     def model_config(self) -> ModelConfig:

@@ -911,6 +911,16 @@ class JaxEngine:
         ready = [(i, s) for i, s in ready if self.slots[i] is s]
         if not ready:
             return None
+        if (
+            self._prefilling
+            and len(ready) < self.config.decode_ready_frac * len(self.slots)
+            and all(s.carry_pending for _, s in ready)
+        ):
+            # pure admission wave (no stream has emitted yet): wait for a
+            # fuller batch — a sparse dispatch costs the same device time
+            # as a full one. Never holds once any stream is mid-decode,
+            # so a late-arriving prompt cannot stall running streams.
+            return None
 
         b = len(self.slots)
         k_steps = self.config.decode_steps

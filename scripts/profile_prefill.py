@@ -45,19 +45,28 @@ def run(name, *, attn=True, logits=True, write=True):
     topp = jnp.ones((N,), jnp.float32)
     last = jnp.full((N,), T - 1, jnp.int32)
 
-    pallas_write = os.environ.get("PROF_PALLAS_WRITE") == "1"
+    mode = os.environ.get("PROF_MODE", "oracle")  # oracle|write|flash
     ppc = T // PAGE
     wtables = jnp.asarray(smat_np[:, :ppc], jnp.int32).reshape(-1)
+    btables = jnp.asarray(smat_np, jnp.int32)
+    tlen = jnp.full((N,), T, jnp.int32)
+    pos0 = jnp.zeros((N,), jnp.int32)
 
     def step(params, kv, tokens, positions, key):
         def body(carry, _):
             kv, key = carry
             key, sub = jax.random.split(key)
-            spec = (
-                llama.AttnSpec.gather(smat, write_tables=wtables, page_size=PAGE)
-                if pallas_write
-                else smat
-            )
+            if mode == "flash":
+                spec = llama.AttnSpec.gather(
+                    smat, write_tables=wtables, page_size=PAGE,
+                    block_tables=btables, q_pos0=pos0, lengths=tlen,
+                )
+            elif mode == "write":
+                spec = llama.AttnSpec.gather(
+                    smat, write_tables=wtables, page_size=PAGE
+                )
+            else:
+                spec = smat
             hidden, kv = llama.forward(
                 params, CFG, tokens, positions, kv, wslots, spec
             )
