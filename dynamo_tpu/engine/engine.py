@@ -114,8 +114,13 @@ class JaxEngine:
         # under tp via shard_map (AttnSpec.mesh) — other axes fall back
         mc = config.mesh
         tp_only = mc.num_devices == mc.tp
+        # Mosaic needs the folded KV width lane-aligned per tp shard (the
+        # kernels slice [*, K*Hd] refs); tiny test models fall back
+        kw_ok = (
+            self.model_cfg.num_kv_heads * self.model_cfg.head_dim
+        ) % (128 * mc.tp) == 0
         if config.attn_backend == "auto":
-            self._attn_pallas = backend == "tpu" and tp_only
+            self._attn_pallas = backend == "tpu" and tp_only and kw_ok
             self._attn_interpret = False
         elif config.attn_backend == "pallas":
             if not tp_only:

@@ -104,6 +104,13 @@ def main() -> None:
     args = p.parse_args()
     configure_logging()
     _apply_chip_env(args.worker_id)
+    if os.environ.get("JAX_PLATFORMS"):
+        # a sitecustomize hook may pin a tunneled-TPU platform at
+        # interpreter startup; force the requested platform through
+        # jax.config too (same strategy as tests/conftest.py)
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
     asyncio.run(amain(args.entry, args.service_name, args.worker_id))
 
 

@@ -1,0 +1,140 @@
+"""LLM serving components for the example graphs (reference:
+examples/llm/components/{frontend,processor,worker,prefill_worker}.py —
+there BentoML @service classes wrapping vLLM; here SDK @service classes
+wrapping the native JaxEngine stack).
+
+Every component reads its knobs from the graph config YAML
+(`ServiceConfig`, exposed as `self.dynamo_context["config"]`):
+
+Frontend:     port, model-name
+Worker:       model-path, model-name, page-size, max-batch-size,
+              max-model-len, attn-backend, disagg ("agg" | "decode"),
+              max-local-prefill-length
+PrefillWorker: model-path, model-name (+ engine knobs above)
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from dynamo_tpu.sdk import async_on_start, depends, service
+
+NAMESPACE = "dynamo"
+
+
+def _engine_kwargs(cfg: dict) -> dict:
+    kw = {}
+    if cfg.get("page-size"):
+        kw["page_size"] = int(cfg["page-size"])
+    if cfg.get("max-batch-size"):
+        kw["max_batch_size"] = int(cfg["max-batch-size"])
+    if cfg.get("max-model-len"):
+        kw["max_model_len"] = int(cfg["max-model-len"])
+    if cfg.get("attn-backend"):
+        kw["attn_backend"] = cfg["attn-backend"]
+    if cfg.get("tensor-parallel-size"):
+        from dynamo_tpu.parallel.mesh import MeshConfig
+
+        kw["mesh"] = MeshConfig(tp=int(cfg["tensor-parallel-size"]))
+    return kw
+
+
+@service(name="PrefillWorker", namespace=NAMESPACE)
+class PrefillWorker:
+    """Competes on the hub prefill queue; computes prompt KV and ships it
+    to the requesting decode worker (reference:
+    examples/llm/components/prefill_worker.py)."""
+
+    def __init__(self):
+        self.cfg = self.dynamo_context["config"]
+
+    @async_on_start
+    async def start(self):
+        from dynamo_tpu.llm.disagg import PrefillHandler
+        from dynamo_tpu.llm.local_model import LocalModel
+
+        drt = self.dynamo_context["runtime"]
+        lm = LocalModel.prepare(
+            self.cfg["model-path"], name=self.cfg.get("model-name")
+        )
+        engine = lm.build_engine(**_engine_kwargs(self.cfg))
+        PrefillHandler(drt, engine, NAMESPACE, "Worker").start()
+
+
+@service(name="Worker", namespace=NAMESPACE)
+class Worker:
+    """Decode/aggregated worker: native JaxEngine registered on
+    dyn://dynamo.Worker.generate with KV metrics + events published
+    (reference: examples/llm/components/worker.py VllmWorker)."""
+
+    def __init__(self):
+        self.cfg = self.dynamo_context["config"]
+
+    @async_on_start
+    async def start(self):
+        from dynamo_tpu.llm.http.discovery import register_llm
+        from dynamo_tpu.llm.kv_router import KvEventPublisher, KvMetricsPublisher
+        from dynamo_tpu.llm.local_model import LocalModel
+
+        drt = self.dynamo_context["runtime"]
+        lm = LocalModel.prepare(
+            self.cfg["model-path"], name=self.cfg.get("model-name")
+        )
+        engine = lm.build_engine(**_engine_kwargs(self.cfg))
+        lm.card.kv_cache_block_size = engine.page_size
+        serving = engine
+        if self.cfg.get("disagg") == "decode":
+            from dynamo_tpu.llm.disagg import (
+                DisaggConfig,
+                DisaggDecodeWorker,
+                DisaggRouter,
+            )
+
+            serving = DisaggDecodeWorker(
+                drt, engine, NAMESPACE, "Worker",
+                router=DisaggRouter(
+                    drt, model=lm.card.display_name,
+                    config=DisaggConfig(
+                        max_local_prefill_length=int(
+                            self.cfg.get("max-local-prefill-length", 128)
+                        )
+                    ),
+                ),
+            )
+            await serving.attach()
+        metrics = KvMetricsPublisher.for_engine(engine)
+        component = drt.namespace(NAMESPACE).component("Worker")
+        KvEventPublisher(component, drt.worker_id).attach(engine).start()
+        await register_llm(
+            drt, serving, lm.card, f"dyn://{NAMESPACE}.Worker.generate",
+            stats_handler=metrics.stats_handler,
+        )
+
+
+@service(name="Frontend", namespace=NAMESPACE)
+class Frontend:
+    """OpenAI HTTP ingress: watches the hub for registered models and
+    builds preprocess->route->detokenize pipelines per model (reference:
+    examples/llm/components/frontend.py + processor.py — the processor
+    stage is folded into the pipeline here)."""
+
+    worker = depends(Worker)
+
+    def __init__(self):
+        self.cfg = self.dynamo_context["config"]
+
+    @async_on_start
+    async def start(self):
+        from dynamo_tpu.llm.http.discovery import ModelWatcher
+        from dynamo_tpu.llm.http.service import HttpService
+
+        drt = self.dynamo_context["runtime"]
+        self.svc = HttpService()
+        self.watcher = ModelWatcher(
+            drt, self.svc.manager,
+            router_mode=self.cfg.get("router", "round_robin"),
+        )
+        await self.watcher.start()
+        await self.svc.start("0.0.0.0", int(self.cfg.get("port", 8080)))
+        # hold the HTTP server open for the worker process lifetime
+        asyncio.get_running_loop().create_task(asyncio.Event().wait())

@@ -1,0 +1,13 @@
+"""Aggregated serving graph: HTTP frontend -> native engine worker
+(reference: examples/llm/graphs/agg.py:16-18).
+
+    python -m dynamo_tpu.sdk serve examples/llm/graphs/agg.py:Frontend \
+        -f examples/llm/configs/agg.yaml
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from components import Frontend, Worker  # noqa: F401  (graph edge: Frontend -> Worker)

@@ -1,0 +1,21 @@
+"""Disaggregated serving graph: frontend -> decode worker, with prefill
+workers competing on the hub queue (reference:
+examples/llm/graphs/disagg.py:16-21).
+
+    python -m dynamo_tpu.sdk serve examples/llm/graphs/disagg.py:Frontend \
+        -f examples/llm/configs/disagg.yaml
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from components import Frontend, PrefillWorker, Worker  # noqa: F401
+
+from dynamo_tpu.sdk import depends
+
+# the prefill pool talks to the decode worker through the hub queue, not a
+# call edge — the depends() below only pulls PrefillWorker into the served
+# graph (reference disagg.py links it into the chain for the same reason)
+Worker.prefill = depends(PrefillWorker)
