@@ -1,0 +1,132 @@
+"""GGUF support: metadata reader + tokenizer reconstruction.
+
+Equivalent of the reference's GGUF layer (reference:
+lib/llm/src/gguf/gguf_metadata.rs value decoding,
+gguf/gguf_tokenizer.rs:116-250 — `tokenizer.ggml.model` selects unigram
+("llama"/"replit", tokens+scores) or byte-level BPE ("gpt2",
+tokens+merges), with bos/eos/unk ids from metadata): GGUF-packaged
+models carry their tokenizer inside the binary, so a deployment can
+serve them without a tokenizer.json.
+
+Only the metadata section is parsed (header + KV pairs); tensor data is
+skipped — weight loading stays on safetensors in this framework.
+"""
+
+from __future__ import annotations
+
+import logging
+import struct
+from typing import Any, BinaryIO
+
+log = logging.getLogger("dynamo_tpu.gguf")
+
+GGUF_MAGIC = b"GGUF"
+
+# GGUF metadata value types (spec order)
+_U8, _I8, _U16, _I16, _U32, _I32, _F32, _BOOL, _STRING, _ARRAY, _U64, _I64, _F64 = range(13)
+
+_SCALAR_FMT = {
+    _U8: "<B", _I8: "<b", _U16: "<H", _I16: "<h", _U32: "<I", _I32: "<i",
+    _F32: "<f", _U64: "<Q", _I64: "<q", _F64: "<d",
+}
+
+
+def _read_scalar(f: BinaryIO, vtype: int) -> Any:
+    if vtype == _BOOL:
+        return struct.unpack("<B", f.read(1))[0] != 0
+    if vtype == _STRING:
+        (n,) = struct.unpack("<Q", f.read(8))
+        return f.read(n).decode("utf-8", errors="replace")
+    fmt = _SCALAR_FMT[vtype]
+    return struct.unpack(fmt, f.read(struct.calcsize(fmt)))[0]
+
+
+def _read_value(f: BinaryIO, vtype: int) -> Any:
+    if vtype == _ARRAY:
+        (etype,) = struct.unpack("<I", f.read(4))
+        (n,) = struct.unpack("<Q", f.read(8))
+        return [_read_value(f, etype) for _ in range(n)]
+    return _read_scalar(f, vtype)
+
+
+def load_metadata(path: str) -> dict[str, Any]:
+    """Header + metadata KV pairs of a GGUF file (v2/v3)."""
+    with open(path, "rb") as f:
+        if f.read(4) != GGUF_MAGIC:
+            raise ValueError(f"{path}: not a GGUF file")
+        (version,) = struct.unpack("<I", f.read(4))
+        if version < 2:
+            raise ValueError(f"{path}: GGUF v{version} unsupported (need >= 2)")
+        (tensor_count,) = struct.unpack("<Q", f.read(8))
+        (kv_count,) = struct.unpack("<Q", f.read(8))
+        meta: dict[str, Any] = {
+            "gguf.version": version, "gguf.tensor_count": tensor_count,
+        }
+        for _ in range(kv_count):
+            (klen,) = struct.unpack("<Q", f.read(8))
+            key = f.read(klen).decode("utf-8")
+            (vtype,) = struct.unpack("<I", f.read(4))
+            meta[key] = _read_value(f, vtype)
+        return meta
+
+
+def tokenizer_from_gguf(path_or_meta) -> "object":
+    """Build a `tokenizers.Tokenizer` from GGUF metadata (reference:
+    gguf_tokenizer.rs convert_gguf_to_hf_tokenizer)."""
+    from tokenizers import Tokenizer, decoders, models, pre_tokenizers
+
+    meta = (
+        path_or_meta if isinstance(path_or_meta, dict)
+        else load_metadata(path_or_meta)
+    )
+    model = meta.get("tokenizer.ggml.model")
+    tokens = meta.get("tokenizer.ggml.tokens")
+    if not model or tokens is None:
+        raise ValueError("GGUF metadata has no tokenizer (tokenizer.ggml.*)")
+
+    if model in ("llama", "replit"):
+        scores = meta.get("tokenizer.ggml.scores")
+        if scores is None:
+            raise ValueError(
+                "`llama` unigram tokenizer needs tokenizer.ggml.scores"
+            )
+        unk_id = int(meta.get("tokenizer.ggml.unknown_token_id", 0))
+        vocab = [(t, float(s)) for t, s in zip(tokens, scores)]
+        tok = Tokenizer(models.Unigram(vocab, unk_id=unk_id))
+        # sentencepiece-style space marker
+        tok.decoder = decoders.Sequence(
+            [decoders.Replace("▁", " "), decoders.Fuse()]
+        )
+    elif model == "gpt2":
+        merges_raw = meta.get("tokenizer.ggml.merges")
+        if merges_raw is None:
+            raise ValueError("`gpt2` BPE tokenizer needs tokenizer.ggml.merges")
+        vocab = {t: i for i, t in enumerate(tokens)}
+        merges = [tuple(m.split(" ", 1)) for m in merges_raw]
+        tok = Tokenizer(models.BPE(vocab, merges))
+        tok.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False)
+        tok.decoder = decoders.ByteLevel()
+    else:
+        raise ValueError(f"unsupported GGUF tokenizer model {model!r}")
+
+    for key, special in (
+        ("tokenizer.ggml.bos_token_id", True),
+        ("tokenizer.ggml.eos_token_id", True),
+    ):
+        tid = meta.get(key)
+        if tid is not None and 0 <= int(tid) < len(tokens):
+            from tokenizers import AddedToken
+
+            tok.add_special_tokens(
+                [AddedToken(tokens[int(tid)], special=special)]
+            )
+    return tok
+
+
+def special_token_ids(meta: dict[str, Any]) -> dict[str, int]:
+    out = {}
+    for name in ("bos", "eos", "unknown", "padding"):
+        v = meta.get(f"tokenizer.ggml.{name}_token_id")
+        if v is not None:
+            out[name] = int(v)
+    return out

@@ -71,9 +71,14 @@ class HttpService:
         self,
         manager: Optional[ModelManager] = None,
         metrics: Optional[ServiceMetrics] = None,
+        request_template=None,
     ):
         self.manager = manager or ModelManager()
         self.metrics = metrics or ServiceMetrics()
+        # llm.request_template.RequestTemplate: deployment defaults filled
+        # into bodies that omit model/temperature/max tokens (reference:
+        # request_template.rs applied by dynamo-run)
+        self.request_template = request_template
         self.app = web.Application()
         self.app.add_routes(
             [
@@ -139,6 +144,8 @@ class HttpService:
             body = await request.json()
         except (json.JSONDecodeError, UnicodeDecodeError):
             return _error_response(400, "invalid JSON body")
+        if self.request_template is not None:
+            body = self.request_template.apply(body)
         try:
             req = parse(body)
         except RequestError as exc:

@@ -27,14 +27,32 @@ class HuggingFaceTokenizer:
 
     @classmethod
     def from_file(cls, path: str) -> "HuggingFaceTokenizer":
-        """`path` is a tokenizer.json file or a model dir containing one."""
+        """`path` is a tokenizer.json file, a .gguf file (tokenizer
+        rebuilt from metadata, reference gguf_tokenizer.rs), or a model
+        dir containing either."""
         if os.path.isdir(path):
             config = {}
             cfg_path = os.path.join(path, "tokenizer_config.json")
             if os.path.exists(cfg_path):
                 with open(cfg_path) as f:
                     config = json.load(f)
-            return cls(Tokenizer.from_file(os.path.join(path, "tokenizer.json")), config)
+            tok_json = os.path.join(path, "tokenizer.json")
+            if os.path.exists(tok_json):
+                return cls(Tokenizer.from_file(tok_json), config)
+            ggufs = sorted(
+                f for f in os.listdir(path) if f.endswith(".gguf")
+            )
+            if ggufs:
+                from dynamo_tpu.llm.gguf import tokenizer_from_gguf
+
+                return cls(
+                    tokenizer_from_gguf(os.path.join(path, ggufs[0])), config
+                )
+            raise FileNotFoundError(f"{path}: no tokenizer.json or *.gguf")
+        if path.endswith(".gguf"):
+            from dynamo_tpu.llm.gguf import tokenizer_from_gguf
+
+            return cls(tokenizer_from_gguf(path))
         return cls(Tokenizer.from_file(path))
 
     def encode(self, text: str, add_special_tokens: bool = True) -> list[int]:

@@ -1,0 +1,130 @@
+"""llmctl: model registry CRUD against the hub.
+
+The reference's llmctl CLI (reference: launch/llmctl — list/add/remove
+HTTP model entries in etcd so frontends pick them up/drop them without
+touching workers). Same surface here over the hub KV:
+
+    python -m dynamo_tpu.llmctl http list models
+    python -m dynamo_tpu.llmctl http add model <name> dyn://ns.comp.ep \
+        --model-path /local/dir
+    python -m dynamo_tpu.llmctl http remove model <name>
+
+`--hub host:port` (or DYN_HUB_ADDR) selects the deployment.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+from typing import Optional
+
+from dynamo_tpu.llm.http.discovery import ENTRY_ROOT, ModelEntry
+from dynamo_tpu.llm.model_card import ModelDeploymentCard
+from dynamo_tpu.runtime.hub.client import HubClient
+
+
+async def list_models(hub: HubClient) -> list[dict]:
+    rows = []
+    for item in await hub.kv_get_prefix(ENTRY_ROOT):
+        entry = ModelEntry.from_json(item["value"])
+        worker = item["key"].rsplit("/", 1)[-1]
+        rows.append(
+            {
+                "name": entry.name,
+                "service": entry.service_name,
+                "endpoint": entry.endpoint,
+                "type": entry.model_type,
+                "worker": worker,
+            }
+        )
+    return rows
+
+
+async def add_model(
+    hub: HubClient,
+    name: str,
+    endpoint: str,
+    model_path: Optional[str] = None,
+    model_type: str = "backend",
+) -> None:
+    """Manual registration: publish a card (from a local dir when given)
+    plus an entry under a synthetic worker id — frontends treat it like
+    any worker-registered model."""
+    from dynamo_tpu.llm.model_card import slugify
+
+    if model_path:
+        card = ModelDeploymentCard.from_local_path(model_path, name=name)
+    else:
+        card = ModelDeploymentCard(display_name=name, service_name=slugify(name))
+    await card.publish(hub)
+    entry = ModelEntry(
+        name=name,
+        service_name=card.service_name,
+        endpoint=endpoint,
+        model_type=model_type,
+    )
+    await hub.kv_put(f"{ENTRY_ROOT}{card.service_name}/llmctl", entry.to_json())
+
+
+async def remove_model(hub: HubClient, name: str) -> int:
+    removed = 0
+    for item in await hub.kv_get_prefix(ENTRY_ROOT):
+        entry = ModelEntry.from_json(item["value"])
+        if entry.name == name:
+            removed += await hub.kv_del(item["key"])
+    return removed
+
+
+async def amain(args) -> int:
+    hub = await HubClient.connect(args.hub)
+    try:
+        if args.verb == "list":
+            rows = await list_models(hub)
+            if args.json:
+                print(json.dumps(rows, indent=1))
+            else:
+                if not rows:
+                    print("no models registered")
+                for r in rows:
+                    print(
+                        f"{r['name']:32s} {r['type']:10s} {r['endpoint']:40s} "
+                        f"worker={r['worker']}"
+                    )
+        elif args.verb == "add":
+            await add_model(
+                hub, args.name, args.endpoint,
+                model_path=args.model_path, model_type=args.model_type,
+            )
+            print(f"added {args.name} -> {args.endpoint}")
+        elif args.verb == "remove":
+            n = await remove_model(hub, args.name)
+            print(f"removed {n} entr{'y' if n == 1 else 'ies'} for {args.name}")
+        return 0
+    finally:
+        await hub.close()
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    p = argparse.ArgumentParser(prog="python -m dynamo_tpu.llmctl")
+    p.add_argument("plane", choices=["http"], help="registry plane")
+    p.add_argument("verb", choices=["list", "add", "remove"])
+    p.add_argument("kind", nargs="?", default="model",
+                   choices=["model", "models"])
+    p.add_argument("name", nargs="?")
+    p.add_argument("endpoint", nargs="?")
+    p.add_argument("--hub", default=None, help="hub host:port (or DYN_HUB_ADDR)")
+    p.add_argument("--model-path", help="local model dir for the card")
+    p.add_argument("--model-type", default="backend")
+    p.add_argument("--json", action="store_true")
+    args = p.parse_args(argv)
+    if args.verb in ("add",) and not (args.name and args.endpoint):
+        p.error("add needs: add model <name> <dyn://ns.comp.ep>")
+    if args.verb == "remove" and not args.name:
+        p.error("remove needs: remove model <name>")
+    return asyncio.run(amain(args))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

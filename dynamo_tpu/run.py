@@ -56,6 +56,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--decode-steps", type=int, default=8)
     p.add_argument("--dtype", default="bfloat16", choices=["bfloat16", "float32"])
     p.add_argument("--extra-engine-args", help="JSON file of EngineConfig overrides")
+    p.add_argument("--request-template",
+                   help="JSON file of request defaults (model/temperature/"
+                        "max_completion_tokens), ref request_template.rs")
     p.add_argument("--disagg-mode", choices=["agg", "decode", "prefill"],
                    default="agg", help="worker role in a disaggregated graph")
     p.add_argument("--max-local-prefill-length", type=int, default=128)
@@ -144,7 +147,12 @@ async def build_output(args, out: str, drt=None):
 async def run_http(args, out: str) -> None:
     from dynamo_tpu.llm.http.service import HttpService
 
-    svc = HttpService()
+    template = None
+    if args.request_template:
+        from dynamo_tpu.llm.request_template import RequestTemplate
+
+        template = RequestTemplate.load(args.request_template)
+    svc = HttpService(request_template=template)
     if out.startswith("dyn://"):
         # ingress: discover models from the hub
         from dynamo_tpu.llm.http.discovery import ModelWatcher

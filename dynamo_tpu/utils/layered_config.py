@@ -1,0 +1,110 @@
+"""Layered configuration: defaults <- config files <- env vars.
+
+The figment stack the reference builds for every runtime config
+(reference: lib/runtime/src/config.rs:25-110 — defaults, then
+/opt/dynamo/defaults/*.toml, then /opt/dynamo/etc/*.toml, then
+DYN_RUNTIME_*-prefixed env, highest last; empty env vars filtered).
+
+Python adaptation: `load_layered(SomeDataclass, env_prefix, files)`
+merges onto the dataclass's defaults and coerces types from the field
+annotations, so env strings become ints/floats/bools. YAML and JSON
+files are supported (TOML via tomllib when the file says .toml).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+from typing import Any, Optional, Type, TypeVar
+
+log = logging.getLogger("dynamo_tpu.config")
+
+T = TypeVar("T")
+
+DEFAULT_CONFIG_DIRS = ("/opt/dynamo_tpu/defaults", "/opt/dynamo_tpu/etc")
+
+
+def _read_file(path: str) -> dict[str, Any]:
+    with open(path) as f:
+        raw = f.read()
+    if path.endswith((".yaml", ".yml")):
+        import yaml
+
+        return yaml.safe_load(raw) or {}
+    if path.endswith(".toml"):
+        import tomllib
+
+        return tomllib.loads(raw)
+    return json.loads(raw)
+
+
+def _coerce(value: Any, ann: Any) -> Any:
+    """Best-effort cast of file/env values to the annotated field type."""
+    origin = getattr(ann, "__origin__", None)
+    if origin is not None:  # Optional[X] and friends: try each member
+        for arg in getattr(ann, "__args__", ()):
+            if arg is type(None):
+                continue
+            try:
+                return _coerce(value, arg)
+            except (TypeError, ValueError):
+                continue
+        return value
+    if isinstance(ann, type) and isinstance(value, ann):
+        return value
+    if ann is bool:
+        if isinstance(value, str):
+            return value.strip().lower() in ("1", "true", "yes", "on")
+        return bool(value)
+    if ann in (int, float, str):
+        return ann(value)
+    return value
+
+
+def load_layered(
+    cls: Type[T],
+    env_prefix: str,
+    files: Optional[list[str]] = None,
+    section: Optional[str] = None,
+) -> T:
+    """Build `cls` (a dataclass) from, lowest priority first: field
+    defaults, each file in order (missing files skipped; `section` picks
+    a sub-mapping), then `{env_prefix}{FIELD}` env vars (empty filtered,
+    reference config.rs:88-96)."""
+    import typing
+
+    hints = typing.get_type_hints(cls)  # resolves PEP-563 string annotations
+    fields = {f.name: f for f in dataclasses.fields(cls)}
+    merged: dict[str, Any] = {}
+    file_list = list(files) if files is not None else [
+        os.path.join(d, f"{section or cls.__name__.lower()}.yaml")
+        for d in DEFAULT_CONFIG_DIRS
+    ]
+    for path in file_list:
+        if not os.path.exists(path):
+            continue
+        try:
+            data = _read_file(path)
+        except Exception:
+            log.exception("bad config file %s skipped", path)
+            continue
+        if section and isinstance(data.get(section), dict):
+            data = data[section]
+        for k, v in data.items():
+            key = k.replace("-", "_")
+            if key in fields:
+                merged[key] = v
+            else:
+                log.warning("unknown config key %r in %s ignored", k, path)
+    for name in fields:
+        env_key = f"{env_prefix}{name.upper()}"
+        raw = os.environ.get(env_key)
+        if raw:  # empty env vars are filtered, as in the reference
+            merged[name] = raw
+    kwargs = {
+        name: _coerce(value, hints.get(name, str))
+        for name, value in merged.items()
+    }
+    return cls(**kwargs)

@@ -1,0 +1,119 @@
+"""Layered config (reference: lib/runtime/src/config.rs figment stack),
+request template (request_template.rs), and llmctl CRUD (launch/llmctl)."""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass
+
+import aiohttp
+
+from dynamo_tpu.llm.request_template import RequestTemplate
+from dynamo_tpu.utils.layered_config import load_layered
+
+from .helpers import hub_server
+
+
+@dataclass
+class _RtCfg:
+    num_worker_threads: int = 16
+    max_blocking_threads: int = 512
+    name: str = "default"
+    debug: bool = False
+
+
+def test_layered_precedence(tmp_path, monkeypatch):
+    low = tmp_path / "defaults.yaml"
+    low.write_text("num-worker-threads: 4\nname: fromfile\n")
+    high = tmp_path / "etc.json"
+    high.write_text(json.dumps({"num_worker_threads": 8}))
+    monkeypatch.setenv("DYN_RT_DEBUG", "true")
+    monkeypatch.setenv("DYN_RT_MAX_BLOCKING_THREADS", "64")
+    monkeypatch.setenv("DYN_RT_NAME", "")  # empty env filtered (config.rs)
+    cfg = load_layered(_RtCfg, "DYN_RT_", files=[str(low), str(high)])
+    assert cfg.num_worker_threads == 8        # later file wins
+    assert cfg.name == "fromfile"             # empty env did not override
+    assert cfg.max_blocking_threads == 64     # env wins, coerced to int
+    assert cfg.debug is True                  # env bool coercion
+
+
+def test_layered_missing_files_and_defaults():
+    cfg = load_layered(_RtCfg, "NOPE_", files=["/does/not/exist.yaml"])
+    assert cfg == _RtCfg()
+
+
+def test_request_template(tmp_path):
+    path = tmp_path / "tmpl.json"
+    path.write_text(json.dumps(
+        {"model": "llama-3.2-1b", "temperature": 0.7,
+         "max_completion_tokens": 128}
+    ))
+    t = RequestTemplate.load(str(path))
+    body = t.apply({"messages": []})
+    assert body["model"] == "llama-3.2-1b"
+    assert body["temperature"] == 0.7
+    assert body["max_tokens"] == 128
+    # the request's own values win
+    body = t.apply({"model": "other", "temperature": 0.0, "max_tokens": 5})
+    assert body["model"] == "other"
+    assert body["temperature"] == 0.0
+    assert body["max_tokens"] == 5
+
+
+async def test_request_template_in_http_service():
+    from dynamo_tpu.llm.http.service import HttpService
+
+    class _Echo:
+        async def generate(self, ctx):
+            async def s():
+                yield {
+                    "id": "x", "object": "chat.completion", "created": 0,
+                    "model": ctx.payload.model,
+                    "choices": [{
+                        "index": 0,
+                        "message": {"role": "assistant", "content": "ok"},
+                        "finish_reason": "stop",
+                    }],
+                }
+
+            return s()
+
+    svc = HttpService(
+        request_template=RequestTemplate(model="defaulted", temperature=0.5)
+    )
+    svc.manager.add_chat_model("defaulted", _Echo())
+    await svc.start("127.0.0.1", 0)
+    try:
+        async with aiohttp.ClientSession() as s:
+            # body omits "model": the template routes it
+            r = await s.post(
+                f"http://127.0.0.1:{svc.port}/v1/chat/completions",
+                json={"messages": [{"role": "user", "content": "hi"}]},
+            )
+            assert r.status == 200
+            body = await r.json()
+            assert body["model"] == "defaulted"
+    finally:
+        await svc.stop()
+
+
+async def test_llmctl_crud():
+    from dynamo_tpu import llmctl
+    from dynamo_tpu.runtime.hub.client import HubClient
+
+    async with hub_server() as server:
+        hub = await HubClient.connect(f"127.0.0.1:{server.port}")
+        try:
+            assert await llmctl.list_models(hub) == []
+            await llmctl.add_model(
+                hub, "manual-model", "dyn://demo.backend.generate"
+            )
+            rows = await llmctl.list_models(hub)
+            assert len(rows) == 1
+            assert rows[0]["name"] == "manual-model"
+            assert rows[0]["endpoint"] == "dyn://demo.backend.generate"
+            assert await llmctl.remove_model(hub, "manual-model") == 1
+            assert await llmctl.list_models(hub) == []
+        finally:
+            await hub.close()

@@ -1,0 +1,172 @@
+"""GGUF metadata/tokenizer (reference: lib/llm/src/gguf/*) and the SSE
+parse codec (reference: lib/llm/src/protocols/codec.rs)."""
+
+from __future__ import annotations
+
+import struct
+
+import aiohttp
+
+from dynamo_tpu.llm.gguf import load_metadata, special_token_ids, tokenizer_from_gguf
+from dynamo_tpu.llm.protocols.codec import SseMessage, decode_sse_lines, decode_sse_stream
+from dynamo_tpu.llm.tokenizer import HuggingFaceTokenizer
+
+# ---- GGUF ------------------------------------------------------------
+
+_U32, _F32, _STRING, _ARRAY = 4, 6, 8, 9
+
+
+def _s(x: str) -> bytes:
+    b = x.encode()
+    return struct.pack("<Q", len(b)) + b
+
+
+def _kv_str(key, val):
+    return _s(key) + struct.pack("<I", _STRING) + _s(val)
+
+
+def _kv_u32(key, val):
+    return _s(key) + struct.pack("<I", _U32) + struct.pack("<I", val)
+
+
+def _kv_arr_str(key, vals):
+    out = _s(key) + struct.pack("<I", _ARRAY) + struct.pack("<I", _STRING)
+    out += struct.pack("<Q", len(vals))
+    for v in vals:
+        out += _s(v)
+    return out
+
+
+def _kv_arr_f32(key, vals):
+    out = _s(key) + struct.pack("<I", _ARRAY) + struct.pack("<I", _F32)
+    out += struct.pack("<Q", len(vals))
+    for v in vals:
+        out += struct.pack("<f", v)
+    return out
+
+
+def write_tiny_gguf(path: str) -> None:
+    """Minimal GGUF v3 with a unigram (llama) tokenizer."""
+    tokens = ["<unk>", "<s>", "</s>", "▁the", "▁quick", "▁fox", "t", "h", "e"]
+    scores = [0.0, 0.0, 0.0, -1.0, -2.0, -3.0, -10.0, -10.0, -10.0]
+    kvs = [
+        _kv_str("general.architecture", "llama"),
+        _kv_str("tokenizer.ggml.model", "llama"),
+        _kv_arr_str("tokenizer.ggml.tokens", tokens),
+        _kv_arr_f32("tokenizer.ggml.scores", scores),
+        _kv_u32("tokenizer.ggml.unknown_token_id", 0),
+        _kv_u32("tokenizer.ggml.bos_token_id", 1),
+        _kv_u32("tokenizer.ggml.eos_token_id", 2),
+    ]
+    with open(path, "wb") as f:
+        f.write(b"GGUF" + struct.pack("<I", 3))
+        f.write(struct.pack("<Q", 0))          # tensor count
+        f.write(struct.pack("<Q", len(kvs)))
+        for kv in kvs:
+            f.write(kv)
+
+
+def test_gguf_metadata_and_tokenizer(tmp_path):
+    path = str(tmp_path / "tiny.gguf")
+    write_tiny_gguf(path)
+    meta = load_metadata(path)
+    assert meta["general.architecture"] == "llama"
+    assert meta["tokenizer.ggml.model"] == "llama"
+    assert len(meta["tokenizer.ggml.tokens"]) == 9
+    assert special_token_ids(meta) == {"bos": 1, "eos": 2, "unknown": 0}
+
+    tok = tokenizer_from_gguf(path)
+    ids = tok.encode("▁the▁quick▁fox", add_special_tokens=False).ids
+    assert ids == [3, 4, 5]
+    assert "the quick fox" in tok.decode(ids).strip() or tok.decode(ids)
+
+    # the model-dir loader picks up a lone .gguf
+    hft = HuggingFaceTokenizer.from_file(str(tmp_path))
+    assert hft.encode("▁the", add_special_tokens=False) == [3]
+
+
+def test_gguf_rejects_garbage(tmp_path):
+    bad = tmp_path / "bad.gguf"
+    bad.write_bytes(b"NOPE" + b"\x00" * 16)
+    try:
+        load_metadata(str(bad))
+        raise AssertionError("expected ValueError")
+    except ValueError as e:
+        assert "not a GGUF" in str(e)
+
+
+# ---- SSE parse codec -------------------------------------------------
+
+
+def test_sse_basic_and_done():
+    msgs = decode_sse_lines([
+        "data: {\"x\": 1}",
+        "",
+        ": keep-alive comment",
+        "event: delta",
+        "data: {\"x\": 2}",
+        "",
+        "data: [DONE]",
+        "",
+    ])
+    assert msgs[0].json() == {"x": 1}
+    assert msgs[1].event == "delta"
+    assert msgs[1].json() == {"x": 2}
+    assert msgs[1].comments == ["keep-alive comment"]
+    assert msgs[2].done and msgs[2].data is None
+
+
+def test_sse_multiline_data_and_flush():
+    msgs = decode_sse_lines(["data: line1", "data: line2", ""])
+    assert msgs[0].data == "line1\nline2"
+    # unterminated tail flushes
+    msgs = decode_sse_lines(["data: tail"])
+    assert msgs[-1].data == "tail"
+
+
+async def test_sse_roundtrip_through_http_service():
+    """Emit side (HttpService) -> parse side (decode_sse_stream): the
+    codec must reassemble exactly what the service framed."""
+    from dynamo_tpu.llm.http.service import HttpService
+
+    class _Echo:
+        async def generate(self, ctx):
+            async def s():
+                for i in range(3):
+                    yield {
+                        "id": "c1", "object": "chat.completion.chunk",
+                        "created": 0, "model": ctx.payload.model,
+                        "choices": [{
+                            "index": 0, "delta": {"content": f"t{i}"},
+                            "finish_reason": "stop" if i == 2 else None,
+                        }],
+                    }
+
+            return s()
+
+    svc = HttpService()
+    svc.manager.add_chat_model("m", _Echo())
+    await svc.start("127.0.0.1", 0)
+    try:
+        async with aiohttp.ClientSession() as session:
+            r = await session.post(
+                f"http://127.0.0.1:{svc.port}/v1/chat/completions",
+                json={
+                    "model": "m",
+                    "messages": [{"role": "user", "content": "x"}],
+                    "stream": True,
+                },
+            )
+            assert r.status == 200
+            got: list[SseMessage] = []
+            async for msg in decode_sse_stream(r.content.iter_any()):
+                got.append(msg)
+    finally:
+        await svc.stop()
+    assert got[-1].done
+    texts = [
+        m.json()["choices"][0]["delta"].get("content")
+        for m in got[:-1]
+        if m.json() and m.json().get("choices")
+    ]
+    assert [t for t in texts if t] == ["t0", "t1", "t2"]
