@@ -117,3 +117,26 @@ async def test_llmctl_crud():
             assert await llmctl.list_models(hub) == []
         finally:
             await hub.close()
+
+
+def test_deploy_manifests_parse():
+    """The deploy YAML must at least be valid YAML with the expected
+    top-level objects (no cluster here; structural check only)."""
+    import yaml
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    k8s = os.path.join(root, "deploy", "kubernetes")
+    kinds = []
+    for name in sorted(os.listdir(k8s)):
+        with open(os.path.join(k8s, name)) as f:
+            for doc in yaml.safe_load_all(f):
+                assert doc and "kind" in doc, name
+                kinds.append(doc["kind"])
+    assert kinds.count("Deployment") == 3
+    assert kinds.count("Service") == 2
+    assert "Kustomization" in kinds
+    with open(os.path.join(root, "deploy", "docker-compose.yml")) as f:
+        compose = yaml.safe_load(f)
+    assert set(compose["services"]) >= {
+        "hub", "worker", "frontend", "prometheus", "grafana",
+    }
