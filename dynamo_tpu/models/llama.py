@@ -58,7 +58,7 @@ class AttnSpec:
 
     def __init__(self, slot_matrix=None, block_tables=None, lengths=None,
                  write_pos=None, page_size: int = 16, interpret: bool = False,
-                 mesh=None, write_tables=None, q_pos0=None):
+                 mesh=None, write_tables=None, q_pos0=None, ring: bool = False):
         self.slot_matrix = slot_matrix
         self.block_tables = block_tables
         self.lengths = lengths
@@ -72,6 +72,10 @@ class AttnSpec:
         # [B] chunk start positions (page-aligned): with block_tables +
         # lengths (=valid chunk rows) selects the pallas flash prefill
         self.q_pos0 = q_pos0
+        # long-context sequence parallelism: whole-prompt prefill with the
+        # token axis sharded over the mesh's sp axis — attention runs as a
+        # ring over ICI (ops/ring_attention.py), KV still lands in the pool
+        self.ring = ring
 
     @classmethod
     def gather(cls, slot_matrix, write_tables=None, page_size: int = 16,
@@ -80,6 +84,13 @@ class AttnSpec:
         return cls(slot_matrix=slot_matrix, write_tables=write_tables,
                    page_size=page_size, interpret=interpret, mesh=mesh,
                    block_tables=block_tables, q_pos0=q_pos0, lengths=lengths)
+
+    @classmethod
+    def ring(cls, slot_matrix, mesh, page_size: int = 16):
+        """Whole-prompt sp-sharded prefill: ring attention over the chunk
+        (which IS the full sequence), page-pool writes as usual."""
+        return cls(slot_matrix=slot_matrix, mesh=mesh, page_size=page_size,
+                   ring=True)
 
     @classmethod
     def pallas_decode(cls, block_tables, lengths, page_size, write_pos=None,
@@ -99,12 +110,12 @@ jax.tree_util.register_pytree_node(
     lambda s: (
         (s.slot_matrix, s.block_tables, s.lengths, s.write_pos,
          s.write_tables, s.q_pos0),
-        (s.page_size, s.interpret, s.mesh),
+        (s.page_size, s.interpret, s.mesh, s.ring),
     ),
     lambda aux, children: AttnSpec(
         slot_matrix=children[0], block_tables=children[1], lengths=children[2],
         write_pos=children[3], write_tables=children[4], q_pos0=children[5],
-        page_size=aux[0], interpret=aux[1], mesh=aux[2],
+        page_size=aux[0], interpret=aux[1], mesh=aux[2], ring=aux[3],
     ),
 )
 
@@ -272,6 +283,17 @@ def _attn_block(
             )
         else:
             out = paged_attention(q, kv_k, kv_v, attn.slot_matrix, positions)
+    elif attn.ring and attn.mesh is not None:
+        # sp-sharded whole-prompt prefill: KV lands in the (sp-replicated)
+        # pool for later decode; attention rings the fresh chunk blocks
+        # around the sp axis (ops/ring_attention.py)
+        from dynamo_tpu.ops.ring_attention import ring_attention_sharded
+
+        kv_k, kv_v = write_kv_slots(
+            kv_k, kv_v, write_slots,
+            k.reshape(b * t, kh * hd), v.reshape(b * t, kh * hd),
+        )
+        out = ring_attention_sharded(q, k, v, attn.mesh)
     else:
         kv_k, kv_v = write_kv_slots(
             kv_k, kv_v, write_slots,
