@@ -36,6 +36,10 @@ class ModelConfig:
     attn_bias: bool = False  # qwen2-style qkv bias
     rope_scaling: Optional[dict[str, Any]] = None
     dtype: str = "bfloat16"
+    # sparse MoE (mixtral-style): 0 experts = dense FFN
+    num_experts: int = 0
+    num_experts_per_tok: int = 2
+    expert_capacity_factor: float = 1.25
 
     @property
     def q_size(self) -> int:
@@ -68,6 +72,8 @@ class ModelConfig:
             tie_word_embeddings=hf.get("tie_word_embeddings", False),
             attn_bias=hf.get("model_type") == "qwen2",
             rope_scaling=hf.get("rope_scaling"),
+            num_experts=hf.get("num_local_experts", 0),
+            num_experts_per_tok=hf.get("num_experts_per_tok", 2),
         )
 
 
@@ -183,6 +189,39 @@ _preset(ModelConfig(
     head_dim=128,
     rope_theta=1000000.0,
     max_position_embeddings=32768,
+))
+
+# Sparse MoE family (the reference serves Mixtral/DeepSeek-MoE through
+# vLLM's fused-MoE kernels; here models/moe.py with the ep mesh axis).
+TINY_MOE = _preset(ModelConfig(
+    name="tiny-moe",
+    vocab_size=256,
+    hidden_size=64,
+    intermediate_size=128,
+    num_layers=2,
+    num_heads=4,
+    num_kv_heads=2,
+    head_dim=16,
+    rope_theta=10000.0,
+    max_position_embeddings=2048,
+    tie_word_embeddings=True,
+    num_experts=4,
+    num_experts_per_tok=2,
+))
+
+_preset(ModelConfig(
+    name="mixtral-8x7b",
+    vocab_size=32000,
+    hidden_size=4096,
+    intermediate_size=14336,
+    num_layers=32,
+    num_heads=32,
+    num_kv_heads=8,
+    head_dim=128,
+    rope_theta=1000000.0,
+    max_position_embeddings=32768,
+    num_experts=8,
+    num_experts_per_tok=2,
 ))
 
 

@@ -353,6 +353,18 @@ def forward(
     """
     if not isinstance(attn, AttnSpec):
         attn = AttnSpec.gather(attn)
+    # genuine-token mask for MoE capacity (padding must not evict real
+    # tokens): fused decode marks inactive rows by write_pos == -1; every
+    # other path routes padding's writes to trash slot 0
+    real_mask = None
+    if cfg.num_experts:
+        b_, t_ = tokens.shape
+        if attn.write_pos is not None:
+            real_mask = (attn.write_pos >= 0)[:, None] & jnp.ones(
+                (b_, t_), bool
+            )
+        else:
+            real_mask = write_slots.reshape(b_, t_) != 0
     x = params["embed"][tokens]
 
     inv_freq = jnp.asarray(rope_inv_freq(cfg))
@@ -368,7 +380,12 @@ def forward(
         )
         x = x + attn_out
         mlp_in = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
-        x = x + _mlp_block(lp, mlp_in)
+        if cfg.num_experts:
+            from dynamo_tpu.models.moe import moe_block
+
+            x = x + moe_block(lp, cfg, mlp_in, real_mask=real_mask)
+        else:
+            x = x + _mlp_block(lp, mlp_in)
         new_k_layers.append(layer_k)
         new_v_layers.append(layer_v)
 
@@ -405,10 +422,17 @@ def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> Params:
             "wv": dense(next(keys), (d, kvs)),
             "wo": dense(next(keys), (qs, d)),
             "mlp_norm": jnp.ones((d,), dtype),
-            "w_gate": dense(next(keys), (d, f)),
-            "w_up": dense(next(keys), (d, f)),
-            "w_down": dense(next(keys), (f, d)),
         }
+        if cfg.num_experts:
+            from dynamo_tpu.models.moe import init_moe_params
+
+            lp.update(init_moe_params(cfg, next(keys), dtype=dtype))
+        else:
+            lp.update({
+                "w_gate": dense(next(keys), (d, f)),
+                "w_up": dense(next(keys), (d, f)),
+                "w_down": dense(next(keys), (f, d)),
+            })
         if cfg.attn_bias:
             lp["bq"] = jnp.zeros((qs,), dtype)
             lp["bk"] = jnp.zeros((kvs,), dtype)

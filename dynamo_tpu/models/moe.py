@@ -1,0 +1,101 @@
+"""Sparse mixture-of-experts FFN (Mixtral-style) with expert parallelism.
+
+The reference serves MoE checkpoints (DeepSeek-R1, Mixtral) through its
+engines' fused MoE kernels + expert-parallel process groups (SURVEY §2.4
+— EP is an engine concern there). TPU-native, experts are one more mesh
+axis: expert weights live as [E, ...] arrays sharded P('ep', ...), the
+router's dispatch/combine are one-hot einsums (the GShard/Switch
+formulation), and GSPMD inserts the all-to-alls over the ep axis — no
+hand-written token shuffling.
+
+Capacity-based routing (GShard): each expert processes at most
+`capacity = ceil(k * N / E * capacity_factor)` tokens per step; overflow
+tokens fall through that expert (their combine weight is zero) —
+degraded quality, never a crash, and every shape stays static for XLA.
+Top-k weights are renormalized over the selected experts (Mixtral
+convention).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def init_moe_params(cfg, key, dtype=jnp.bfloat16) -> dict:
+    """Per-layer MoE params: router [D, E] + expert FFNs [E, D, F]/[E, F, D]."""
+    d, f, e = cfg.hidden_size, cfg.intermediate_size, cfg.num_experts
+    k_router, k_gate, k_up, k_down = jax.random.split(key, 4)
+
+    def dense(k, shape, scale):
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(dtype)
+
+    return {
+        "router": dense(k_router, (d, e), d ** -0.5),
+        "we_gate": dense(k_gate, (e, d, f), d ** -0.5),
+        "we_up": dense(k_up, (e, d, f), d ** -0.5),
+        "we_down": dense(k_down, (e, f, d), f ** -0.5),
+    }
+
+
+def expert_capacity(cfg, n_tokens: int) -> int:
+    """Static per-expert token budget, padded to a TPU-friendly multiple."""
+    e, k = cfg.num_experts, cfg.num_experts_per_tok
+    cap = int(k * n_tokens / e * cfg.expert_capacity_factor) + 1
+    return -(-cap // 8) * 8
+
+
+def moe_block(lp: dict, cfg, x: jnp.ndarray, real_mask=None) -> jnp.ndarray:
+    """x [B, T, D] -> [B, T, D]. Router top-k -> capacity-bounded one-hot
+    dispatch -> per-expert SwiGLU -> weighted combine.
+
+    `real_mask` [B, T] bool marks genuine tokens: padding rows (bucket
+    pad, inactive decode slots) must not consume expert capacity — a pad
+    row ahead of a real token in batch order would otherwise evict it."""
+    b, t, d = x.shape
+    n = b * t
+    e, k = cfg.num_experts, cfg.num_experts_per_tok
+    cap = expert_capacity(cfg, n)
+    xf = x.reshape(n, d)
+    real = (
+        jnp.ones((n,), jnp.float32)
+        if real_mask is None
+        else real_mask.reshape(n).astype(jnp.float32)
+    )
+
+    # fp32 routing: bf16 logits flip near-tie top-k membership
+    logits = xf.astype(jnp.float32) @ lp["router"].astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)                 # [N, E]
+    top_w, top_i = jax.lax.top_k(probs, k)                  # [N, k]
+    top_w = top_w / jnp.sum(top_w, axis=-1, keepdims=True)  # mixtral renorm
+
+    # position of each (token, slot) within its expert: slot-major cumsum
+    # so slot 0 assignments win capacity over slot 1 (GShard priority);
+    # pad rows are zeroed out of the count entirely
+    onehot = jax.nn.one_hot(top_i, e, dtype=jnp.float32)    # [N, k, E]
+    onehot = onehot * real[:, None, None]
+    flat = onehot.transpose(1, 0, 2).reshape(k * n, e)      # [kN, E]
+    pos = jnp.cumsum(flat, axis=0) - 1.0                    # [kN, E]
+    pos_in_e = jnp.sum(pos * flat, axis=-1)                 # [kN]
+    keep = (pos_in_e < cap) & (jnp.sum(flat, axis=-1) > 0)  # pads drop here
+
+    slot_w = top_w.T.reshape(k * n)                         # [kN]
+    expert_of = top_i.T.reshape(k * n)                      # [kN]
+    pos_oh = jax.nn.one_hot(
+        pos_in_e.astype(jnp.int32), cap, dtype=xf.dtype
+    )  # [kN, C]
+    exp_oh = jax.nn.one_hot(expert_of, e, dtype=xf.dtype)   # [kN, E]
+    keep_f = keep.astype(xf.dtype)
+
+    # dispatch [kN, E, C] (0/1), combine adds the routing weight
+    dispatch = exp_oh[:, :, None] * pos_oh[:, None, :] * keep_f[:, None, None]
+    combine = dispatch * slot_w.astype(xf.dtype)[:, None, None]
+
+    tok = jnp.tile(xf, (k, 1))                              # [kN, D]
+    expert_in = jnp.einsum("sec,sd->ecd", dispatch, tok)    # [E, C, D]
+    gate = jax.nn.silu(jnp.einsum("ecd,edf->ecf", expert_in, lp["we_gate"]))
+    up = jnp.einsum("ecd,edf->ecf", expert_in, lp["we_up"])
+    expert_out = jnp.einsum("ecf,efd->ecd", gate * up, lp["we_down"])
+    out = jnp.einsum("sec,ecd->sd", combine, expert_out)    # [kN, D]
+    out = out.reshape(k, n, d).sum(axis=0)                  # slots add up
+    return out.reshape(b, t, d)

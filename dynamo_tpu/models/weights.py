@@ -83,6 +83,22 @@ def load_params(
         "mlp.down_proj.weight": ("w_down", True),
     }
 
+    # mixtral MoE tensors stage per (layer, matrix) and flush to device
+    # the moment all E experts arrived — staging stays bounded at one
+    # [E, ...] group, keeping the one-tensor(-group) streaming invariant
+    moe_stage: dict[tuple[int, str], dict[int, np.ndarray]] = {}
+    moe_map = {"w1": "we_gate", "w3": "we_up", "w2": "we_down"}
+
+    def stage_moe(idx: int, ours: str, e_idx: int, tensor: np.ndarray) -> None:
+        group = moe_stage.setdefault((idx, ours), {})
+        group[e_idx] = np.ascontiguousarray(tensor.T)  # HF stores [out, in]
+        if len(group) == cfg.num_experts:
+            stacked = np.stack([group[e] for e in sorted(group)])
+            layers[idx][ours] = put(
+                f"layer{idx}.{ours}", stacked.astype(dtype)
+            )
+            del moe_stage[(idx, ours)]
+
     for name, tensor in _iter_safetensors(model_dir):
         if name == "model.embed_tokens.weight":
             params["embed"] = convert(name, tensor, transpose=False)
@@ -94,15 +110,42 @@ def load_params(
         elif name.startswith("model.layers."):
             rest = name[len("model.layers."):]
             idx_s, _, sub = rest.partition(".")
+            idx = int(idx_s)
+            if sub == "block_sparse_moe.gate.weight":
+                layers[idx]["router"] = convert(name, tensor, transpose=True)
+                continue
+            if sub.startswith("block_sparse_moe.experts."):
+                # block_sparse_moe.experts.{e}.{w1|w2|w3}.weight
+                e_s, _, w_name = sub[len("block_sparse_moe.experts."):].partition(".")
+                ours = moe_map.get(w_name.split(".")[0])
+                if ours is not None:
+                    stage_moe(idx, ours, int(e_s), tensor)
+                continue
             mapped = hf_layer_map.get(sub)
             if mapped is None:
                 continue  # rotary inv_freq etc.
             ours, transpose = mapped
-            layers[int(idx_s)][ours] = convert(name, tensor, transpose)
+            layers[idx][ours] = convert(name, tensor, transpose)
 
+    if moe_stage:
+        short = sorted(
+            f"layers[{i}].{ours}({len(g)}/{cfg.num_experts} experts)"
+            for (i, ours), g in moe_stage.items()
+        )
+        raise ValueError(
+            f"checkpoint {model_dir} has incomplete expert groups: {short[:5]}"
+        )
+    required = ["wq"]
+    if cfg.num_experts:
+        required += ["router", "we_gate", "we_up", "we_down"]
     missing = [
         k for k in ("embed", "final_norm") if k not in params
-    ] + [f"layers[{i}]" for i, lp in enumerate(layers) if "wq" not in lp]
+    ] + [
+        f"layers[{i}].{r}"
+        for i, lp in enumerate(layers)
+        for r in required
+        if r not in lp
+    ]
     if missing:
         raise ValueError(f"checkpoint {model_dir} missing tensors: {missing[:5]}")
     return params

@@ -86,6 +86,11 @@ def validate_model_mesh(cfg: ModelConfig, mc: MeshConfig) -> None:
             f"model '{cfg.name}' has num_heads={cfg.num_heads}, which is "
             f"not divisible by tp={mc.tp}"
         )
+    if mc.ep > 1 and cfg.num_experts % mc.ep:
+        raise ValueError(
+            f"model '{cfg.name}' has num_experts={cfg.num_experts}, which "
+            f"is not divisible by ep={mc.ep}"
+        )
 
 
 def param_shardings(cfg: ModelConfig, mesh: Mesh) -> dict:
@@ -110,10 +115,23 @@ def param_shardings(cfg: ModelConfig, mesh: Mesh) -> dict:
         "wv": ns(None, "tp"),
         "wo": ns("tp", None),
         "mlp_norm": ns(),
-        "w_gate": ns(None, "tp"),
-        "w_up": ns(None, "tp"),
-        "w_down": ns("tp", None),
     }
+    if cfg.num_experts:
+        # sparse MoE: experts over ep, each expert's FFN column/row
+        # parallel over tp (models/moe.py; GSPMD inserts the dispatch/
+        # combine all-to-alls over ep)
+        layer.update({
+            "router": ns(),
+            "we_gate": ns("ep", None, "tp"),
+            "we_up": ns("ep", None, "tp"),
+            "we_down": ns("ep", "tp", None),
+        })
+    else:
+        layer.update({
+            "w_gate": ns(None, "tp"),
+            "w_up": ns(None, "tp"),
+            "w_down": ns("tp", None),
+        })
     if cfg.attn_bias:
         layer["bq"] = ns("tp")
         layer["bk"] = ns("tp")
