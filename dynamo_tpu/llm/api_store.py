@@ -1,0 +1,167 @@
+"""api-store: REST registry of packaged graphs and deployments.
+
+Equivalent of the reference's api-store service (reference:
+deploy/dynamo/api-store/ai_dynamo_store/api/dynamo.py:59 — FastAPI +
+SQL + S3 storing packaged graphs ("Dynamo NIMs"), their versions, and
+deployment records for the operator/UI). TPU-native build: aiohttp over
+the hub's KV (records) and object store (archives) — no extra database
+or S3 dependency in the serving plane.
+
+API (mirroring the reference's surface):
+    GET/POST        /api/v1/graphs                  {name, description}
+    GET             /api/v1/graphs/{name}
+    GET/POST        /api/v1/graphs/{name}/versions  {version, manifest}
+    PUT/GET         /api/v1/graphs/{name}/versions/{v}/archive   (bytes)
+    GET/POST/DELETE /api/v1/deployments             {name, graph, version, config}
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Optional
+
+from aiohttp import web
+
+GRAPH_ROOT = "/api-store/graphs/"
+DEPLOY_ROOT = "/api-store/deployments/"
+ARCHIVE_BUCKET = "graph-archives"
+
+
+class ApiStore:
+    def __init__(self, hub):
+        self.hub = hub
+        self.app = web.Application(client_max_size=256 * 1024 * 1024)
+        self.app.add_routes(
+            [
+                web.get("/api/v1/graphs", self.list_graphs),
+                web.post("/api/v1/graphs", self.create_graph),
+                web.get("/api/v1/graphs/{name}", self.get_graph),
+                web.get("/api/v1/graphs/{name}/versions", self.list_versions),
+                web.post("/api/v1/graphs/{name}/versions", self.create_version),
+                web.put(
+                    "/api/v1/graphs/{name}/versions/{version}/archive",
+                    self.put_archive,
+                ),
+                web.get(
+                    "/api/v1/graphs/{name}/versions/{version}/archive",
+                    self.get_archive,
+                ),
+                web.get("/api/v1/deployments", self.list_deployments),
+                web.post("/api/v1/deployments", self.create_deployment),
+                web.delete("/api/v1/deployments/{name}", self.delete_deployment),
+            ]
+        )
+        self._runner: Optional[web.AppRunner] = None
+        self.port = 0
+
+    # ---- lifecycle ----------------------------------------------------
+
+    async def start(self, host: str = "0.0.0.0", port: int = 0) -> None:
+        self._runner = web.AppRunner(self.app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, host, port)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]
+
+    async def stop(self) -> None:
+        if self._runner:
+            await self._runner.cleanup()
+
+    # ---- graphs -------------------------------------------------------
+
+    async def list_graphs(self, request: web.Request) -> web.Response:
+        items = await self.hub.kv_get_prefix(GRAPH_ROOT)
+        graphs = [
+            json.loads(i["value"])
+            for i in items
+            if i["key"].count("/") == GRAPH_ROOT.count("/")  # no versions
+        ]
+        return web.json_response(graphs)
+
+    async def create_graph(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        name = body.get("name")
+        if not name:
+            return web.json_response({"error": "name required"}, status=400)
+        rec = {
+            "name": name,
+            "description": body.get("description", ""),
+            "created_at": time.time(),
+        }
+        await self.hub.kv_put(f"{GRAPH_ROOT}{name}", json.dumps(rec).encode())
+        return web.json_response(rec, status=201)
+
+    async def get_graph(self, request: web.Request) -> web.Response:
+        name = request.match_info["name"]
+        item = await self.hub.kv_get(f"{GRAPH_ROOT}{name}")
+        if item is None:
+            return web.json_response({"error": "not found"}, status=404)
+        return web.json_response(json.loads(item["value"]))
+
+    # ---- versions -----------------------------------------------------
+
+    async def list_versions(self, request: web.Request) -> web.Response:
+        name = request.match_info["name"]
+        items = await self.hub.kv_get_prefix(f"{GRAPH_ROOT}{name}/versions/")
+        return web.json_response([json.loads(i["value"]) for i in items])
+
+    async def create_version(self, request: web.Request) -> web.Response:
+        name = request.match_info["name"]
+        if await self.hub.kv_get(f"{GRAPH_ROOT}{name}") is None:
+            return web.json_response({"error": "graph not found"}, status=404)
+        body = await request.json()
+        version = body.get("version")
+        if not version:
+            return web.json_response({"error": "version required"}, status=400)
+        rec = {
+            "graph": name,
+            "version": version,
+            "manifest": body.get("manifest", {}),
+            "created_at": time.time(),
+        }
+        await self.hub.kv_put(
+            f"{GRAPH_ROOT}{name}/versions/{version}", json.dumps(rec).encode()
+        )
+        return web.json_response(rec, status=201)
+
+    async def put_archive(self, request: web.Request) -> web.Response:
+        name, version = request.match_info["name"], request.match_info["version"]
+        data = await request.read()
+        await self.hub.obj_put(ARCHIVE_BUCKET, f"{name}/{version}", data)
+        return web.json_response({"size": len(data)}, status=201)
+
+    async def get_archive(self, request: web.Request) -> web.Response:
+        name, version = request.match_info["name"], request.match_info["version"]
+        data = await self.hub.obj_get(ARCHIVE_BUCKET, f"{name}/{version}")
+        if data is None:
+            return web.json_response({"error": "not found"}, status=404)
+        return web.Response(body=data, content_type="application/octet-stream")
+
+    # ---- deployments --------------------------------------------------
+
+    async def list_deployments(self, request: web.Request) -> web.Response:
+        items = await self.hub.kv_get_prefix(DEPLOY_ROOT)
+        return web.json_response([json.loads(i["value"]) for i in items])
+
+    async def create_deployment(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        name = body.get("name")
+        if not name:
+            return web.json_response({"error": "name required"}, status=400)
+        rec = {
+            "name": name,
+            "graph": body.get("graph"),
+            "version": body.get("version"),
+            "config": body.get("config", {}),
+            "created_at": time.time(),
+        }
+        await self.hub.kv_put(f"{DEPLOY_ROOT}{name}", json.dumps(rec).encode())
+        return web.json_response(rec, status=201)
+
+    async def delete_deployment(self, request: web.Request) -> web.Response:
+        name = request.match_info["name"]
+        n = await self.hub.kv_del(f"{DEPLOY_ROOT}{name}")
+        if not n:
+            return web.json_response({"error": "not found"}, status=404)
+        return web.json_response({"deleted": name})

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import asyncio
 import os
+from dataclasses import dataclass
 from typing import AsyncIterator
 
 from dynamo_tpu.llm.protocols.common import (
@@ -19,6 +20,27 @@ from dynamo_tpu.llm.protocols.common import (
     PreprocessedRequest,
 )
 from dynamo_tpu.runtime.pipeline.context import Context
+
+
+@dataclass
+class MultiNodeConfig:
+    """Multi-node engine launch surface (reference: engines.rs
+    MultiNodeConfig{num_nodes, node_rank, leader_addr}) — the engine-level
+    alias of parallel.multihost.MultiHostConfig: `leader_addr` is the
+    jax.distributed coordinator."""
+
+    num_nodes: int = 1
+    node_rank: int = 0
+    leader_addr: str = ""
+
+    def to_multihost(self):
+        from dynamo_tpu.parallel.multihost import MultiHostConfig
+
+        return MultiHostConfig(
+            num_nodes=self.num_nodes,
+            node_rank=self.node_rank,
+            coordinator=self.leader_addr or None,
+        )
 
 
 def _token_delay_s() -> float:

@@ -1,0 +1,70 @@
+"""KV layout rearrange between mismatched worker shardings.
+
+Equivalent of the reference's kv_rearrange kernels (reference: vLLM patch
+`kv_rearrange.py`, container/deps/vllm/vllm_v0.7.2-dynamo-kv-disagg-patch
+.patch:935 — Triton transposes bridging different TP shardings during
+NIXL block transfer): when the prefill worker and the decode worker run
+different tensor-parallel degrees or page sizes, transferred KV must be
+re-laid-out before injection.
+
+This framework's disagg wire format is already the neutral layout —
+`[L, T, K*Hd]` full-width rows (dynamo_tpu/llm/disagg, engine
+_extract_fn/_inject_fn) — so same-shape transfers need no rearrange.
+These helpers cover the remaining mismatches:
+
+- tp shard <-> full-width: a tp-ranked worker that stages only its local
+  KV slice (device-path transfers ship shard-local buffers to avoid the
+  all-gather) exchanges with a worker of a different tp degree;
+- page-size repacking: page-granular buffers between engines configured
+  with different page sizes.
+
+All functions are pure numpy (host-staged plane); the device path reuses
+them on jnp arrays unchanged (same API surface).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def shard_kv(full: np.ndarray, tp: int, rank: int) -> np.ndarray:
+    """[..., K*Hd] full-width rows -> rank's slice under `tp` (whole KV
+    heads per shard, contiguous Hd blocks — mesh.kv_cache_sharding)."""
+    kw = full.shape[-1]
+    if kw % tp:
+        raise ValueError(f"KV width {kw} not divisible by tp={tp}")
+    step = kw // tp
+    return full[..., rank * step:(rank + 1) * step]
+
+
+def unshard_kv(shards: list[np.ndarray]) -> np.ndarray:
+    """Inverse of shard_kv: rank-ordered slices -> full-width rows."""
+    return np.concatenate(shards, axis=-1)
+
+
+def rearrange_tp(
+    shards: list[np.ndarray], dst_tp: int
+) -> list[np.ndarray]:
+    """src_tp shard-local buffers -> dst_tp shard-local buffers (the
+    patch:935 operation). Works on any [..., K*Hd/src_tp] shape."""
+    full = unshard_kv(shards)
+    return [shard_kv(full, dst_tp, r) for r in range(dst_tp)]
+
+
+def repack_pages(
+    pages: np.ndarray, src_page_size: int, dst_page_size: int
+) -> np.ndarray:
+    """[n_pages, src_page, ...] page blocks -> [m_pages, dst_page, ...].
+    Total token count must be divisible by dst_page_size (pad upstream:
+    trailing positions of the final page may be garbage by the engine's
+    page contract)."""
+    n, ps = pages.shape[0], pages.shape[1]
+    if ps != src_page_size:
+        raise ValueError(f"pages have page_size {ps}, expected {src_page_size}")
+    tokens = pages.reshape(n * ps, *pages.shape[2:])
+    total = tokens.shape[0]
+    if total % dst_page_size:
+        raise ValueError(
+            f"{total} tokens not divisible by dst page size {dst_page_size}"
+        )
+    return tokens.reshape(total // dst_page_size, dst_page_size, *pages.shape[2:])

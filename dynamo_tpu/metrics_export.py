@@ -1,0 +1,154 @@
+"""Standalone metrics aggregator: stats-plane scrape -> Prometheus.
+
+The reference ships this as a separate binary (reference:
+components/metrics/src/main.rs:50-611 — NATS $SRV.STATS scrape of one
+component endpoint on a poll interval, exposing
+dynamo_llm_kv_blocks_active/total, requests_active/total, load_avg/std
+gauges plus kv-hit-rate counters from the `kv-hit-rate` subject).
+
+    python -m dynamo_tpu.metrics_export \
+        --endpoint dyn://dynamo.Worker.generate --hub host:port --port 9091
+
+Scrapes every --poll-interval via the existing stats plane
+(Client.scrape_stats -> KvMetricsAggregator) and subscribes the
+component's kv-hit-rate events; serves GET /metrics in Prometheus text.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import statistics
+from typing import Optional
+
+from aiohttp import web
+
+from dynamo_tpu.llm.kv_router.metrics_aggregator import KvMetricsAggregator
+from dynamo_tpu.llm.kv_router.protocols import KV_HIT_RATE_SUBJECT
+from dynamo_tpu.runtime.component import EndpointId
+from dynamo_tpu.utils.logging import configure_logging
+
+PREFIX = "dynamo_llm"
+
+
+class MetricsExporter:
+    def __init__(self, drt, endpoint_path: str, poll_interval: float = 2.0):
+        self.drt = drt
+        self.eid = EndpointId.parse(endpoint_path)
+        self.poll_interval = poll_interval
+        self.aggregator: Optional[KvMetricsAggregator] = None
+        self.hit_events = 0
+        self.hit_tokens = 0
+        self.request_tokens = 0
+        self._sub = None
+        self._task: Optional[asyncio.Task] = None
+        self.app = web.Application()
+        self.app.add_routes([web.get("/metrics", self._metrics)])
+        self._runner: Optional[web.AppRunner] = None
+        self.port = 0
+
+    async def start(self, host: str = "0.0.0.0", port: int = 0) -> None:
+        ep = (
+            self.drt.namespace(self.eid.namespace)
+            .component(self.eid.component)
+            .endpoint(self.eid.name)
+        )
+        client = await ep.client()
+        self.aggregator = KvMetricsAggregator(
+            client, poll_interval=self.poll_interval
+        )
+        await self.aggregator.start()
+        comp = self.drt.namespace(self.eid.namespace).component(self.eid.component)
+        self._sub = await comp.subscribe(KV_HIT_RATE_SUBJECT)
+        self._task = asyncio.create_task(self._pump_hit_rate())
+        self._runner = web.AppRunner(self.app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, host, port)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]
+
+    async def _pump_hit_rate(self) -> None:
+        import msgpack
+
+        async for ev in self._sub:
+            try:
+                d = msgpack.unpackb(ev["data"], raw=False)
+                self.hit_events += 1
+                self.hit_tokens += int(d.get("overlap_blocks", 0)) * int(
+                    d.get("block_size", 1)
+                )
+                self.request_tokens += int(d.get("isl_blocks", 0)) * int(
+                    d.get("block_size", 1)
+                )
+            except Exception:  # noqa: BLE001 — a bad event must not stop export
+                continue
+
+    def render(self) -> str:
+        snap = self.aggregator.current if self.aggregator else None
+        eps = snap.endpoints if snap else {}
+        lines = []
+
+        def gauge(name: str, value, labels: str = "") -> None:
+            lines.append(f"# TYPE {PREFIX}_{name} gauge")
+            lines.append(f"{PREFIX}_{name}{labels} {value}")
+
+        gauge("worker_count", len(eps))
+        for wid, m in eps.items():
+            lab = f'{{worker_id="{wid:x}"}}'
+            gauge("kv_blocks_active", m.kv_active_blocks, lab)
+            gauge("kv_blocks_total", m.kv_total_blocks, lab)
+            gauge("requests_active_slots", m.request_active_slots, lab)
+            gauge("requests_total_slots", m.request_total_slots, lab)
+            gauge("gpu_cache_usage_percent", m.gpu_cache_usage_perc, lab)
+            gauge("requests_waiting", m.num_requests_waiting, lab)
+        loads = [m.kv_active_blocks for m in eps.values()]
+        gauge("load_avg", statistics.fmean(loads) if loads else 0.0)
+        gauge("load_std", statistics.pstdev(loads) if len(loads) > 1 else 0.0)
+        lines.append(f"# TYPE {PREFIX}_kv_hit_rate_events counter")
+        lines.append(f"{PREFIX}_kv_hit_rate_events {self.hit_events}")
+        lines.append(f"# TYPE {PREFIX}_kv_hit_tokens counter")
+        lines.append(f"{PREFIX}_kv_hit_tokens {self.hit_tokens}")
+        lines.append(f"# TYPE {PREFIX}_kv_request_tokens counter")
+        lines.append(f"{PREFIX}_kv_request_tokens {self.request_tokens}")
+        return "\n".join(lines) + "\n"
+
+    async def _metrics(self, request: web.Request) -> web.Response:
+        return web.Response(
+            text=self.render(), content_type="text/plain", charset="utf-8"
+        )
+
+    async def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+        if self._sub is not None:
+            await self._sub.unsubscribe()
+        if self.aggregator:
+            await self.aggregator.close()
+        if self._runner:
+            await self._runner.cleanup()
+
+
+async def amain(args) -> None:
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+    drt = await DistributedRuntime.from_settings(hub_addr=args.hub)
+    exporter = MetricsExporter(drt, args.endpoint, poll_interval=args.poll_interval)
+    await exporter.start(args.host, args.port)
+    print(f"prometheus metrics on :{exporter.port}/metrics")
+    await asyncio.Event().wait()
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(prog="python -m dynamo_tpu.metrics_export")
+    p.add_argument("--endpoint", required=True, help="dyn://ns.comp.ep to scrape")
+    p.add_argument("--hub", default=None)
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=9091)
+    p.add_argument("--poll-interval", type=float, default=2.0)
+    args = p.parse_args()
+    configure_logging()
+    asyncio.run(amain(args))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,146 @@
+"""api-store REST CRUD (reference: deploy/dynamo/api-store — graphs,
+versions, archives, deployments) + kv_rearrange + metrics exporter."""
+
+from __future__ import annotations
+
+import aiohttp
+import numpy as np
+
+from dynamo_tpu.llm.api_store import ApiStore
+from dynamo_tpu.llm.kv_rearrange import (
+    rearrange_tp,
+    repack_pages,
+    shard_kv,
+    unshard_kv,
+)
+from dynamo_tpu.runtime.hub.client import HubClient
+
+from .helpers import hub_server
+
+
+def test_kv_rearrange_tp_roundtrip():
+    rng = np.random.RandomState(0)
+    full = rng.randn(4, 16, 512).astype(np.float32)  # [L, T, K*Hd]
+    shards2 = [shard_kv(full, 2, r) for r in range(2)]
+    assert shards2[0].shape[-1] == 256
+    np.testing.assert_array_equal(unshard_kv(shards2), full)
+    # tp=2 -> tp=4 (patch:935 mismatched-TP transfer)
+    shards4 = rearrange_tp(shards2, 4)
+    assert len(shards4) == 4 and shards4[0].shape[-1] == 128
+    np.testing.assert_array_equal(unshard_kv(shards4), full)
+    # and back down
+    np.testing.assert_array_equal(
+        unshard_kv(rearrange_tp(shards4, 2)), full
+    )
+
+
+def test_repack_pages():
+    rng = np.random.RandomState(1)
+    pages16 = rng.randn(8, 16, 64).astype(np.float32)  # 128 tokens
+    pages64 = repack_pages(pages16, 16, 64)
+    assert pages64.shape == (2, 64, 64)
+    np.testing.assert_array_equal(
+        pages64.reshape(-1, 64), pages16.reshape(-1, 64)
+    )
+    back = repack_pages(pages64, 64, 16)
+    np.testing.assert_array_equal(back, pages16)
+
+
+async def test_api_store_crud():
+    async with hub_server() as server:
+        hub = await HubClient.connect(f"127.0.0.1:{server.port}")
+        store = ApiStore(hub)
+        await store.start("127.0.0.1", 0)
+        base = f"http://127.0.0.1:{store.port}/api/v1"
+        try:
+            async with aiohttp.ClientSession() as s:
+                # graphs
+                r = await s.post(base + "/graphs", json={"name": "agg"})
+                assert r.status == 201
+                r = await s.get(base + "/graphs/agg")
+                assert (await r.json())["name"] == "agg"
+                r = await s.get(base + "/graphs/missing")
+                assert r.status == 404
+
+                # versions + archive round trip
+                r = await s.post(
+                    base + "/graphs/agg/versions",
+                    json={"version": "v1", "manifest": {"services": 2}},
+                )
+                assert r.status == 201
+                blob = b"\x00archive-bytes" * 100
+                r = await s.put(base + "/graphs/agg/versions/v1/archive", data=blob)
+                assert r.status == 201
+                r = await s.get(base + "/graphs/agg/versions/v1/archive")
+                assert await r.read() == blob
+                r = await s.get(base + "/graphs/agg/versions")
+                assert [v["version"] for v in await r.json()] == ["v1"]
+
+                # deployments
+                r = await s.post(
+                    base + "/deployments",
+                    json={"name": "prod", "graph": "agg", "version": "v1"},
+                )
+                assert r.status == 201
+                r = await s.get(base + "/deployments")
+                assert len(await r.json()) == 1
+                r = await s.delete(base + "/deployments/prod")
+                assert (await r.json())["deleted"] == "prod"
+                r = await s.get(base + "/deployments")
+                assert await r.json() == []
+        finally:
+            await store.stop()
+            await hub.close()
+
+
+async def test_metrics_exporter_scrapes_and_renders():
+    from dynamo_tpu.metrics_export import MetricsExporter
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+    async with hub_server() as server:
+        hub_addr = f"127.0.0.1:{server.port}"
+        worker = await DistributedRuntime.from_settings(hub_addr=hub_addr)
+        observer = await DistributedRuntime.from_settings(hub_addr=hub_addr)
+        try:
+            class _E:
+                async def generate(self, ctx):
+                    async def s():
+                        yield {}
+
+                    return s()
+
+            ep = worker.namespace("m").component("w").endpoint("generate")
+            await ep.endpoint_builder().engine(_E()).stats_handler(
+                lambda: {
+                    "kv_active_blocks": 7, "kv_total_blocks": 100,
+                    "request_active_slots": 3, "request_total_slots": 8,
+                    "gpu_cache_usage_perc": 0.07,
+                }
+            ).start()
+
+            exporter = MetricsExporter(
+                observer, "dyn://m.w.generate", poll_interval=0.1
+            )
+            await exporter.start("127.0.0.1", 0)
+            try:
+                import asyncio
+
+                text = ""
+                async with aiohttp.ClientSession() as s:
+                    for _ in range(50):
+                        r = await s.get(
+                            f"http://127.0.0.1:{exporter.port}/metrics"
+                        )
+                        text = await r.text()
+                        if "dynamo_llm_kv_blocks_active" in text and "7" in text:
+                            break
+                        await asyncio.sleep(0.1)
+                assert "dynamo_llm_worker_count 1" in text
+                assert "dynamo_llm_kv_blocks_active" in text
+                assert "dynamo_llm_load_avg 7" in text
+                assert "dynamo_llm_kv_hit_rate_events 0" in text
+            finally:
+                await exporter.stop()
+        finally:
+            await observer.shutdown()
+            await worker.shutdown()
