@@ -256,7 +256,7 @@ def fused_paged_decode_attention(
     *,
     page_size: int,
     pages_per_block: int = 4,
-    nbuf: int = 4,
+    nbuf: int = 8,
     interpret: bool = False,
     ablate: str = "",
     alias_caches: bool = True,

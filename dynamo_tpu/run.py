@@ -54,6 +54,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--num-pages", type=int, default=None)
     p.add_argument("--prefill-chunk", type=int, default=512)
     p.add_argument("--decode-steps", type=int, default=8)
+    p.add_argument("--attn-backend", default="auto",
+                   choices=["auto", "pallas", "gather"])
+    p.add_argument("--host-kv-pages", type=int, default=0,
+                   help="HBM->host KV offload pool size (0 disables)")
     p.add_argument("--dtype", default="bfloat16", choices=["bfloat16", "float32"])
     p.add_argument("--extra-engine-args", help="JSON file of EngineConfig overrides")
     p.add_argument("--request-template",
@@ -97,6 +101,8 @@ def build_engine_config_kwargs(args) -> dict:
         max_model_len=args.max_model_len,
         prefill_chunk=args.prefill_chunk,
         decode_steps=args.decode_steps,
+        attn_backend=args.attn_backend,
+        host_kv_pages=args.host_kv_pages,
     )
     if args.extra_engine_args:
         with open(args.extra_engine_args) as f:
