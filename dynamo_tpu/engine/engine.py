@@ -223,7 +223,7 @@ class JaxEngine:
         # per all_greedy variant — static so the pure-greedy batch skips
         # the sampling shortlist entirely)
         self._step_fn = jax.jit(
-            self._model_step, donate_argnums=(1,), static_argnums=(13,)
+            self._model_step, donate_argnums=(1,), static_argnums=(15,)
         )
         # multi-step decode: `decode_steps` iterations per dispatch
         self._decode_fn = jax.jit(
@@ -303,7 +303,8 @@ class JaxEngine:
 
     def _model_step(self, params, kv, tokens, positions, write_slots, slot_matrix,
                     last_idx, temp, topk, topp, key, wtables=None,
-                    btables=None, all_greedy=False):
+                    btables=None, embeds=None, embeds_mask=None,
+                    all_greedy=False):
         if wtables is not None:
             # pallas prefill: page-scatter write + flash attention over
             # the streamed pages (the XLA row scatter serializes; the
@@ -317,7 +318,8 @@ class JaxEngine:
         else:
             attn = llama.AttnSpec.gather(slot_matrix)
         hidden, kv = llama.forward(
-            params, self.model_cfg, tokens, positions, kv, write_slots, attn
+            params, self.model_cfg, tokens, positions, kv, write_slots, attn,
+            embeds=embeds, embeds_mask=embeds_mask,
         )
         last_h = jnp.take_along_axis(
             hidden, last_idx[:, None, None].astype(jnp.int32), axis=1
@@ -419,6 +421,24 @@ class JaxEngine:
             )
         if len(pre.token_ids) == 0:
             raise ValueError("empty prompt")
+        if pre.prompt_embeds is not None:
+            # fail fast: a silently dropped/misaligned embed span would
+            # produce plausible but image-blind output
+            n_emb = len(pre.prompt_embeds)
+            off = pre.embeds_offset
+            if n_emb == 0:
+                raise ValueError("prompt_embeds is empty")
+            if off < 0 or off + n_emb > len(pre.token_ids):
+                raise ValueError(
+                    f"embed span [{off}, {off + n_emb}) outside the "
+                    f"{len(pre.token_ids)}-token prompt"
+                )
+            width = len(pre.prompt_embeds[0])
+            if width != self.model_cfg.hidden_size:
+                raise ValueError(
+                    f"prompt_embeds width {width} != model hidden size "
+                    f"{self.model_cfg.hidden_size}"
+                )
         seq = Sequence.from_request(
             request, pre, self.page_size, self.config.max_model_len
         )
@@ -625,9 +645,14 @@ class JaxEngine:
         all current tokens; host-tier hits are restored by H2D scatter."""
         t = seq.total_tokens
         hashes = seq.blocks.sequence_hashes()
+        cap = seq.cacheable_pages(self.page_size)
+        if cap is not None:
+            # embed sequences: only the text prefix below embeds_offset
+            # has sound hashes (placeholder ids don't cover the image)
+            hashes = hashes[:cap]
         matched = self.allocator.match_prefix(hashes)
         host_run: list[int] = []
-        if self.host_pool is not None:
+        if self.host_pool is not None and hashes:
             host_run = self.host_pool.match_prefix(hashes[len(matched):])
         # ensure >=1 token is computed (there must be a query position)
         while (len(matched) + len(host_run)) * self.page_size >= t:
@@ -793,6 +818,24 @@ class JaxEngine:
         ps = self.page_size
         ppc = -(-bucket // ps)  # page blocks per chunk (pallas write path)
         wtables = np.zeros((n, ppc), np.int32)
+        # multimodal: a separate compiled family only when THIS chunk of
+        # some sequence overlaps its embed span — the common path (and
+        # later text-only chunks of an image prompt) pays nothing
+        def _chunk_overlaps(s) -> bool:
+            if s.prompt_embeds is None:
+                return False
+            c0 = s.num_computed
+            c1 = c0 + min(s.total_tokens - c0, bucket)
+            return c0 < s.embeds_offset + len(s.prompt_embeds) and s.embeds_offset < c1
+
+        has_embeds = any(_chunk_overlaps(s) for s in seqs)
+        emb = emb_mask = None
+        if has_embeds:
+            d_model = self.model_cfg.hidden_size
+            emb = np.zeros(
+                (n, bucket, d_model), self._dtype.dtype
+            )  # model dtype: forward casts anyway, halve the H2D bytes
+            emb_mask = np.zeros((n, bucket), bool)
         # attention table width: pages actually attended this chunk,
         # bucketed to a power of two so compile families stay bounded —
         # full width would DMA every (mostly trash) page per query tile
@@ -822,6 +865,16 @@ class JaxEngine:
             wtables[j, :n_pages_used] = pages[start // ps : start // ps + n_pages_used]
             npg = min(len(pages), w_b)
             btables[j, :npg] = pages[:npg]
+            if has_embeds and seq.prompt_embeds is not None:
+                # overlap of [start, start+chunk) with the embed span
+                e0 = seq.embeds_offset
+                e1 = e0 + len(seq.prompt_embeds)
+                lo, hi = max(start, e0), min(start + chunk, e1)
+                if lo < hi:
+                    emb[j, lo - start:hi - start] = seq.prompt_embeds[
+                        lo - e0:hi - e0
+                    ]
+                    emb_mask[j, lo - start:hi - start] = True
             last_idx[j] = chunk - 1
             temp[j] = seq.temperature
             topk[j] = seq.top_k
@@ -837,6 +890,8 @@ class JaxEngine:
                 sub,
                 jnp.asarray(wtables.reshape(-1)) if self._attn_pallas else None,
                 jnp.asarray(btables) if self._attn_pallas else None,
+                jnp.asarray(emb) if has_embeds else None,
+                jnp.asarray(emb_mask) if has_embeds else None,
                 bool((temp <= 0.0).all()),
             )
         for j, seq in enumerate(seqs):
@@ -1073,6 +1128,9 @@ class JaxEngine:
 
     def _register_full_pages(self, seq: Sequence) -> None:
         full = seq.num_computed // self.page_size
+        cap = seq.cacheable_pages(self.page_size)
+        if cap is not None:
+            full = min(full, cap)  # hashes past embeds_offset are unsound
         start = seq.registered_pages
         if full <= start:
             return
@@ -1084,13 +1142,19 @@ class JaxEngine:
         )
         seq.registered_pages = full
 
-    def peek_prefix_tokens(self, token_ids: list[int]) -> int:
+    def peek_prefix_tokens(
+        self, token_ids: list[int], max_tokens: Optional[int] = None
+    ) -> int:
         """Non-destructive cached-prefix length across BOTH tiers (HBM,
         then host continuation) — the disagg/router decision input must
-        agree with what _reserve_pages would actually reuse."""
+        agree with what _reserve_pages would actually reuse. For embed
+        requests pass `max_tokens=embeds_offset`: reservation only
+        matches the text prefix below the image span."""
         from dynamo_tpu.llm.tokens import compute_block_hashes
 
         hashes = compute_block_hashes(token_ids, self.page_size)
+        if max_tokens is not None:
+            hashes = hashes[: max_tokens // self.page_size]
         n = 0
         for h in hashes:
             if h in self.allocator._by_hash:

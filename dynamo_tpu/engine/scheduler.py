@@ -58,6 +58,11 @@ class Sequence:
     # disagg: (first_token, k [L,T,Kh*Hd], v) delivered by a remote prefill
     # worker — admission injects this into pages instead of computing it
     preloaded: Optional[tuple] = None
+    # multimodal: [T_img, D] embeddings replacing token lookups starting
+    # at embeds_offset; embed sequences skip the prefix cache (block
+    # hashes over placeholder ids would alias distinct images)
+    prompt_embeds: Optional[object] = None
+    embeds_offset: int = 0
 
     # per-request sampling (resolved once at admission)
     temperature: float = 0.0
@@ -88,7 +93,27 @@ class Sequence:
             list(pre.eos_token_ids) + list(pre.stop_conditions.stop_token_ids)
         )
         seq.ignore_eos = pre.stop_conditions.ignore_eos
+        if pre.prompt_embeds is not None:
+            import numpy as np
+
+            seq.prompt_embeds = np.asarray(pre.prompt_embeds, np.float32)
+            seq.embeds_offset = int(pre.embeds_offset)
         return seq
+
+    @property
+    def no_cache(self) -> bool:
+        """Prefix caching is unsound from the first embed position on:
+        block hashes cover the placeholder token ids, not the image
+        contents. The text prefix BEFORE embeds_offset stays cacheable
+        (see cacheable_pages)."""
+        return self.prompt_embeds is not None
+
+    def cacheable_pages(self, page_size: int) -> Optional[int]:
+        """Page count eligible for prefix-cache match/registration; None
+        means unlimited (no embeds)."""
+        if self.prompt_embeds is None:
+            return None
+        return self.embeds_offset // page_size
 
     @property
     def tokens(self) -> list[int]:

@@ -310,11 +310,18 @@ class DisaggDecodeWorker:
         decision = False
         if not pre.disagg.get("force_local"):
             # engine-level peek covers the host offload tier too (a
-            # host-restorable prefix must not look uncached here)
-            peek = getattr(
-                self.engine, "peek_prefix_tokens", None
-            ) or self.engine.allocator.peek_prefix_tokens
-            prefix_hit = peek(pre.token_ids)
+            # host-restorable prefix must not look uncached here); embed
+            # requests can only ever reuse the text prefix below the image
+            peek = getattr(self.engine, "peek_prefix_tokens", None)
+            if peek is not None:
+                cap = (
+                    pre.embeds_offset if pre.prompt_embeds is not None else None
+                )
+                prefix_hit = peek(pre.token_ids, max_tokens=cap)
+            else:
+                prefix_hit = self.engine.allocator.peek_prefix_tokens(
+                    pre.token_ids
+                )
             # length test first: only remote-eligible requests pay the hub
             # RTT for the queue-depth check
             if self.router.prefill_remote(len(pre.token_ids), prefix_hit, 0):

@@ -76,6 +76,12 @@ class PreprocessedRequest:
     mdc_sum: Optional[str] = None  # model-deployment-card checksum
     # disaggregation extras (set by the disagg router / prefill path)
     disagg: dict[str, Any] = field(default_factory=dict)
+    # multimodal: embeddings replacing token lookups for positions
+    # [embeds_offset, embeds_offset + len(prompt_embeds)) — the LLaVA-style
+    # image-patch injection (reference: examples/multimodal encode worker
+    # -> vLLM prompt-embeds path). Nested lists [T_img, D] on the wire.
+    prompt_embeds: Optional[list] = None
+    embeds_offset: int = 0
 
     def to_dict(self) -> dict:
         return {
@@ -86,6 +92,8 @@ class PreprocessedRequest:
             "annotations": self.annotations,
             "mdc_sum": self.mdc_sum,
             "disagg": self.disagg,
+            "prompt_embeds": self.prompt_embeds,
+            "embeds_offset": self.embeds_offset,
         }
 
     @classmethod
@@ -98,6 +106,8 @@ class PreprocessedRequest:
             annotations=list(d.get("annotations") or []),
             mdc_sum=d.get("mdc_sum"),
             disagg=dict(d.get("disagg") or {}),
+            prompt_embeds=d.get("prompt_embeds"),
+            embeds_offset=int(d.get("embeds_offset") or 0),
         )
 
 

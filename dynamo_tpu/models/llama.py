@@ -345,6 +345,8 @@ def forward(
     kv: KVCache,
     write_slots: jnp.ndarray,  # [B*T] int32 flat slots for the new tokens (0=trash for pads)
     attn,                      # AttnSpec, or a raw [B, C] slot matrix (gather mode)
+    embeds: jnp.ndarray | None = None,       # [B, T, D] multimodal injections
+    embeds_mask: jnp.ndarray | None = None,  # [B, T] bool: use embeds row
 ) -> tuple[jnp.ndarray, KVCache]:
     """One model step. Returns (hidden [B, T, D] after final norm, updated kv).
 
@@ -366,6 +368,10 @@ def forward(
         else:
             real_mask = write_slots.reshape(b_, t_) != 0
     x = params["embed"][tokens]
+    if embeds is not None:
+        # LLaVA-style injection: image-patch positions take precomputed
+        # embeddings instead of the placeholder tokens' lookups
+        x = jnp.where(embeds_mask[..., None], embeds.astype(x.dtype), x)
 
     inv_freq = jnp.asarray(rope_inv_freq(cfg))
     cos, sin = rope_cos_sin(inv_freq, positions)  # [B, T, Hd]

@@ -1,0 +1,92 @@
+"""Minimal ViT-style vision encoder: images -> LLM-space patch embeddings.
+
+The multimodal encode stage (reference: examples/multimodal/components/
+encode_worker.py — there CLIP inside vLLM; here a native jax encoder):
+patchify [H, W, 3] -> linear patch embedding + learned positions -> N
+pre-norm transformer blocks -> linear projection into the language
+model's hidden size. Random-init weights serve the example/test path;
+checkpoint loading would follow models/weights.py's pattern.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from dynamo_tpu.ops.norm import rms_norm
+
+
+@dataclass(frozen=True)
+class VisionConfig:
+    image_size: int = 64
+    patch_size: int = 16
+    hidden_size: int = 128
+    num_layers: int = 2
+    num_heads: int = 4
+    out_size: int = 2048  # language model hidden size
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def patch_dim(self) -> int:
+        return self.patch_size * self.patch_size * 3
+
+
+def init_vision_params(cfg: VisionConfig, key, dtype=jnp.float32) -> dict:
+    d = cfg.hidden_size
+    keys = iter(jax.random.split(key, 3 + 4 * cfg.num_layers))
+
+    def dense(k, shape):
+        return (
+            jax.random.normal(k, shape, jnp.float32) * shape[0] ** -0.5
+        ).astype(dtype)
+
+    return {
+        "patch_proj": dense(next(keys), (cfg.patch_dim, d)),
+        "pos_embed": dense(next(keys), (cfg.num_patches, d)),
+        "layers": [
+            {
+                "norm1": jnp.ones((d,), dtype),
+                "wqkv": dense(next(keys), (d, 3 * d)),
+                "wo": dense(next(keys), (d, d)),
+                "norm2": jnp.ones((d,), dtype),
+                "w_up": dense(next(keys), (d, 4 * d)),
+                "w_down": dense(next(keys), (4 * d, d)),
+            }
+            for _ in range(cfg.num_layers)
+        ],
+        "out_proj": dense(next(keys), (d, cfg.out_size)),
+    }
+
+
+def patchify(cfg: VisionConfig, images: jnp.ndarray) -> jnp.ndarray:
+    """[B, H, W, 3] -> [B, num_patches, patch_dim]."""
+    b = images.shape[0]
+    p, n = cfg.patch_size, cfg.image_size // cfg.patch_size
+    x = images.reshape(b, n, p, n, p, 3)
+    return x.transpose(0, 1, 3, 2, 4, 5).reshape(b, n * n, cfg.patch_dim)
+
+
+def encode(params: dict, cfg: VisionConfig, images: jnp.ndarray) -> jnp.ndarray:
+    """[B, H, W, 3] float in [0, 1] -> [B, num_patches, out_size]."""
+    x = patchify(cfg, images) @ params["patch_proj"] + params["pos_embed"]
+    h = cfg.num_heads
+    hd = cfg.hidden_size // h
+    for lp in params["layers"]:
+        b, t, d = x.shape
+        qkv = rms_norm(x, lp["norm1"], 1e-5) @ lp["wqkv"]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, t, h, hd)
+        k = k.reshape(b, t, h, hd)
+        v = v.reshape(b, t, h, hd)
+        s = jnp.einsum("bthd,bshd->bhts", q, k) * hd ** -0.5
+        p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(x.dtype)
+        attn = jnp.einsum("bhts,bshd->bthd", p, v).reshape(b, t, d)
+        x = x + attn @ lp["wo"]
+        y = rms_norm(x, lp["norm2"], 1e-5)
+        x = x + jax.nn.gelu(y @ lp["w_up"]) @ lp["w_down"]
+    return x @ params["out_proj"]

@@ -1,0 +1,13 @@
+"""Multimodal aggregated graph: LLM worker + independently scalable
+encode pool (reference: examples/multimodal/graphs/agg.py).
+
+    python -m dynamo_tpu.sdk serve examples/multimodal/graphs/agg.py:MMWorker \
+        -f examples/multimodal/configs/agg.yaml
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from mm_components import EncodeWorker, MMWorker  # noqa: F401

@@ -1,0 +1,110 @@
+"""Multimodal serving components (reference: examples/multimodal — an
+encode worker computes image embeddings, the LLM worker injects them as
+prompt embeddings and prefills/decodes as usual; stages scale
+independently).
+
+Flow (reference README's figure, hub edition):
+
+    HTTP -> MMWorker --image--> EncodeWorker
+                    <--[T_img, D] embeddings--
+            MMWorker: placeholder tokens + prompt_embeds -> JaxEngine
+"""
+
+from __future__ import annotations
+
+from dynamo_tpu.sdk import async_on_start, depends, endpoint, service
+
+NAMESPACE = "mm"
+PLACEHOLDER_TOKEN = 3  # expands to num_patches positions in the prompt
+
+
+@service(name="EncodeWorker", namespace=NAMESPACE)
+class EncodeWorker:
+    """Vision encode stage: image array -> LLM-space patch embeddings."""
+
+    def __init__(self):
+        cfg = self.dynamo_context["config"]
+        import jax
+
+        from dynamo_tpu.models.vision import VisionConfig, init_vision_params
+
+        self.vcfg = VisionConfig(
+            out_size=int(cfg.get("llm-hidden-size", 2048)),
+            image_size=int(cfg.get("image-size", 64)),
+        )
+        self.params = init_vision_params(
+            self.vcfg, jax.random.PRNGKey(int(cfg.get("seed", 0)))
+        )
+
+    @endpoint()
+    async def encode(self, request):
+        import jax.numpy as jnp
+        import numpy as np
+
+        from dynamo_tpu.models.vision import encode
+
+        image = np.asarray(request.payload["image"], np.float32)
+
+        async def stream():
+            emb = encode(self.params, self.vcfg, jnp.asarray(image[None]))
+            yield {"embeddings": np.asarray(emb[0]).tolist()}
+
+        return stream()
+
+
+@service(name="MMWorker", namespace=NAMESPACE)
+class MMWorker:
+    """LLM stage: fetches image embeddings from the encode pool, injects
+    them as prompt embeddings, serves through the native engine."""
+
+    encoder = depends(EncodeWorker, endpoint="encode")
+
+    def __init__(self):
+        self.cfg = self.dynamo_context["config"]
+
+    @async_on_start
+    async def start(self):
+        from dynamo_tpu.llm.local_model import LocalModel
+
+        lm = LocalModel.prepare(
+            self.cfg["model-path"], name=self.cfg.get("model-name")
+        )
+        kw = {}
+        for yaml_key, attr in (
+            ("page-size", "page_size"), ("max-batch-size", "max_batch_size"),
+            ("max-model-len", "max_model_len"),
+        ):
+            if self.cfg.get(yaml_key):
+                kw[attr] = int(self.cfg[yaml_key])
+        self.engine = lm.build_engine(**kw)
+        await self.encoder.wait_for_instances()
+
+    @endpoint()
+    async def generate(self, request):
+        """payload: PreprocessedRequest dict + optional 'image' [H, W, 3];
+        the image expands into placeholder positions at embeds_offset."""
+        from dynamo_tpu.llm.protocols.common import PreprocessedRequest
+
+        payload = dict(request.payload)
+        image = payload.pop("image", None)
+        pre = PreprocessedRequest.from_dict(payload)
+        if image is not None:
+            emb = None
+            async for frame in await self.encoder.generate({"image": image}):
+                emb = frame.get("embeddings")
+            if emb is None:
+                raise RuntimeError("encode worker returned no embeddings")
+            if emb and len(emb[0]) != self.engine.model_cfg.hidden_size:
+                raise RuntimeError(
+                    f"encoder llm-hidden-size {len(emb[0])} != model hidden "
+                    f"size {self.engine.model_cfg.hidden_size} — fix "
+                    "EncodeWorker.llm-hidden-size in the graph config"
+                )
+            n_patches = len(emb)
+            offset = len(pre.token_ids)
+            pre.token_ids = (
+                list(pre.token_ids) + [PLACEHOLDER_TOKEN] * n_patches
+            )
+            pre.prompt_embeds = emb
+            pre.embeds_offset = offset
+        return await self.engine.generate(request.map(pre.to_dict()))

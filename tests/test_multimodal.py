@@ -1,0 +1,188 @@
+"""Multimodal: prompt-embed injection in the engine (LLaVA-style,
+reference: examples/multimodal) + the vision encoder + the 2-process
+example graph."""
+
+from __future__ import annotations
+
+import asyncio
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dynamo_tpu.llm.protocols.common import (
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.models.vision import VisionConfig, encode, init_vision_params
+from dynamo_tpu.runtime.pipeline.context import Context
+
+from .test_engine import collect, make_engine
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _req(tokens, embeds=None, offset=0, max_tokens=6):
+    return PreprocessedRequest(
+        token_ids=list(tokens),
+        stop_conditions=StopConditions(max_tokens=max_tokens, ignore_eos=True),
+        sampling_options=SamplingOptions(greedy=True),
+        prompt_embeds=embeds,
+        embeds_offset=offset,
+    )
+
+
+async def test_embeds_equal_to_token_lookups_reproduce_plain_run():
+    """Oracle: passing prompt_embeds that ARE the embed-table rows of the
+    placeholder tokens must reproduce the plain token run bit-for-bit."""
+    engine = make_engine()
+    prompt = [5, 17, 42, 9, 88, 3, 14, 21]
+    ref_tokens, _, _ = await collect(engine, _req(prompt))
+
+    table = np.asarray(engine.params["embed"], np.float32)
+    span = prompt[3:6]
+    embeds = table[np.asarray(span)].tolist()
+    got_tokens, _, _ = await collect(engine, _req(prompt, embeds, offset=3))
+    assert got_tokens == ref_tokens
+    await engine.close()
+
+
+async def test_distinct_embeds_change_output_and_skip_prefix_cache():
+    engine = make_engine()
+    prompt = [5, 17, 42, 9, 88, 3, 14, 21]
+    rng = np.random.RandomState(0)
+    e1 = (rng.randn(3, 64) * 0.5).tolist()
+    e2 = (rng.randn(3, 64) * 0.5).tolist()
+    t1, _, _ = await collect(engine, _req(prompt, e1, offset=3))
+    hits_before = engine.allocator.hits
+    t2, _, _ = await collect(engine, _req(prompt, e2, offset=3))
+    # same placeholder tokens, different images: the prefix cache must NOT
+    # serve request 1's KV to request 2 (no_cache), and outputs may differ
+    assert engine.allocator.hits == hits_before
+    assert t1 != t2  # distinct random embeddings at 3 positions
+    await engine.close()
+
+
+async def test_embeds_span_multiple_chunks():
+    """An embed span crossing prefill-chunk boundaries is split correctly
+    across group dispatches."""
+    engine = make_engine(prefill_chunk=16, max_model_len=128)
+    prompt = list(range(2, 2 + 40))
+    table = np.asarray(engine.params["embed"], np.float32)
+    span = prompt[10:30]  # crosses the chunk boundary at 16
+    embeds = table[np.asarray(span)].tolist()
+    ref, _, _ = await collect(engine, _req(prompt))
+    got, _, _ = await collect(engine, _req(prompt, embeds, offset=10))
+    assert got == ref
+    await engine.close()
+
+
+def test_vision_encoder_shapes_and_determinism():
+    cfg = VisionConfig(image_size=32, patch_size=16, out_size=64)
+    params = init_vision_params(cfg, jax.random.PRNGKey(0))
+    img = jax.random.uniform(jax.random.PRNGKey(1), (2, 32, 32, 3))
+    out = encode(params, cfg, img)
+    assert out.shape == (2, cfg.num_patches, 64)
+    out2 = encode(params, cfg, img)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(out2))
+    # different images -> different embeddings
+    img2 = jax.random.uniform(jax.random.PRNGKey(2), (2, 32, 32, 3))
+    assert not np.allclose(np.asarray(out), np.asarray(encode(params, cfg, img2)))
+
+
+async def test_multimodal_example_graph_e2e():
+    """The example graph serves: encode worker pool + MMWorker processes,
+    an image request round-trips through both stages."""
+    from dynamo_tpu.runtime.component import EndpointId
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+    from dynamo_tpu.sdk import ServiceConfig
+    from dynamo_tpu.sdk.supervisor import Supervisor, load_entry
+
+    from .fixtures import tiny_model_dir
+
+    entry_path = os.path.join(
+        ROOT, "examples", "multimodal", "graphs", "agg.py"
+    ) + ":MMWorker"
+    cfg = ServiceConfig(
+        {
+            "MMWorker": {
+                "model-path": tiny_model_dir(),
+                "model-name": "tiny-mm",
+                "page-size": 8,
+                "max-batch-size": 2,
+                "max-model-len": 128,
+            },
+            "EncodeWorker": {"llm-hidden-size": 64, "image-size": 32},
+        }
+    )
+    entry = load_entry(entry_path)
+    sup = Supervisor.for_graph(entry_path, entry, config=cfg)
+    for w in sup.watchers.values():
+        w.env["JAX_PLATFORMS"] = "cpu"
+    await sup.start()
+    try:
+        drt = await DistributedRuntime.from_settings(hub_addr=sup.hub_addr)
+        try:
+            eid = EndpointId.parse("dyn://mm.MMWorker.generate")
+            ep = (
+                drt.namespace(eid.namespace)
+                .component(eid.component)
+                .endpoint(eid.name)
+            )
+            client = await ep.client()
+            await client.wait_for_instances(timeout=60)
+            rng = np.random.RandomState(0)
+            payload = _req([5, 17, 42], max_tokens=4).to_dict()
+            payload["image"] = rng.rand(32, 32, 3).tolist()
+            toks = []
+            deadline = asyncio.get_event_loop().time() + 90
+            while not toks:
+                try:
+                    async for frame in await client.generate(payload):
+                        toks.extend(frame.get("token_ids") or [])
+                except Exception:
+                    if asyncio.get_event_loop().time() > deadline:
+                        raise
+                    await asyncio.sleep(1)
+            assert len(toks) == 4
+        finally:
+            await drt.shutdown()
+    finally:
+        await sup.stop()
+
+
+async def test_text_prefix_before_image_is_cached():
+    """Pages entirely below embeds_offset carry sound hashes and must be
+    shared across image requests (review fix: no blanket no_cache)."""
+    engine = make_engine(max_model_len=128, prefill_chunk=32)
+    shared_text = list(range(2, 2 + 24))  # 3 full pages at page_size=8
+    rng = np.random.RandomState(1)
+    prompt = shared_text + [3, 3, 3]
+    e1 = (rng.randn(3, 64) * 0.5).tolist()
+    e2 = (rng.randn(3, 64) * 0.5).tolist()
+    _, _, frames1 = await collect(engine, _req(prompt, e1, offset=24))
+    meta1 = frames1[0].get("meta") or {}
+    assert meta1.get("prefix_cached_tokens") == 0
+    _, _, frames2 = await collect(engine, _req(prompt, e2, offset=24))
+    meta2 = frames2[0].get("meta") or {}
+    # the 24-token text prefix (3 pages) is reused; the image span is not
+    assert meta2.get("prefix_cached_tokens") == 24
+    await engine.close()
+
+
+async def test_bad_embed_spans_rejected():
+    engine = make_engine()
+    for req in (
+        _req([5, 6, 7], [[0.0] * 64] * 4, offset=0),    # span overhangs
+        _req([5, 6, 7], [[0.0] * 64], offset=3),        # offset at end
+        _req([5, 6, 7], [[0.0] * 32], offset=0),        # wrong width
+        _req([5, 6, 7], [], offset=0),                  # empty
+    ):
+        try:
+            await engine.generate(Context(req.to_dict()))
+            raise AssertionError(f"expected ValueError for {req.prompt_embeds}")
+        except ValueError:
+            pass
+    await engine.close()
