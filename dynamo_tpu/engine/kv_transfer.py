@@ -1,0 +1,79 @@
+"""Device-path KV transfer between engines: the NIXL-RDMA equivalent.
+
+The reference moves KV blocks between prefill and decode workers with
+one-sided RDMA (reference: vLLM patch nixl.py, patch:1067 — agent
+registration, base addresses, remote block reads) plus layout rearrange
+for TP mismatches (patch:935). TPU-native, the same job is three steps
+that never touch the host:
+
+  1. jitted page gather on the source engine's mesh;
+  2. `jax.device_put` onto the destination pool's sharding — XLA moves
+     the buffers device-to-device (ICI within a slice, DCN across), and
+     a TP-degree mismatch is just a different NamedSharding: the
+     resharding collective IS the kv_rearrange;
+  3. jitted page scatter into the destination pool (donated, in place).
+
+This is the colocated/shared-backend fast path (both engines visible to
+one process — separate pools for prefill/decode SLO isolation, or
+different tp degrees on one slice). Engines in different OS processes
+fall back to the host-staged msgpack plane in `llm/disagg` — single-
+controller JAX cannot address another process's devices; a cross-process
+device path is a multi-controller (SPMD) deployment property, not a
+transfer-API property.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _expand_slots(page_ids, page_size: int, n_tokens: int) -> np.ndarray:
+    slots = (
+        np.asarray(page_ids, np.int32)[:, None] * page_size
+        + np.arange(page_size, dtype=np.int32)
+    ).reshape(-1)
+    return slots[:n_tokens]
+
+
+def device_transfer_kv(
+    src_engine,
+    dst_engine,
+    src_page_ids: list[int],
+    dst_page_ids: list[int],
+    n_tokens: int,
+) -> None:
+    """Move `n_tokens` positions of KV from src pages to dst pages with
+    no host staging. Engines may differ in mesh/tp (pools resharded in
+    step 2); page sizes must match (repack via llm.kv_rearrange first)."""
+    if src_engine.page_size != dst_engine.page_size:
+        raise ValueError(
+            f"page-size mismatch {src_engine.page_size} != "
+            f"{dst_engine.page_size}: repack_pages first"
+        )
+    src_slots = jnp.asarray(
+        _expand_slots(src_page_ids, src_engine.page_size, n_tokens)
+    )
+    dst_slots = jnp.asarray(
+        _expand_slots(dst_page_ids, dst_engine.page_size, n_tokens)
+    )
+
+    # 1. gather on the source mesh: [L, n, kw] stacked rows
+    with src_engine._kv_lock:
+        k_rows, v_rows = src_engine._extract_fn(src_engine.kv, src_slots)
+
+    # 2. reshard onto the destination pool's layout (device-to-device;
+    # the tp-mismatch rearrange happens here as an XLA collective)
+    dst_sh = dst_engine._kv_sharding
+    row_sharding = jax.sharding.NamedSharding(
+        dst_sh.mesh, jax.sharding.PartitionSpec(None, None, "tp")
+    )
+    k_rows = jax.device_put(k_rows, row_sharding)
+    v_rows = jax.device_put(v_rows, row_sharding)
+
+    # 3. scatter into the destination pool, in place
+    with dst_engine._kv_lock:
+        dst_engine.kv = dst_engine._inject_fn(
+            dst_engine.kv, dst_slots, k_rows, v_rows
+        )

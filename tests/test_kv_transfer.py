@@ -1,0 +1,147 @@
+"""Device-path KV transfer between engines (the NIXL equivalent): pool to
+pool with no host staging, including a tp-degree mismatch where the
+resharding collective performs the kv_rearrange."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from dynamo_tpu.engine.kv_transfer import device_transfer_kv
+from dynamo_tpu.parallel.mesh import MeshConfig
+
+from .test_engine import collect, greedy_request, make_engine
+
+
+async def _prefill_on(engine, prompt):
+    """Run a 1-token generation so the engine computes the prompt's KV,
+    then return the sequence's pages before they are recycled."""
+    pages = {}
+    orig = engine._finish
+
+    def capture(seq, reason):
+        pages["ids"] = list(seq.page_ids)
+        pages["computed"] = seq.num_computed
+        orig(seq, reason)
+
+    engine._finish = capture
+    toks, _, _ = await collect(engine, greedy_request(prompt, max_tokens=1))
+    engine._finish = orig
+    return toks[0], pages["ids"], pages["computed"]
+
+
+async def _decode_with_preloaded_kv(engine, prompt, first_token, page_ids, n_kv):
+    """Continue greedy decode on `engine` whose pool already holds the
+    prompt KV at `page_ids` (device-transferred): drive the paged decode
+    directly via the disagg inject path with a zero-copy marker."""
+    from dynamo_tpu.llm.protocols.common import PreprocessedRequest
+
+    # reuse the engine's preloaded-sequence machinery with empty host
+    # arrays but pre-positioned pages: simplest equivalent is to seed the
+    # sequence manually and let the normal loop decode
+    import asyncio
+
+    from dynamo_tpu.engine.scheduler import Sequence
+    from dynamo_tpu.runtime.pipeline.context import Context
+
+    pre = greedy_request(prompt, max_tokens=5)
+    ctx = Context(pre.to_dict())
+    seq = Sequence.from_request(
+        ctx, PreprocessedRequest.from_dict(pre.to_dict()),
+        engine.page_size, engine.config.max_model_len,
+    )
+    slot = engine._free_slot()
+    seq.slot = slot
+    seq.page_ids = list(page_ids)
+    seq.num_cached = 0
+    seq.num_computed = n_kv
+    seq.registered_pages = len(page_ids)  # don't re-register foreign pages
+    seq.prefilling = False
+    seq.device_pos = n_kv
+    engine.slots[slot] = seq
+    engine._overrides[slot] = int(first_token)
+    seq.carry_pending = True
+    # mark pages as held so the allocator bookkeeping stays sane
+    for pid in page_ids:
+        engine.allocator._meta.setdefault(
+            pid, type(next(iter(engine.allocator._meta.values())))()
+        ).refs += 1
+    engine._ensure_loop()
+    engine._wake.set()
+    toks = []
+    async for frame in _frames(seq):
+        toks.extend(frame.get("token_ids") or [])
+    return toks
+
+
+async def _frames(seq):
+    while True:
+        frame = await seq.out_queue.get()
+        yield frame
+        if frame.get("finish_reason"):
+            return
+
+
+async def test_device_transfer_same_sharding_reproduces_tokens():
+    """prefill on engine A -> device transfer -> decode on engine B must
+    produce the same continuation as a single engine run."""
+    prompt = [5, 17, 42, 9, 88, 3, 14, 21]
+    ref_engine = make_engine()
+    ref_tokens, _, _ = await collect(
+        ref_engine, greedy_request(prompt, max_tokens=6)
+    )
+    await ref_engine.close()
+
+    src = make_engine()
+    dst = make_engine()  # same params (seed 0): same model weights
+    first, src_pages, n_kv = await _prefill_on(src, prompt)
+    assert first == ref_tokens[0]
+
+    need = -(-(n_kv + 8) // dst.page_size)
+    dst_pages = dst.allocator.allocate(need)
+    device_transfer_kv(src, dst, src_pages[:need], dst_pages, n_kv)
+    got = await _decode_with_preloaded_kv(dst, prompt, first, dst_pages, n_kv)
+    assert len(got) > 1
+    assert got == ref_tokens[: len(got)]
+    await src.close()
+    await dst.close()
+
+
+async def test_device_transfer_tp_mismatch():
+    """tp=1 source pool -> tp=2 destination pool: the device_put reshard
+    IS the kv_rearrange; KV content must be identical."""
+    import jax
+
+    prompt = [5, 17, 42, 9, 88, 3, 14, 21]
+    src = make_engine()
+    dst = make_engine(mesh=MeshConfig(tp=2))
+    first, src_pages, n_kv = await _prefill_on(src, prompt)
+
+    need = len(src_pages)
+    dst_pages = dst.allocator.allocate(need)
+    device_transfer_kv(src, dst, src_pages, dst_pages, n_kv)
+
+    # compare the raw KV rows (weights are identical across engines)
+    src_slots = (
+        np.asarray(src_pages)[:, None] * src.page_size
+        + np.arange(src.page_size)
+    ).reshape(-1)[:n_kv]
+    dst_slots = (
+        np.asarray(dst_pages)[:, None] * dst.page_size
+        + np.arange(dst.page_size)
+    ).reshape(-1)[:n_kv]
+    for layer in (0, len(dst.kv.k) - 1):
+        a = np.asarray(src.kv.k[layer][src_slots])
+        b = np.asarray(dst.kv.k[layer][dst_slots])
+        np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-6)
+    await src.close()
+    await dst.close()
+
+
+def test_page_size_mismatch_rejected():
+    src = make_engine()
+    dst = make_engine(page_size=16, max_model_len=128)
+    try:
+        device_transfer_kv(src, dst, [1], [1], 8)
+        raise AssertionError("expected ValueError")
+    except ValueError as e:
+        assert "page-size mismatch" in str(e)
