@@ -170,9 +170,16 @@ def _attn_block(
     write_slots: jnp.ndarray,   # [B*T] int32
     attn: "AttnSpec",
     positions: jnp.ndarray,     # [B, T]
+    tp_axis=None,  # set when running INSIDE a shard_map (manual tp):
+    # row-parallel projections then need an explicit psum
 ):
     b, t, _ = x.shape
     h, kh, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+    if tp_axis is not None:
+        # manual tp: this shard holds its local slice of the heads
+        tpn = jax.lax.axis_size(tp_axis)
+        h //= tpn
+        kh //= tpn
 
     q = x @ lp["wq"]
     k = x @ lp["wk"]
@@ -328,13 +335,19 @@ def _attn_block(
             )[:, None]
         else:
             out = paged_attention(q, kv_k, kv_v, attn.slot_matrix, positions)
-    return out.reshape(b, t, h * hd) @ lp["wo"], kv_k, kv_v
+    proj = out.reshape(b, t, h * hd) @ lp["wo"]
+    if tp_axis is not None:
+        proj = jax.lax.psum(proj, tp_axis)
+    return proj, kv_k, kv_v
 
 
-def _mlp_block(lp: Params, x: jnp.ndarray) -> jnp.ndarray:
+def _mlp_block(lp: Params, x: jnp.ndarray, tp_axis=None) -> jnp.ndarray:
     gate = jax.nn.silu(x @ lp["w_gate"])
     up = x @ lp["w_up"]
-    return (gate * up) @ lp["w_down"]
+    out = (gate * up) @ lp["w_down"]
+    if tp_axis is not None:
+        out = jax.lax.psum(out, tp_axis)
+    return out
 
 
 def forward(
@@ -379,25 +392,39 @@ def forward(
     new_k_layers = []
     new_v_layers = []
     for l, lp in enumerate(params["layers"]):
-        attn_in = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
-        attn_out, layer_k, layer_v = _attn_block(
-            lp, cfg, attn_in, cos, sin, kv.k[l], kv.v[l],
-            write_slots, attn, positions,
+        x, layer_k, layer_v = layer_step(
+            lp, cfg, x, cos, sin, kv.k[l], kv.v[l],
+            write_slots, attn, positions, real_mask=real_mask,
         )
-        x = x + attn_out
-        mlp_in = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
-        if cfg.num_experts:
-            from dynamo_tpu.models.moe import moe_block
-
-            x = x + moe_block(lp, cfg, mlp_in, real_mask=real_mask)
-        else:
-            x = x + _mlp_block(lp, mlp_in)
         new_k_layers.append(layer_k)
         new_v_layers.append(layer_v)
 
     kv = KVCache(k=tuple(new_k_layers), v=tuple(new_v_layers))
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     return x, kv
+
+
+def layer_step(lp, cfg, x, cos, sin, kv_k, kv_v, write_slots, attn,
+               positions, real_mask=None, tp_axis=None):
+    """One transformer layer (attention + FFN, pre-norm residuals) over
+    the paged pools — shared by `forward` and the pipeline-parallel
+    stage executor (parallel/pipeline.py). `tp_axis` enables manual-tp
+    semantics for use inside a shard_map (explicit psums after the
+    row-parallel projections)."""
+    attn_in = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+    attn_out, kv_k, kv_v = _attn_block(
+        lp, cfg, attn_in, cos, sin, kv_k, kv_v, write_slots, attn, positions,
+        tp_axis=tp_axis,
+    )
+    x = x + attn_out
+    mlp_in = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
+    if cfg.num_experts:
+        from dynamo_tpu.models.moe import moe_block
+
+        x = x + moe_block(lp, cfg, mlp_in, real_mask=real_mask)
+    else:
+        x = x + _mlp_block(lp, mlp_in, tp_axis=tp_axis)
+    return x, kv_k, kv_v
 
 
 def logits(params: Params, cfg: ModelConfig, hidden: jnp.ndarray) -> jnp.ndarray:
