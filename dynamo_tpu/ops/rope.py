@@ -49,11 +49,19 @@ def rope_cos_sin(inv_freq: jnp.ndarray, positions: jnp.ndarray):
 
 def apply_rope(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray) -> jnp.ndarray:
     """Rotate `x` [..., H, head_dim] by per-position cos/sin [..., head_dim]
-    (broadcast over the head axis)."""
+    (broadcast over the head axis).
+
+    Formulated as one trailing concat of the two rotated halves (rather
+    than building the full-width `rotate_half` tensor first) so XLA fuses
+    the whole rotation into a single pass over x — the full-width
+    intermediate materialized f32 copies of every q/k tensor."""
     orig_dtype = x.dtype
-    xf = x.astype(jnp.float32)
     half = x.shape[-1] // 2
-    x1, x2 = xf[..., :half], xf[..., half:]
-    rotated = jnp.concatenate([-x2, x1], axis=-1)
-    out = xf * cos[..., None, :] + rotated * sin[..., None, :]
+    x1 = x[..., :half].astype(jnp.float32)
+    x2 = x[..., half:].astype(jnp.float32)
+    c1 = cos[..., None, :half]
+    c2 = cos[..., None, half:]
+    s1 = sin[..., None, :half]
+    s2 = sin[..., None, half:]
+    out = jnp.concatenate([x1 * c1 - x2 * s1, x2 * c2 + x1 * s2], axis=-1)
     return out.astype(orig_dtype)
