@@ -122,6 +122,14 @@ def main() -> None:
                     "chips": n_chips,
                     "params": n_params,
                     "parity_target_toks_per_chip": round(target, 1),
+                    # the wall includes prefilling ISL tokens per request;
+                    # total token throughput shows the full device output
+                    "prefill_toks_per_sec_chip": round(
+                        CONCURRENCY * ISL / wall / n_chips, 1
+                    ),
+                    "total_toks_per_sec_chip": round(
+                        (CONCURRENCY * ISL + total_tokens) / wall / n_chips, 1
+                    ),
                 },
             }
         )
