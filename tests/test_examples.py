@@ -84,3 +84,62 @@ async def test_agg_graph_serves_openai():
             assert body["model"] == "tiny-example"
     finally:
         await sup.stop()
+
+
+async def test_disagg_graph_serves_with_remote_prefill():
+    """The disagg example graph: Frontend + decode Worker + PrefillWorker
+    processes; a long prompt (over max-local-prefill-length) round-trips,
+    exercising queue push -> remote prefill -> KV ingest -> decode."""
+    port = _free_port()
+    cfg = ServiceConfig(
+        {
+            "Frontend": {"port": port},
+            "Worker": {
+                "model-path": tiny_model_dir(),
+                "model-name": "tiny-disagg",
+                "page-size": 8,
+                "max-batch-size": 2,
+                "max-model-len": 128,
+                "disagg": "decode",
+                "max-local-prefill-length": 8,
+            },
+            "PrefillWorker": {
+                "model-path": tiny_model_dir(),
+                "model-name": "tiny-disagg",
+                "page-size": 8,
+                "max-batch-size": 2,
+                "max-model-len": 128,
+            },
+        }
+    )
+    entry = load_entry(DISAGG)
+    sup = Supervisor.for_graph(DISAGG, entry, config=cfg)
+    for w in sup.watchers.values():
+        w.env["JAX_PLATFORMS"] = "cpu"
+    await sup.start()
+    try:
+        async with aiohttp.ClientSession() as session:
+            body = None
+            # a prompt comfortably over the 8-token local-prefill bound
+            content = "the quick brown fox jumps over the lazy dog again and again"
+            for _ in range(120):
+                try:
+                    r = await session.post(
+                        f"http://127.0.0.1:{port}/v1/chat/completions",
+                        json={
+                            "model": "tiny-disagg",
+                            "messages": [{"role": "user", "content": content}],
+                            "max_tokens": 4,
+                        },
+                        timeout=aiohttp.ClientTimeout(total=10),
+                    )
+                    if r.status == 200:
+                        body = await r.json()
+                        break
+                except (aiohttp.ClientError, asyncio.TimeoutError):
+                    pass
+                await asyncio.sleep(1)
+            assert body is not None, "disagg graph never became ready"
+            assert body["choices"][0]["message"]["content"]
+    finally:
+        await sup.stop()
