@@ -152,6 +152,24 @@ class JaxEngine:
                 "pallas attention backend"
             )
 
+        # pipeline-parallel serving: pp > 1 runs the GPipe stage executor
+        # (parallel/pipeline.py) — layers AND KV pools live stage-local;
+        # gather attention (the pallas kernels are not pp-aware), no
+        # disagg extract/inject or host offload in pp mode (v1)
+        self._pp = mc.pp > 1
+        if self._pp:
+            if self._attn_pallas:
+                raise ValueError("attn_backend='pallas' unsupported with pp>1")
+            if config.host_kv_pages:
+                raise ValueError("host KV offload unsupported with pp>1")
+            if self.model_cfg.num_experts:
+                raise ValueError("MoE unsupported with pp>1 (pipeline v1)")
+            if self.model_cfg.num_layers % mc.pp:
+                raise ValueError(
+                    f"num_layers={self.model_cfg.num_layers} not divisible "
+                    f"by pp={mc.pp}"
+                )
+
         if params is None:
             if config.checkpoint_dir:
                 from dynamo_tpu.models.weights import load_params
@@ -159,22 +177,34 @@ class JaxEngine:
                 params = load_params(
                     config.checkpoint_dir, self.model_cfg, dtype=self._dtype
                 )
-                params = meshmod.shard_params(params, self.model_cfg, self.mesh)
             else:
                 params = llama.init_params(
                     self.model_cfg, jax.random.PRNGKey(config.seed), dtype=self._dtype
                 )
+            if not self._pp:
                 params = meshmod.shard_params(params, self.model_cfg, self.mesh)
-        self.params = params
 
         self.num_pages = config.num_pages or self._auto_num_pages()
         self.page_size = config.page_size
         num_slots = self.num_pages * self.page_size
         kv = llama.init_kv_cache(self.model_cfg, num_slots, dtype=self._dtype)
-        self.kv = llama.KVCache(
-            k=tuple(jax.device_put(x, self._kv_sharding) for x in kv.k),
-            v=tuple(jax.device_put(x, self._kv_sharding) for x in kv.v),
-        )
+        if self._pp:
+            from dynamo_tpu.parallel.pipeline import (
+                pp_sharded_put,
+                stack_layer_params,
+            )
+
+            k_st, v_st = kv.stacked()
+            params, k_st, v_st = pp_sharded_put(
+                self.mesh, stack_layer_params(params), k_st, v_st
+            )
+            self.kv = (k_st, v_st)  # stacked [L, N, KW] pair in pp mode
+        else:
+            self.kv = llama.KVCache(
+                k=tuple(jax.device_put(x, self._kv_sharding) for x in kv.k),
+                v=tuple(jax.device_put(x, self._kv_sharding) for x in kv.v),
+            )
+        self.params = params
 
         self._event_seq = 0
         self._event_subscribers: list[Callable[[dict], None]] = []
@@ -301,10 +331,36 @@ class JaxEngine:
     # ------------------------------------------------------------------
     # compiled steps
 
+    def _pp_forward(self, params, kv, tokens, positions, write_slots,
+                    slot_matrix):
+        """pp>1 forward: GPipe stage executor over stacked stage-local
+        params/pools (parallel/pipeline.py). Microbatching m=1 — serving
+        correctness first; the fill/drain bubble is the price of a model
+        that doesn't fit one stage's HBM."""
+        from dynamo_tpu.parallel.pipeline import pp_forward
+
+        k_st, v_st = kv
+        b, t = tokens.shape
+        hidden, (k_st, v_st) = pp_forward(
+            params, self.model_cfg, tokens, positions, k_st, v_st,
+            write_slots.reshape(b, t), slot_matrix, self.mesh, 1,
+        )
+        return hidden, (k_st, v_st)
+
     def _model_step(self, params, kv, tokens, positions, write_slots, slot_matrix,
                     last_idx, temp, topk, topp, key, wtables=None,
                     btables=None, embeds=None, embeds_mask=None,
                     all_greedy=False):
+        if self._pp:
+            hidden, kv = self._pp_forward(
+                params, kv, tokens, positions, write_slots, slot_matrix
+            )
+            last_h = jnp.take_along_axis(
+                hidden, last_idx[:, None, None].astype(jnp.int32), axis=1
+            )[:, 0]
+            lg = llama.logits(params, self.model_cfg, last_h)
+            toks = sample_tokens(lg, key, temp, topk, topp, all_greedy=all_greedy)
+            return toks, kv
         if wtables is not None:
             # pallas prefill: page-scatter write + flash attention over
             # the streamed pages (the XLA row scatter serializes; the
@@ -377,10 +433,16 @@ class JaxEngine:
                     active & (positions < max_len), wslots, 0
                 ).astype(jnp.int32)
                 attn = llama.AttnSpec.gather(smat)
-            hidden, kv = llama.forward(
-                params, self.model_cfg, tokens[:, None], positions[:, None],
-                kv, wslots, attn,
-            )
+            if self._pp:
+                hidden, kv = self._pp_forward(
+                    params, kv, tokens[:, None], positions[:, None],
+                    wslots, smat,
+                )
+            else:
+                hidden, kv = llama.forward(
+                    params, self.model_cfg, tokens[:, None], positions[:, None],
+                    kv, wslots, attn,
+                )
             lg = llama.logits(params, self.model_cfg, hidden[:, 0])
             toks = sample_tokens(lg, sub, temp, topk, topp, all_greedy=all_greedy)
             return (toks, positions + 1, kv, key), toks
@@ -421,7 +483,11 @@ class JaxEngine:
             )
         if len(pre.token_ids) == 0:
             raise ValueError("empty prompt")
+        if self._pp and _preloaded is not None:
+            raise ValueError("disagg KV ingest unsupported with pp>1 (v1)")
         if pre.prompt_embeds is not None:
+            if self._pp:
+                raise ValueError("prompt_embeds unsupported with pp>1 (v1)")
             # fail fast: a silently dropped/misaligned embed span would
             # produce plausible but image-blind output
             n_emb = len(pre.prompt_embeds)
@@ -489,6 +555,8 @@ class JaxEngine:
         token), extract it host-side, and keep the pages in the prefix
         cache for future hits. Returns (first_token, k, v) with k/v shaped
         [L, T, Kh*Hd]."""
+        if self._pp:
+            raise ValueError("disagg prefill_only unsupported with pp>1 (v1)")
         ctx = ctx or Context(pre.to_dict())
         usable_tokens = (self.num_pages - 1) * self.page_size
         if len(pre.token_ids) + 1 > usable_tokens:
