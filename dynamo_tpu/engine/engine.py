@@ -152,11 +152,29 @@ class JaxEngine:
                 "pallas attention backend"
             )
 
+        # sequence-parallel serving: sp > 1 prefills whole prompts with
+        # RING attention over the sp axis (ops/ring_attention.py) — the
+        # long-context mode. Ring attention is whole-prompt self-
+        # attention, so prompts must prefill in ONE chunk and the prefix
+        # cache is off (a cached-prefix continuation can't ring)
+        self._sp = mc.sp > 1
+        if self._sp:
+            if config.prefill_chunk < config.max_model_len:
+                raise ValueError(
+                    f"sp>1 (ring attention) needs prefill_chunk "
+                    f"({config.prefill_chunk}) >= max_model_len "
+                    f"({config.max_model_len}): prompts prefill whole"
+                )
+            if config.host_kv_pages:
+                raise ValueError("host KV offload unsupported with sp>1")
+
         # pipeline-parallel serving: pp > 1 runs the GPipe stage executor
         # (parallel/pipeline.py) — layers AND KV pools live stage-local;
         # gather attention (the pallas kernels are not pp-aware), no
         # disagg extract/inject or host offload in pp mode (v1)
         self._pp = mc.pp > 1
+        if self._pp and self._sp:
+            raise ValueError("pp>1 with sp>1 unsupported (v1)")
         if self._pp:
             if self._attn_pallas:
                 raise ValueError("attn_backend='pallas' unsupported with pp>1")
@@ -371,6 +389,11 @@ class JaxEngine:
                 block_tables=btables, q_pos0=positions[:, 0],
                 lengths=last_idx + 1,
             )
+        elif self._sp:
+            # long-context mode: whole-prompt ring attention over sp
+            attn = llama.AttnSpec.ring(
+                slot_matrix, self.mesh, page_size=self.page_size
+            )
         else:
             attn = llama.AttnSpec.gather(slot_matrix)
         hidden, kv = llama.forward(
@@ -483,8 +506,8 @@ class JaxEngine:
             )
         if len(pre.token_ids) == 0:
             raise ValueError("empty prompt")
-        if self._pp and _preloaded is not None:
-            raise ValueError("disagg KV ingest unsupported with pp>1 (v1)")
+        if (self._pp or self._sp) and _preloaded is not None:
+            raise ValueError("disagg KV ingest unsupported with pp/sp>1 (v1)")
         if pre.prompt_embeds is not None:
             if self._pp:
                 raise ValueError("prompt_embeds unsupported with pp>1 (v1)")
@@ -712,9 +735,9 @@ class JaxEngine:
         """Prefix-match (HBM, then host tier) and allocate pages covering
         all current tokens; host-tier hits are restored by H2D scatter."""
         t = seq.total_tokens
-        hashes = seq.blocks.sequence_hashes()
+        hashes = [] if self._sp else seq.blocks.sequence_hashes()
         cap = seq.cacheable_pages(self.page_size)
-        if cap is not None:
+        if cap is not None and hashes:
             # embed sequences: only the text prefix below embeds_offset
             # has sound hashes (placeholder ids don't cover the image)
             hashes = hashes[:cap]
@@ -1195,6 +1218,8 @@ class JaxEngine:
     # ---- bookkeeping --------------------------------------------------
 
     def _register_full_pages(self, seq: Sequence) -> None:
+        if self._sp:
+            return  # ring prefill can't continue from a cached prefix
         full = seq.num_computed // self.page_size
         cap = seq.cacheable_pages(self.page_size)
         if cap is not None:

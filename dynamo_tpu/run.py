@@ -49,6 +49,8 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["random", "round_robin", "kv"])
     p.add_argument("--tensor-parallel-size", "--tp", type=int, default=1, dest="tp")
     p.add_argument("--pipeline-parallel-size", "--pp", type=int, default=1, dest="pp")
+    p.add_argument("--sequence-parallel-size", "--sp", type=int, default=1, dest="sp",
+                   help="ring-attention long-context prefill (needs prefill-chunk >= max-model-len)")
     p.add_argument("--max-batch-size", type=int, default=8)
     p.add_argument("--max-model-len", type=int, default=2048)
     p.add_argument("--page-size", type=int, default=16)
@@ -94,7 +96,7 @@ def build_engine_config_kwargs(args) -> dict:
     from dynamo_tpu.parallel.mesh import MeshConfig
 
     kw = dict(
-        mesh=MeshConfig(tp=args.tp, pp=args.pp),
+        mesh=MeshConfig(tp=args.tp, pp=args.pp, sp=args.sp),
         dtype=args.dtype,
         page_size=args.page_size,
         num_pages=args.num_pages,

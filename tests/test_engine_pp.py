@@ -56,3 +56,47 @@ def test_pp_mode_rejects_unsupported_combos():
         make_engine(
             model=CFG4.with_(num_layers=3), mesh=MeshConfig(pp=2)
         )
+
+
+async def test_sp2_engine_ring_prefill_matches_single_device():
+    """sp=2 engine (ring-attention whole-prompt prefill) must reproduce
+    the single-device engine's greedy output exactly."""
+    prompt = [5, 17, 42, 9, 88, 3, 14, 21, 21, 4, 19, 77, 8, 2, 30, 6]
+    ref_engine = make_engine(model=CFG4, prefill_chunk=128)
+    ref, _, _ = await collect(ref_engine, greedy_request(prompt, max_tokens=6))
+    await ref_engine.close()
+
+    engine = make_engine(
+        model=CFG4, mesh=MeshConfig(sp=2), prefill_chunk=128
+    )
+    tokens, finish, _ = await collect(
+        engine, greedy_request(prompt, max_tokens=6)
+    )
+    assert finish == "length" and tokens == ref
+    await engine.close()
+
+
+async def test_sp2_tp2_engine_concurrent():
+    import asyncio
+
+    prompt_a = list(range(2, 2 + 20))
+    prompt_b = [9, 8, 7, 6, 5]
+    ref_engine = make_engine(model=CFG4, prefill_chunk=128)
+    ref_a, _, _ = await collect(ref_engine, greedy_request(prompt_a, max_tokens=4))
+    ref_b, _, _ = await collect(ref_engine, greedy_request(prompt_b, max_tokens=4))
+    await ref_engine.close()
+
+    engine = make_engine(
+        model=CFG4, mesh=MeshConfig(sp=2, tp=2), prefill_chunk=128
+    )
+    (a, _, _), (b, _, _) = await asyncio.gather(
+        collect(engine, greedy_request(prompt_a, max_tokens=4)),
+        collect(engine, greedy_request(prompt_b, max_tokens=4)),
+    )
+    assert a == ref_a and b == ref_b
+    await engine.close()
+
+
+def test_sp_mode_requires_whole_prompt_prefill():
+    with pytest.raises(ValueError, match="prefill_chunk"):
+        make_engine(model=CFG4, mesh=MeshConfig(sp=2), prefill_chunk=32)
