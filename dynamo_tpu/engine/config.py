@@ -27,6 +27,12 @@ class EngineConfig:
     # "pallas": force the kernel (interpret mode off-TPU); "gather": oracle
     attn_backend: str = "auto"
 
+    # None = bf16 weights; "int8" = W8A8 dynamic quantization of the dense
+    # projections + vocab head (ops/quant.py) — the TPU-native match for
+    # the reference baselines' FP8 serving (docs/architecture.md:76-83).
+    # Attention, KV cache, norms, embeddings stay bf16.
+    quantization: Optional[str] = None
+
     # HBM->host KV offload tier (reference: lib/llm/src/kv reuse/manager):
     # 0 disables; else pages whose refcount hits 0 are write-through
     # copied to a host-RAM pool of this many pages, restored on prefix

@@ -199,8 +199,32 @@ class JaxEngine:
                 params = llama.init_params(
                     self.model_cfg, jax.random.PRNGKey(config.seed), dtype=self._dtype
                 )
+            # logical model size, before quantization adds scale vectors
+            # and a standalone int8 vocab head
+            self.param_count = llama.param_count(params)
+            if config.quantization:
+                if self._pp:
+                    raise ValueError(
+                        "quantization unsupported with pp>1 (stage stacking)"
+                    )
+                from dynamo_tpu.ops.quant import quantize_params
+
+                params = quantize_params(
+                    params, self.model_cfg, mode=config.quantization
+                )
             if not self._pp:
                 params = meshmod.shard_params(params, self.model_cfg, self.mesh)
+        else:
+            from dynamo_tpu.ops.quant import is_quantized, logical_param_count
+
+            if config.quantization and not any(
+                is_quantized(lp.get("wq")) for lp in params["layers"]
+            ):
+                raise ValueError(
+                    "quantization set but caller-provided params are "
+                    "unquantized — pass ops.quant.quantize_params output"
+                )
+            self.param_count = logical_param_count(params, self.model_cfg)
 
         self.num_pages = config.num_pages or self._auto_num_pages()
         self.page_size = config.page_size

@@ -25,6 +25,7 @@ import jax.numpy as jnp
 from dynamo_tpu.models.config import ModelConfig
 from dynamo_tpu.ops.attention import paged_attention, write_kv_slots
 from dynamo_tpu.ops.norm import rms_norm
+from dynamo_tpu.ops.quant import is_quantized, mm, quant_matmul
 from dynamo_tpu.ops.rope import apply_rope, rope_cos_sin, rope_inv_freq
 
 Params = dict[str, Any]
@@ -181,9 +182,9 @@ def _attn_block(
         h //= tpn
         kh //= tpn
 
-    q = x @ lp["wq"]
-    k = x @ lp["wk"]
-    v = x @ lp["wv"]
+    q = mm(x, lp["wq"])
+    k = mm(x, lp["wk"])
+    v = mm(x, lp["wv"])
     if cfg.attn_bias:
         q = q + lp["bq"]
         k = k + lp["bk"]
@@ -335,16 +336,16 @@ def _attn_block(
             )[:, None]
         else:
             out = paged_attention(q, kv_k, kv_v, attn.slot_matrix, positions)
-    proj = out.reshape(b, t, h * hd) @ lp["wo"]
+    proj = mm(out.reshape(b, t, h * hd), lp["wo"])
     if tp_axis is not None:
         proj = jax.lax.psum(proj, tp_axis)
     return proj, kv_k, kv_v
 
 
 def _mlp_block(lp: Params, x: jnp.ndarray, tp_axis=None) -> jnp.ndarray:
-    gate = jax.nn.silu(x @ lp["w_gate"])
-    up = x @ lp["w_up"]
-    out = (gate * up) @ lp["w_down"]
+    gate = jax.nn.silu(mm(x, lp["w_gate"]))
+    up = mm(x, lp["w_up"])
+    out = mm(gate * up, lp["w_down"])
     if tp_axis is not None:
         out = jax.lax.psum(out, tp_axis)
     return out
@@ -428,8 +429,16 @@ def layer_step(lp, cfg, x, cos, sin, kv_k, kv_v, write_slots, attn,
 
 
 def logits(params: Params, cfg: ModelConfig, hidden: jnp.ndarray) -> jnp.ndarray:
-    """Vocab projection [..., D] -> [..., V] in float32."""
-    head = params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
+    """Vocab projection [..., D] -> [..., V] in float32.
+
+    When the params carry a quantized "lm_head" (ops/quant.py adds one
+    even for tied embeddings — the bf16 table stays for the gather), the
+    projection runs int8 on the MXU."""
+    head = params.get("lm_head")
+    if head is None:
+        head = params["embed"].T
+    if is_quantized(head):
+        return quant_matmul(hidden, head, out_dtype=jnp.float32)
     return jnp.einsum(
         "...d,dv->...v", hidden, head, preferred_element_type=jnp.float32
     )
@@ -483,4 +492,8 @@ def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> Params:
 
 
 def param_count(params: Params) -> int:
+    """Logical parameter count. On a quantized tree (ops/quant.py) the
+    per-channel scales and the duplicate int8 head of tied embeddings
+    are bookkeeping, not model parameters — call on the bf16 tree (the
+    engine snapshots `param_count` before quantizing)."""
     return sum(int(p.size) for p in jax.tree.leaves(params))

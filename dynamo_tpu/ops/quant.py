@@ -1,0 +1,120 @@
+"""Int8 quantized matmul path (W8A8, dynamic per-token activation scales).
+
+The reference's headline baselines serve FP8 models on H100 (reference:
+docs/architecture.md:76-83 — "R1-Distill-Llama-70B FP8"); the TPU-native
+equivalent is int8 on the MXU, which runs at ~1.4x the bf16 matmul rate on
+v5e (measured; spec 2x) and halves the weight bytes the bandwidth-bound
+decode phase must stream per step.
+
+Scheme (llm.int8 / SmoothQuant-family, the standard near-lossless recipe):
+
+- weights: symmetric per-output-channel int8, scale = max|w_col| / 127,
+  stored as a plain dict leaf {"q": int8 [in, out], "s": f32 [out]} so the
+  sharding pytrees in parallel/mesh.py keep working structurally (the
+  scale inherits the weight's output-dim partition spec);
+- activations: symmetric per-row (per-token) int8 quantized dynamically
+  at trace time inside the same jit — no calibration pass;
+- the dot runs s8 x s8 -> s32 on the MXU (`preferred_element_type=int32`;
+  worst-case accumulation 127*127*K < 2^31 for any real K), dequantized
+  as acc * x_scale * w_scale in f32 and cast back to the activation dtype.
+
+Attention itself (QK^T, PV, the paged KV cache) stays bf16: its inputs
+are freshly-computed activations, not weights, and the Pallas kernels are
+bandwidth- not compute-bound. Embedding lookups stay bf16; the vocab
+projection gets its own int8 copy (tied embeddings keep the bf16 table
+for the gather).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+# per-layer weight names eligible for quantization (dense Llama family;
+# MoE expert tensors and the router stay bf16 — 3-D einsum weights, and
+# routing is accuracy-critical)
+QUANT_KEYS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+
+
+def is_quantized(leaf: Any) -> bool:
+    """A quantized-weight leaf is the exact dict {"q", "s"}."""
+    return (
+        isinstance(leaf, dict)
+        and len(leaf) == 2
+        and "q" in leaf
+        and "s" in leaf
+    )
+
+
+def quantize_weight(w: jnp.ndarray) -> dict:
+    """[in, out] float -> {"q": int8 [in, out], "s": f32 [out]}."""
+    wf = w.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(wf), axis=0)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(wf / scale), -127, 127).astype(jnp.int8)
+    return {"q": q, "s": scale}
+
+
+def quant_matmul(x: jnp.ndarray, w: dict, out_dtype=None) -> jnp.ndarray:
+    """x [..., in] (bf16/f32) @ quantized w -> [..., out] in x.dtype
+    (or `out_dtype`; the dequant itself is f32).
+
+    Per-row dynamic activation quantization; s8xs8->s32 on the MXU.
+    """
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)
+    xs = jnp.where(amax > 0, amax / 127.0, 1.0)
+    xi = jnp.clip(jnp.round(xf / xs), -127, 127).astype(jnp.int8)
+    acc = jax.lax.dot_general(
+        xi,
+        w["q"],
+        (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+    out = acc.astype(jnp.float32) * xs * w["s"]
+    return out.astype(out_dtype or x.dtype)
+
+
+def mm(x: jnp.ndarray, w) -> jnp.ndarray:
+    """The model's matmul: quantized or plain depending on the leaf."""
+    if is_quantized(w):
+        return quant_matmul(x, w)
+    return x @ w
+
+
+def logical_param_count(params: dict, cfg) -> int:
+    """Model parameter count on a quantized OR plain tree: scales are
+    bookkeeping, a tied-embedding int8 head is a duplicate, int8 weights
+    count by element like their bf16 originals."""
+    total = 0
+    for key, sub in params.items():
+        if key == "lm_head" and cfg.tie_word_embeddings and is_quantized(sub):
+            continue
+        for leaf in jax.tree.leaves(sub, is_leaf=is_quantized):
+            total += int(leaf["q"].size) if is_quantized(leaf) else int(leaf.size)
+    return total
+
+
+def quantize_params(params: dict, cfg, mode: str = "int8") -> dict:
+    """Quantize a llama.init_params-shaped pytree in place of the dense
+    projection weights; adds an int8 "lm_head" (from embed.T when tied).
+
+    Norms, biases, embeddings, MoE experts and the router stay bf16.
+    """
+    if mode != "int8":
+        raise ValueError(f"unknown quantization mode {mode!r}; expected 'int8'")
+    new = dict(params)
+    new["layers"] = [
+        {
+            k: (quantize_weight(v) if k in QUANT_KEYS else v)
+            for k, v in lp.items()
+        }
+        for lp in params["layers"]
+    ]
+    head = (
+        params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
+    )
+    new["lm_head"] = quantize_weight(head)
+    return new

@@ -165,9 +165,28 @@ def replicated(mesh: Mesh) -> NamedSharding:
 
 
 def shard_params(params, cfg: ModelConfig, mesh: Mesh):
-    """device_put the param pytree against its shardings."""
+    """device_put the param pytree against its shardings.
+
+    Quantized leaves ({"q", "s"} dicts, ops/quant.py) get the weight's
+    spec on q and its output-dim (last) axis on the per-channel scale;
+    the int8 "lm_head" quantization adds even for tied embeddings is
+    vocab-column sharded like an untied head."""
+    from dynamo_tpu.ops.quant import is_quantized
+
     shardings = param_shardings(cfg, mesh)
+    if "lm_head" in params and "lm_head" not in shardings:
+        shardings["lm_head"] = NamedSharding(mesh, P(None, "tp"))
+
+    def put(arr, s):
+        if is_quantized(arr):
+            last = s.spec[-1] if len(s.spec) else None
+            return {
+                "q": jax.device_put(arr["q"], s),
+                "s": jax.device_put(arr["s"], NamedSharding(mesh, P(last))),
+            }
+        return jax.device_put(arr, s)
+
     return jax.tree.map(
-        lambda arr, s: jax.device_put(arr, s), params, shardings,
-        is_leaf=lambda x: not isinstance(x, (dict, list)),
+        put, params, shardings,
+        is_leaf=lambda x: is_quantized(x) or not isinstance(x, (dict, list)),
     )
