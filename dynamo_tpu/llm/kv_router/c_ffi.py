@@ -1,0 +1,83 @@
+"""ctypes wrapper over libdynamo_kv_events.so (native/kv_events.cpp).
+
+Mirrors how the reference's vLLM patch loads the Dynamo C bindings
+(reference: lib/bindings/c/src/lib.rs:52-297; patch event_manager.py
+ctypes load): an external engine process links the library and reports
+prefix-cache block lifecycle straight onto the hub event plane, no
+Python runtime required. The events are wire-identical to
+KvEventPublisher's (protocols.py RouterEvent), so KvIndexer consumes
+them unchanged.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Optional, Sequence
+
+
+class NativeKvEventPublisher:
+    """Engine-side KV event publisher backed by the native C library."""
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        namespace: str,
+        component: str,
+        worker_id: int,
+        kv_block_size: int,
+        lib_path: Optional[str] = None,
+    ):
+        if lib_path is None:
+            from dynamo_tpu.runtime.hub.native import kv_events_library
+
+            lib_path = kv_events_library()
+        if lib_path is None:
+            raise RuntimeError("libdynamo_kv_events.so unavailable")
+        self._lib = ctypes.CDLL(lib_path)
+        self._lib.dyn_llm_init.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_longlong, ctypes.c_int,
+        ]
+        self._lib.dyn_kv_event_publish_stored.argtypes = [
+            ctypes.c_ulonglong, ctypes.c_ulonglong, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_ulonglong),
+            ctypes.POINTER(ctypes.c_ulonglong),
+            ctypes.POINTER(ctypes.c_int), ctypes.c_int,
+        ]
+        self._lib.dyn_kv_event_publish_removed.argtypes = [
+            ctypes.c_ulonglong, ctypes.POINTER(ctypes.c_ulonglong), ctypes.c_int,
+        ]
+        rc = self._lib.dyn_llm_init(
+            host.encode(), port, namespace.encode(), component.encode(),
+            worker_id, kv_block_size,
+        )
+        if rc != 0:
+            raise ConnectionError(f"dyn_llm_init failed (rc={rc})")
+
+    def publish_stored(
+        self,
+        event_id: int,
+        blocks: Sequence[tuple[int, int, int]],  # (block_hash, tokens_hash, page_id)
+        parent_hash: Optional[int] = None,
+    ) -> None:
+        n = len(blocks)
+        bh = (ctypes.c_ulonglong * n)(*(b[0] for b in blocks))
+        th = (ctypes.c_ulonglong * n)(*(b[1] for b in blocks))
+        pg = (ctypes.c_int * n)(*(b[2] for b in blocks))
+        rc = self._lib.dyn_kv_event_publish_stored(
+            event_id, parent_hash or 0, 0 if parent_hash is None else 1,
+            bh, th, pg, n,
+        )
+        if rc != 0:
+            raise ConnectionError(f"publish_stored failed (rc={rc})")
+
+    def publish_removed(self, event_id: int, block_hashes: Sequence[int]) -> None:
+        n = len(block_hashes)
+        bh = (ctypes.c_ulonglong * n)(*block_hashes)
+        rc = self._lib.dyn_kv_event_publish_removed(event_id, bh, n)
+        if rc != 0:
+            raise ConnectionError(f"publish_removed failed (rc={rc})")
+
+    def close(self) -> None:
+        self._lib.dyn_llm_shutdown()

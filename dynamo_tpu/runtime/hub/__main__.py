@@ -18,7 +18,17 @@ def main() -> None:
     parser = argparse.ArgumentParser(description="dynamo-tpu hub (control plane)")
     parser.add_argument("--host", default="0.0.0.0")
     parser.add_argument("--port", type=int, default=2379)
+    parser.add_argument(
+        "--native", action="store_true",
+        help="run the C++ daemon (native/hubd.cpp) instead of the asyncio "
+             "server — same wire protocol, built on demand",
+    )
     args = parser.parse_args()
+    if args.native:
+        from dynamo_tpu.runtime.hub.native import exec_hubd
+
+        exec_hubd(args.host, args.port)
+        return
     try:
         asyncio.run(_main(args.host, args.port))
     except KeyboardInterrupt:

@@ -1,0 +1,82 @@
+"""Build/spawn helpers for the native (C++) hub daemon and the C-FFI
+KV-event publisher library (native/ at the repo root).
+
+The native hub (native/hubd.cpp) speaks the identical wire protocol as
+the asyncio HubServer, so `HubClient`/`DistributedRuntime` connect to
+either interchangeably; `python -m dynamo_tpu.runtime.hub --native`
+execs it. Build is a plain `make -C native` (g++, no external deps),
+run lazily and cached in native/build/.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+from pathlib import Path
+from typing import Optional
+
+NATIVE_DIR = Path(__file__).resolve().parents[3] / "native"
+HUBD = NATIVE_DIR / "build" / "dynamo-hubd"
+KV_EVENTS_LIB = NATIVE_DIR / "build" / "libdynamo_kv_events.so"
+
+
+def _stale(binary: Path) -> bool:
+    if not binary.exists():
+        return True
+    btime = binary.stat().st_mtime
+    return any(
+        src.stat().st_mtime > btime for src in NATIVE_DIR.glob("*.cpp")
+    ) or (NATIVE_DIR / "msgpack.hpp").stat().st_mtime > btime
+
+
+def ensure_built() -> None:
+    """Build the native components if missing or out of date."""
+    if not (_stale(HUBD) or _stale(KV_EVENTS_LIB)):
+        return
+    try:
+        subprocess.run(
+            ["make", "-C", str(NATIVE_DIR)],
+            check=True,
+            capture_output=True,
+            text=True,
+        )
+    except FileNotFoundError as exc:
+        raise RuntimeError("`make` not found; cannot build native hub") from exc
+    except subprocess.CalledProcessError as exc:
+        raise RuntimeError(
+            f"native build failed:\n{exc.stdout}\n{exc.stderr}"
+        ) from exc
+
+
+def spawn_hub(
+    host: str = "127.0.0.1", port: int = 0, timeout: float = 10.0
+) -> tuple[subprocess.Popen, int]:
+    """Start dynamo-hubd; returns (process, bound_port). Port 0 picks an
+    ephemeral port (reported on the daemon's stdout)."""
+    import select
+
+    ensure_built()
+    proc = subprocess.Popen(
+        [str(HUBD), "--host", host, "--port", str(port)],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL,
+        text=True,
+    )
+    ready, _, _ = select.select([proc.stdout], [], [], timeout)
+    line = proc.stdout.readline() if ready else ""
+    if not line.startswith("LISTENING "):
+        proc.kill()
+        raise RuntimeError(f"dynamo-hubd failed to start (got {line!r})")
+    return proc, int(line.split()[1])
+
+
+def kv_events_library() -> Optional[str]:
+    """Path to libdynamo_kv_events.so, building on demand."""
+    ensure_built()
+    return str(KV_EVENTS_LIB) if KV_EVENTS_LIB.exists() else None
+
+
+def exec_hubd(host: str, port: int) -> None:
+    """Replace this process with the native daemon (for --native)."""
+    ensure_built()
+    os.execv(str(HUBD), [str(HUBD), "--host", host, "--port", str(port)])

@@ -1,0 +1,693 @@
+// dynamo-hubd: native (C++) hub control plane for dynamo-tpu.
+//
+// Drop-in replacement for the Python hub server
+// (dynamo_tpu/runtime/hub/server.py) speaking the identical
+// length-prefixed-msgpack protocol (hub/codec.py), so every Python client
+// (HubClient, DistributedRuntime, the C KV-event publisher) works
+// unchanged. Semantics mirror the reference's etcd + NATS usage
+// (reference: lib/runtime/src/transports/etcd.rs:41-540, nats.rs:50-214):
+// lease-attached KV with prefix watches, wildcard pub/sub, competing-
+// consumer queues, object-store buckets.
+//
+// Design: one poll(2) loop, one thread — every op is atomic with respect
+// to every other, the same single-writer discipline as the asyncio hub
+// and the reference's mailbox progress engines (SURVEY.md §5). Blocking
+// q_pops and lease TTLs are poll-timeout-driven timers, not threads.
+//
+// Build: make -C native  (produces native/build/dynamo-hubd)
+// Run:   dynamo-hubd [--host 127.0.0.1] [--port 0]
+// Prints "LISTENING <port>" on stdout once bound (port 0 = ephemeral).
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <signal.h>
+#include <sys/socket.h>
+#include <time.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <memory>
+#include <set>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include "msgpack.hpp"
+
+using msgpack::Value;
+
+static constexpr size_t kMaxFrame = 256u * 1024u * 1024u;  // codec.py cap
+static constexpr double kLeaseTick = 0.25;                 // server.py LEASE_TICK_S
+
+static double now_mono() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return static_cast<double>(ts.tv_sec) + static_cast<double>(ts.tv_nsec) * 1e-9;
+}
+
+struct KvEntry {
+  std::string value;
+  int64_t rev = 0;
+  int64_t lease = 0;
+};
+
+struct Lease {
+  double ttl = 10.0;
+  double deadline = 0.0;
+  std::set<std::string> keys;
+};
+
+struct PopWaiter {
+  int conn_id = 0;
+  int64_t msg_id = 0;
+  bool has_deadline = false;
+  double deadline = 0.0;
+};
+
+struct Conn {
+  int fd = -1;
+  int id = 0;
+  std::string rbuf;
+  size_t roff = 0;  // parse offset into rbuf
+  std::string wbuf;
+  size_t woff = 0;  // flush offset into wbuf
+  std::set<int64_t> watches;
+  std::set<int64_t> subs;
+  bool dead = false;
+};
+
+class Hub {
+ public:
+  int listen_fd = -1;
+  uint16_t port = 0;
+
+  std::map<std::string, KvEntry> kv;
+  int64_t revision = 0;
+  std::unordered_map<int64_t, Lease> leases;
+  int64_t next_lease_id = 0x1000;
+  int next_conn_id = 1;
+  std::unordered_map<int, std::unique_ptr<Conn>> conns;
+  // (conn_id, client-chosen watch/sub id) -> prefix / subject pattern
+  std::map<std::pair<int, int64_t>, std::string> watches;
+  std::map<std::pair<int, int64_t>, std::string> subs;
+  std::unordered_map<std::string, std::deque<Value>> queues;
+  std::unordered_map<std::string, std::vector<PopWaiter>> pop_waiters;
+  std::unordered_map<std::string, std::map<std::string, Value>> objects;
+  double next_lease_sweep = 0.0;
+
+  bool listen(const char* host, uint16_t want_port) {
+    listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (listen_fd < 0) return false;
+    int one = 1;
+    setsockopt(listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(want_port);
+    if (inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+      // hostname: resolve like the asyncio server does
+      struct addrinfo hints{};
+      hints.ai_family = AF_INET;
+      hints.ai_socktype = SOCK_STREAM;
+      struct addrinfo* res = nullptr;
+      if (getaddrinfo(host, nullptr, &hints, &res) != 0 || res == nullptr)
+        return false;
+      addr.sin_addr = reinterpret_cast<sockaddr_in*>(res->ai_addr)->sin_addr;
+      freeaddrinfo(res);
+    }
+    if (bind(listen_fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0)
+      return false;
+    if (::listen(listen_fd, 256) < 0) return false;
+    socklen_t alen = sizeof(addr);
+    getsockname(listen_fd, reinterpret_cast<sockaddr*>(&addr), &alen);
+    port = ntohs(addr.sin_port);
+    set_nonblock(listen_fd);
+    next_lease_sweep = now_mono() + kLeaseTick;
+    return true;
+  }
+
+  static void set_nonblock(int fd) {
+    fcntl(fd, F_SETFL, fcntl(fd, F_GETFL, 0) | O_NONBLOCK);
+  }
+
+  // ------------------------------------------------------------ out frames
+
+  void send_value(Conn& c, const Value& v) {
+    c.wbuf.append(msgpack::frame_encode(v));
+  }
+
+  void reply(Conn& c, const Value& req, Value result) {
+    if (req.get("i").is_nil()) return;
+    Value out = Value::mapv();
+    out.set("i", req.get("i"));
+    out.set("ok", Value::boolean(true));
+    out.set("r", std::move(result));
+    send_value(c, out);
+  }
+
+  void reply_err(Conn& c, const Value& req, const std::string& err) {
+    if (req.get("i").is_nil()) return;
+    Value out = Value::mapv();
+    out.set("i", req.get("i"));
+    out.set("ok", Value::boolean(false));
+    out.set("e", Value::str(err));
+    send_value(c, out);
+  }
+
+  void push_to(int conn_id, int64_t push_id, Value ev) {
+    auto it = conns.find(conn_id);
+    if (it == conns.end() || it->second->dead) return;
+    Value out = Value::mapv();
+    out.set("push", Value::integer(push_id));
+    out.set("ev", std::move(ev));
+    send_value(*it->second, out);
+  }
+
+  // ------------------------------------------------------------------- kv
+
+  void notify_watchers(const char* type, const std::string& key,
+                       const std::string* value, int64_t rev) {
+    for (const auto& w : watches) {
+      const std::string& prefix = w.second;
+      if (key.compare(0, prefix.size(), prefix) == 0) {
+        Value ev = Value::mapv();
+        ev.set("type", Value::str(type));
+        ev.set("key", Value::str(key));
+        ev.set("value", value ? Value::bin(*value) : Value::nil());
+        ev.set("rev", Value::integer(rev));
+        push_to(w.first.first, w.first.second, std::move(ev));
+      }
+    }
+  }
+
+  int64_t kv_set(const std::string& key, const std::string& value,
+                 int64_t lease_id) {
+    if (lease_id) {
+      auto it = leases.find(lease_id);
+      if (it == leases.end())
+        throw std::runtime_error("lease " + std::to_string(lease_id) + " not found");
+      it->second.keys.insert(key);
+    }
+    auto old = kv.find(key);
+    if (old != kv.end() && old->second.lease && old->second.lease != lease_id) {
+      auto ol = leases.find(old->second.lease);
+      if (ol != leases.end()) ol->second.keys.erase(key);
+    }
+    ++revision;
+    kv[key] = KvEntry{value, revision, lease_id};
+    notify_watchers("put", key, &value, revision);
+    return revision;
+  }
+
+  bool kv_delete(const std::string& key) {
+    auto it = kv.find(key);
+    if (it == kv.end()) return false;
+    if (it->second.lease) {
+      auto ol = leases.find(it->second.lease);
+      if (ol != leases.end()) ol->second.keys.erase(key);
+    }
+    kv.erase(it);
+    ++revision;
+    notify_watchers("delete", key, nullptr, revision);
+    return true;
+  }
+
+  Value kv_entry_value(const std::string& key, const KvEntry& e) {
+    Value v = Value::mapv();
+    v.set("key", Value::str(key));
+    v.set("value", Value::bin(e.value));
+    v.set("rev", Value::integer(e.rev));
+    v.set("lease", Value::integer(e.lease));
+    return v;
+  }
+
+  Value kv_get_prefix(const std::string& prefix) {
+    Value out = Value::array();
+    // std::map is ordered: scan from lower_bound until prefix stops matching
+    for (auto it = kv.lower_bound(prefix); it != kv.end(); ++it) {
+      if (it->first.compare(0, prefix.size(), prefix) != 0) break;
+      out.arr.push_back(kv_entry_value(it->first, it->second));
+    }
+    return out;
+  }
+
+  bool revoke_lease(int64_t lease_id) {
+    auto it = leases.find(lease_id);
+    if (it == leases.end()) return false;
+    std::vector<std::string> keys(it->second.keys.begin(), it->second.keys.end());
+    leases.erase(it);
+    for (const auto& k : keys) kv_delete(k);
+    return true;
+  }
+
+  // --------------------------------------------------------------- pub/sub
+
+  static bool subject_matches(const std::string& pattern,
+                              const std::string& subject) {
+    if (pattern == subject) return true;
+    if (pattern.size() >= 2 && pattern.compare(pattern.size() - 2, 2, ".>") == 0) {
+      std::string head = pattern.substr(0, pattern.size() - 1);  // keep '.'
+      if (subject.compare(0, head.size(), head) == 0) return true;
+      if (subject == pattern.substr(0, pattern.size() - 2)) return true;
+    }
+    return false;
+  }
+
+  // --------------------------------------------------------------- queues
+
+  void answer_pop(const PopWaiter& w, Value data) {
+    auto it = conns.find(w.conn_id);
+    if (it == conns.end() || it->second->dead) return;
+    Value req = Value::mapv();
+    req.set("i", Value::integer(w.msg_id));
+    reply(*it->second, req, std::move(data));
+  }
+
+  // -------------------------------------------------------------- dispatch
+
+  void dispatch(Conn& c, const Value& m) {
+    const Value& opv = m.get("op");
+    if (!opv.is_str()) {
+      reply_err(c, m, "missing op");
+      return;
+    }
+    const std::string& op = opv.s;
+    try {
+      if (op == "ping") {
+        reply(c, m, Value::str("pong"));
+      } else if (op == "kv_put") {
+        reply(c, m, Value::integer(kv_set(m.get("key").as_str(),
+                                          m.get("value").as_bytes(),
+                                          m.get("lease").is_nil() ? 0 : m.get("lease").as_int())));
+      } else if (op == "kv_get") {
+        auto it = kv.find(m.get("key").as_str());
+        if (it == kv.end()) {
+          reply(c, m, Value::nil());
+        } else {
+          Value v = Value::mapv();
+          v.set("value", Value::bin(it->second.value));
+          v.set("rev", Value::integer(it->second.rev));
+          v.set("lease", Value::integer(it->second.lease));
+          reply(c, m, std::move(v));
+        }
+      } else if (op == "kv_get_prefix") {
+        reply(c, m, kv_get_prefix(m.get("prefix").as_str()));
+      } else if (op == "kv_del") {
+        const std::string key = m.get("key").as_str();
+        if (m.get("prefix").truthy()) {
+          std::vector<std::string> keys;
+          for (auto it = kv.lower_bound(key); it != kv.end(); ++it) {
+            if (it->first.compare(0, key.size(), key) != 0) break;
+            keys.push_back(it->first);
+          }
+          int64_t n = 0;
+          for (const auto& k : keys) n += kv_delete(k) ? 1 : 0;
+          reply(c, m, Value::integer(n));
+        } else {
+          reply(c, m, Value::integer(kv_delete(key) ? 1 : 0));
+        }
+      } else if (op == "kv_create") {
+        const std::string key = m.get("key").as_str();
+        if (kv.count(key)) {
+          reply(c, m, Value::boolean(false));
+        } else {
+          kv_set(key, m.get("value").as_bytes(),
+                 m.get("lease").is_nil() ? 0 : m.get("lease").as_int());
+          reply(c, m, Value::boolean(true));
+        }
+      } else if (op == "kv_create_or_validate") {
+        const std::string key = m.get("key").as_str();
+        auto it = kv.find(key);
+        if (it == kv.end()) {
+          kv_set(key, m.get("value").as_bytes(),
+                 m.get("lease").is_nil() ? 0 : m.get("lease").as_int());
+          reply(c, m, Value::boolean(true));
+        } else {
+          reply(c, m, Value::boolean(it->second.value == m.get("value").as_bytes()));
+        }
+      } else if (op == "watch_prefix") {
+        int64_t wid = m.get("watch_id").as_int();
+        const std::string prefix = m.get("prefix").as_str();
+        watches[{c.id, wid}] = prefix;
+        c.watches.insert(wid);
+        Value r = Value::mapv();
+        r.set("watch_id", Value::integer(wid));
+        r.set("snapshot", kv_get_prefix(prefix));
+        r.set("rev", Value::integer(revision));
+        reply(c, m, std::move(r));
+      } else if (op == "watch_cancel") {
+        int64_t wid = m.get("watch_id").as_int();
+        watches.erase({c.id, wid});
+        c.watches.erase(wid);
+        reply(c, m, Value::boolean(true));
+      } else if (op == "lease_grant") {
+        double ttl = m.get("ttl").is_nil() ? 10.0 : m.get("ttl").as_double();
+        int64_t id = next_lease_id++;
+        leases[id] = Lease{ttl, now_mono() + ttl, {}};
+        Value r = Value::mapv();
+        r.set("lease_id", Value::integer(id));
+        r.set("ttl", Value::real(ttl));
+        reply(c, m, std::move(r));
+      } else if (op == "lease_keepalive") {
+        auto it = leases.find(m.get("lease_id").as_int());
+        if (it == leases.end()) {
+          reply(c, m, Value::boolean(false));
+        } else {
+          it->second.deadline = now_mono() + it->second.ttl;
+          reply(c, m, Value::boolean(true));
+        }
+      } else if (op == "lease_revoke") {
+        reply(c, m, Value::boolean(revoke_lease(m.get("lease_id").as_int())));
+      } else if (op == "lease_is_valid") {
+        reply(c, m, Value::boolean(leases.count(m.get("lease_id").as_int()) > 0));
+      } else if (op == "subscribe") {
+        int64_t sid = m.get("sub_id").as_int();
+        subs[{c.id, sid}] = m.get("subject").as_str();
+        c.subs.insert(sid);
+        Value r = Value::mapv();
+        r.set("sub_id", Value::integer(sid));
+        reply(c, m, std::move(r));
+      } else if (op == "unsubscribe") {
+        int64_t sid = m.get("sub_id").as_int();
+        subs.erase({c.id, sid});
+        c.subs.erase(sid);
+        reply(c, m, Value::boolean(true));
+      } else if (op == "publish") {
+        const std::string subject = m.get("subject").as_str();
+        const Value& data = m.get("data");
+        int64_t n = 0;
+        for (const auto& s : subs) {
+          if (subject_matches(s.second, subject)) {
+            Value ev = Value::mapv();
+            ev.set("subject", Value::str(subject));
+            ev.set("data", data);
+            push_to(s.first.first, s.first.second, std::move(ev));
+            ++n;
+          }
+        }
+        reply(c, m, Value::integer(n));
+      } else if (op == "q_push") {
+        const std::string name = m.get("name").as_str();
+        auto wit = pop_waiters.find(name);
+        if (wit != pop_waiters.end() && !wit->second.empty()) {
+          PopWaiter w = wit->second.front();
+          wit->second.erase(wit->second.begin());
+          if (wit->second.empty()) pop_waiters.erase(wit);
+          answer_pop(w, m.get("data"));
+          reply(c, m, Value::integer(0));
+        } else {
+          auto& q = queues[name];
+          q.push_back(m.get("data"));
+          reply(c, m, Value::integer(static_cast<int64_t>(q.size())));
+        }
+      } else if (op == "q_pop") {
+        const std::string name = m.get("name").as_str();
+        auto qit = queues.find(name);
+        if (qit != queues.end() && !qit->second.empty()) {
+          Value data = std::move(qit->second.front());
+          qit->second.pop_front();
+          reply(c, m, std::move(data));
+        } else if (!m.get("block").truthy()) {
+          reply(c, m, Value::nil());
+        } else {
+          PopWaiter w;
+          w.conn_id = c.id;
+          w.msg_id = m.get("i").as_int();
+          const Value& to = m.get("timeout");
+          if (!to.is_nil()) {
+            w.has_deadline = true;
+            w.deadline = now_mono() + to.as_double();
+          }
+          pop_waiters[name].push_back(w);
+        }
+      } else if (op == "q_len") {
+        auto qit = queues.find(m.get("name").as_str());
+        reply(c, m, Value::integer(
+            qit == queues.end() ? 0 : static_cast<int64_t>(qit->second.size())));
+      } else if (op == "obj_put") {
+        objects[m.get("bucket").as_str()][m.get("name").as_str()] = m.get("data");
+        reply(c, m, Value::boolean(true));
+      } else if (op == "obj_get") {
+        auto bit = objects.find(m.get("bucket").as_str());
+        if (bit == objects.end()) {
+          reply(c, m, Value::nil());
+        } else {
+          auto oit = bit->second.find(m.get("name").as_str());
+          reply(c, m, oit == bit->second.end() ? Value::nil() : oit->second);
+        }
+      } else if (op == "obj_del") {
+        auto bit = objects.find(m.get("bucket").as_str());
+        bool hit = false;
+        if (bit != objects.end()) hit = bit->second.erase(m.get("name").as_str()) > 0;
+        reply(c, m, Value::boolean(hit));
+      } else if (op == "obj_list") {
+        Value out = Value::array();
+        auto bit = objects.find(m.get("bucket").as_str());
+        if (bit != objects.end())
+          for (const auto& o : bit->second) out.arr.push_back(Value::str(o.first));
+        reply(c, m, std::move(out));
+      } else if (op == "stats") {
+        Value qs = Value::mapv();
+        for (const auto& q : queues)
+          qs.set(q.first, Value::integer(static_cast<int64_t>(q.second.size())));
+        Value r = Value::mapv();
+        r.set("keys", Value::integer(static_cast<int64_t>(kv.size())));
+        r.set("leases", Value::integer(static_cast<int64_t>(leases.size())));
+        r.set("conns", Value::integer(static_cast<int64_t>(conns.size())));
+        r.set("watches", Value::integer(static_cast<int64_t>(watches.size())));
+        r.set("subs", Value::integer(static_cast<int64_t>(subs.size())));
+        r.set("queues", std::move(qs));
+        r.set("revision", Value::integer(revision));
+        reply(c, m, std::move(r));
+      } else {
+        reply_err(c, m, "unknown op '" + op + "'");
+      }
+    } catch (const std::exception& e) {
+      reply_err(c, m, e.what());
+    }
+  }
+
+  // ------------------------------------------------------------ connection
+
+  void drop_conn(Conn& c) {
+    c.dead = true;
+    for (int64_t wid : c.watches) watches.erase({c.id, wid});
+    for (int64_t sid : c.subs) subs.erase({c.id, sid});
+    for (auto it = pop_waiters.begin(); it != pop_waiters.end();) {
+      auto& v = it->second;
+      v.erase(std::remove_if(v.begin(), v.end(),
+                             [&](const PopWaiter& w) { return w.conn_id == c.id; }),
+              v.end());
+      it = v.empty() ? pop_waiters.erase(it) : std::next(it);
+    }
+    // leases are NOT revoked on disconnect: they expire by TTL, giving
+    // workers a reconnect window (etcd semantics; server.py _drop_conn)
+    if (c.fd >= 0) {
+      close(c.fd);
+      c.fd = -1;
+    }
+  }
+
+  void handle_readable(Conn& c) {
+    char chunk[65536];
+    bool eof = false;
+    for (;;) {
+      ssize_t n = ::read(c.fd, chunk, sizeof(chunk));
+      if (n > 0) {
+        c.rbuf.append(chunk, static_cast<size_t>(n));
+        continue;
+      }
+      if (n == 0) {  // clean EOF: still parse frames read in this batch —
+        eof = true;  // fire-and-forget publishes may ride the same segment
+        break;       // as the FIN (the C publisher's shutdown pattern)
+      }
+      if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+      if (errno == EINTR) continue;
+      c.dead = true;
+      return;
+    }
+    // parse complete frames
+    for (;;) {
+      size_t avail = c.rbuf.size() - c.roff;
+      if (avail < 4) break;
+      const uint8_t* p = reinterpret_cast<const uint8_t*>(c.rbuf.data()) + c.roff;
+      uint32_t len = (uint32_t(p[0]) << 24) | (uint32_t(p[1]) << 16) |
+                     (uint32_t(p[2]) << 8) | uint32_t(p[3]);
+      if (len > kMaxFrame) {
+        c.dead = true;  // oversized frame: drop conn (codec.py behavior)
+        return;
+      }
+      if (avail < 4 + static_cast<size_t>(len)) break;
+      try {
+        Value m = msgpack::unpack(p + 4, len);
+        c.roff += 4 + len;
+        dispatch(c, m);
+      } catch (const std::exception&) {
+        c.dead = true;  // malformed frame
+        return;
+      }
+      if (c.dead) return;
+    }
+    if (c.roff > 0 && (c.roff == c.rbuf.size() || c.roff > (1u << 20))) {
+      c.rbuf.erase(0, c.roff);
+      c.roff = 0;
+    }
+    if (eof) {
+      handle_writable(c);  // best-effort flush of any replies
+      c.dead = true;
+    }
+  }
+
+  void handle_writable(Conn& c) {
+    while (c.woff < c.wbuf.size()) {
+      ssize_t n = ::write(c.fd, c.wbuf.data() + c.woff, c.wbuf.size() - c.woff);
+      if (n > 0) {
+        c.woff += static_cast<size_t>(n);
+        continue;
+      }
+      if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+      if (errno == EINTR) continue;
+      c.dead = true;
+      return;
+    }
+    if (c.woff == c.wbuf.size()) {
+      c.wbuf.clear();
+      c.woff = 0;
+    } else if (c.woff > (1u << 20)) {
+      c.wbuf.erase(0, c.woff);
+      c.woff = 0;
+    }
+  }
+
+  void sweep_timers() {
+    double now = now_mono();
+    if (now >= next_lease_sweep) {
+      next_lease_sweep = now + kLeaseTick;
+      std::vector<int64_t> expired;
+      for (const auto& l : leases)
+        if (l.second.deadline < now) expired.push_back(l.first);
+      for (int64_t id : expired) revoke_lease(id);
+    }
+    for (auto it = pop_waiters.begin(); it != pop_waiters.end();) {
+      auto& v = it->second;
+      for (auto w = v.begin(); w != v.end();) {
+        if (w->has_deadline && w->deadline <= now) {
+          answer_pop(*w, Value::nil());
+          w = v.erase(w);
+        } else {
+          ++w;
+        }
+      }
+      it = v.empty() ? pop_waiters.erase(it) : std::next(it);
+    }
+  }
+
+  int poll_timeout_ms() const {
+    double now = now_mono();
+    double next = next_lease_sweep;
+    for (const auto& q : pop_waiters)
+      for (const auto& w : q.second)
+        if (w.has_deadline && w.deadline < next) next = w.deadline;
+    double dt = next - now;
+    if (dt < 0.0) dt = 0.0;
+    if (dt > 1.0) dt = 1.0;
+    return static_cast<int>(dt * 1000.0) + 1;
+  }
+
+  void run() {
+    std::vector<pollfd> pfds;
+    std::vector<Conn*> pconns;
+    for (;;) {
+      pfds.clear();
+      pconns.clear();
+      pfds.push_back({listen_fd, POLLIN, 0});
+      for (auto& kvp : conns) {
+        Conn* c = kvp.second.get();
+        short events = POLLIN;
+        if (c->woff < c->wbuf.size()) events |= POLLOUT;
+        pfds.push_back({c->fd, events, 0});
+        pconns.push_back(c);
+      }
+      int rc = ::poll(pfds.data(), pfds.size(), poll_timeout_ms());
+      if (rc < 0 && errno != EINTR) break;
+      sweep_timers();
+      if (rc > 0) {
+        if (pfds[0].revents & POLLIN) accept_new();
+        for (size_t k = 0; k < pconns.size(); ++k) {
+          Conn* c = pconns[k];
+          short re = pfds[k + 1].revents;
+          if (re & (POLLERR | POLLHUP | POLLNVAL)) c->dead = true;
+          if (!c->dead && (re & POLLIN)) handle_readable(*c);
+          if (!c->dead && (re & POLLOUT)) handle_writable(*c);
+        }
+      }
+      // flush anything dispatch produced on conns that weren't POLLOUT-armed
+      for (auto& kvp : conns) {
+        Conn* c = kvp.second.get();
+        if (!c->dead && c->woff < c->wbuf.size()) handle_writable(*c);
+      }
+      // reap dead conns
+      std::vector<int> dead;
+      for (auto& kvp : conns)
+        if (kvp.second->dead) dead.push_back(kvp.first);
+      for (int id : dead) {
+        drop_conn(*conns[id]);
+        conns.erase(id);
+      }
+    }
+  }
+
+  void accept_new() {
+    for (;;) {
+      int fd = ::accept(listen_fd, nullptr, nullptr);
+      if (fd < 0) {
+        if (errno == EMFILE || errno == ENFILE) {
+          // fd exhaustion: the pending conn stays in the backlog and
+          // poll() would spin on POLLIN — back off briefly instead
+          struct timespec ts{0, 50 * 1000 * 1000};
+          nanosleep(&ts, nullptr);
+        }
+        break;
+      }
+      set_nonblock(fd);
+      int one = 1;
+      setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      auto c = std::make_unique<Conn>();
+      c->fd = fd;
+      c->id = next_conn_id++;
+      conns[c->id] = std::move(c);
+    }
+  }
+};
+
+int main(int argc, char** argv) {
+  const char* host = "127.0.0.1";
+  int port = 0;
+  for (int k = 1; k < argc; ++k) {
+    if (!strcmp(argv[k], "--host") && k + 1 < argc) host = argv[++k];
+    else if (!strcmp(argv[k], "--port") && k + 1 < argc) port = atoi(argv[++k]);
+    else {
+      fprintf(stderr, "usage: dynamo-hubd [--host H] [--port P]\n");
+      return 2;
+    }
+  }
+  signal(SIGPIPE, SIG_IGN);
+  Hub hub;
+  if (!hub.listen(host, static_cast<uint16_t>(port))) {
+    fprintf(stderr, "dynamo-hubd: bind %s:%d failed: %s\n", host, port,
+            strerror(errno));
+    return 1;
+  }
+  printf("LISTENING %u\n", hub.port);
+  fflush(stdout);
+  hub.run();
+  return 0;
+}

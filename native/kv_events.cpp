@@ -1,0 +1,168 @@
+// libdynamo_kv_events: C-ABI KV-event publisher for engine integration.
+//
+// Native equivalent of the reference's C bindings
+// (reference: lib/bindings/c/src/lib.rs:52-297 — dynamo_llm_init /
+// dynamo_kv_event_publish_stored / _removed, loaded via ctypes by the
+// vLLM patch's event_manager.py). An external engine links (or dlopens)
+// this library and reports its prefix-cache block lifecycle; events land
+// on the hub subject "{ns}.{component}.kv_events" as msgpack RouterEvents
+// (dynamo_tpu/llm/kv_router/protocols.py), exactly what KvIndexer
+// subscribers consume.
+//
+// Deviation from the reference FFI: the reference's Rust lib hashes raw
+// token ids internally; here chained xxh3 hashing lives in the engine
+// layer (dynamo_tpu/llm/tokens.py), so the C API carries the computed
+// block/tokens hashes. Publishes are fire-and-forget frames (no "i"
+// request id -> the hub sends no reply), matching the event plane's
+// at-most-once semantics.
+//
+// Thread-safe: one internal mutex serializes socket writes.
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <pthread.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstring>
+#include <string>
+
+#include "msgpack.hpp"
+
+using msgpack::Value;
+
+namespace {
+
+struct State {
+  int fd = -1;
+  std::string subject;
+  long long worker_id = 0;
+  int block_size = 0;
+  pthread_mutex_t mu = PTHREAD_MUTEX_INITIALIZER;
+};
+
+State g_state;
+
+int send_frame(const Value& v) {
+  std::string buf = msgpack::frame_encode(v);
+  size_t off = 0;
+  while (off < buf.size()) {
+    ssize_t w = ::send(g_state.fd, buf.data() + off, buf.size() - off, MSG_NOSIGNAL);
+    if (w < 0) {
+      if (errno == EINTR) continue;
+      return -1;
+    }
+    off += static_cast<size_t>(w);
+  }
+  return 0;
+}
+
+int publish_event(Value event) {
+  // the whole build+send runs under the mutex: init() may rebind
+  // subject/worker_id/fd concurrently from another thread
+  pthread_mutex_lock(&g_state.mu);
+  event.set("block_size", Value::integer(g_state.block_size));
+  Value router = Value::mapv();
+  router.set("worker_id", Value::integer(g_state.worker_id));
+  router.set("event", std::move(event));
+
+  Value frame = Value::mapv();  // no "i": fire-and-forget, hub sends no reply
+  frame.set("op", Value::str("publish"));
+  frame.set("subject", Value::str(g_state.subject));
+  frame.set("data", Value::bin(msgpack::pack(router)));
+
+  int rc = g_state.fd >= 0 ? send_frame(frame) : -1;
+  pthread_mutex_unlock(&g_state.mu);
+  return rc;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Connect to the hub and bind the publisher identity. Returns 0 on
+// success, negative errno-style codes on failure.
+int dyn_llm_init(const char* host, int port, const char* ns,
+                 const char* component, long long worker_id,
+                 int kv_block_size) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+    close(fd);
+    return -2;
+  }
+  if (connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0) {
+    close(fd);
+    return -3;
+  }
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  pthread_mutex_lock(&g_state.mu);
+  if (g_state.fd >= 0) close(g_state.fd);
+  g_state.fd = fd;
+  g_state.subject = std::string(ns) + "." + component + ".kv_events";
+  g_state.worker_id = worker_id;
+  g_state.block_size = kv_block_size;
+  pthread_mutex_unlock(&g_state.mu);
+  return 0;
+}
+
+// Publish a "stored" event: num_blocks parent-chained blocks entering the
+// worker's prefix cache. parent_hash is ignored when has_parent == 0
+// (a root block). page_ids may be NULL.
+int dyn_kv_event_publish_stored(unsigned long long event_id,
+                                unsigned long long parent_hash, int has_parent,
+                                const unsigned long long* block_hashes,
+                                const unsigned long long* tokens_hashes,
+                                const int* page_ids, int num_blocks) {
+  if (num_blocks < 0 || !block_hashes || !tokens_hashes) return -4;
+  Value blocks = Value::array();
+  for (int k = 0; k < num_blocks; ++k) {
+    Value b = Value::mapv();
+    b.set("block_hash", Value::uinteger(block_hashes[k]));
+    b.set("tokens_hash", Value::uinteger(tokens_hashes[k]));
+    b.set("page_id", Value::integer(page_ids ? page_ids[k] : 0));
+    blocks.arr.push_back(std::move(b));
+  }
+  Value ev = Value::mapv();
+  ev.set("type", Value::str("stored"));
+  ev.set("event_id", Value::uinteger(event_id));
+  ev.set("parent_hash", has_parent ? Value::uinteger(parent_hash) : Value::nil());
+  ev.set("blocks", std::move(blocks));
+  ev.set("block_hashes", Value::array());
+  ev.set("tier", Value::str("device"));
+  return publish_event(std::move(ev));  // block_size added under the mutex
+}
+
+// Publish a "removed" event: blocks leaving the worker's prefix cache.
+int dyn_kv_event_publish_removed(unsigned long long event_id,
+                                 const unsigned long long* block_hashes,
+                                 int num_blocks) {
+  if (num_blocks < 0 || !block_hashes) return -4;
+  Value hashes = Value::array();
+  for (int k = 0; k < num_blocks; ++k)
+    hashes.arr.push_back(Value::uinteger(block_hashes[k]));
+  Value ev = Value::mapv();
+  ev.set("type", Value::str("removed"));
+  ev.set("event_id", Value::uinteger(event_id));
+  ev.set("parent_hash", Value::nil());
+  ev.set("blocks", Value::array());
+  ev.set("block_hashes", std::move(hashes));
+  ev.set("tier", Value::str("device"));
+  return publish_event(std::move(ev));  // block_size added under the mutex
+}
+
+void dyn_llm_shutdown() {
+  pthread_mutex_lock(&g_state.mu);
+  if (g_state.fd >= 0) {
+    close(g_state.fd);
+    g_state.fd = -1;
+  }
+  pthread_mutex_unlock(&g_state.mu);
+}
+
+}  // extern "C"

@@ -1,0 +1,326 @@
+// Minimal msgpack value + codec for the dynamo-tpu native runtime.
+//
+// Covers exactly the wire subset msgpack-python (use_bin_type=True,
+// raw=False) produces for the hub protocol (dynamo_tpu/runtime/hub/codec.py):
+// nil, bool, int/uint (all widths), float32/64, str, bin, array, map.
+// Faithful int-vs-uint roundtrip matters because 64-bit block hashes can
+// exceed int64. Header-only; no external dependencies.
+
+#pragma once
+
+#include <cstdint>
+#include <cstring>
+#include <stdexcept>
+#include <string>
+#include <utility>
+#include <vector>
+
+namespace msgpack {
+
+struct Value;
+using Map = std::vector<std::pair<Value, Value>>;
+using Array = std::vector<Value>;
+
+struct Value {
+  enum class Type : uint8_t { Nil, Bool, Int, Uint, Float, Str, Bin, Arr, MapT };
+  Type type = Type::Nil;
+  bool b = false;
+  int64_t i = 0;
+  uint64_t u = 0;
+  double d = 0.0;
+  std::string s;  // str and bin payloads
+  Array arr;
+  Map map;
+
+  Value() = default;
+  static Value nil() { return Value(); }
+  static Value boolean(bool v) { Value x; x.type = Type::Bool; x.b = v; return x; }
+  static Value integer(int64_t v) { Value x; x.type = Type::Int; x.i = v; return x; }
+  static Value uinteger(uint64_t v) { Value x; x.type = Type::Uint; x.u = v; return x; }
+  static Value real(double v) { Value x; x.type = Type::Float; x.d = v; return x; }
+  static Value str(std::string v) { Value x; x.type = Type::Str; x.s = std::move(v); return x; }
+  static Value bin(std::string v) { Value x; x.type = Type::Bin; x.s = std::move(v); return x; }
+  static Value array(Array v = {}) { Value x; x.type = Type::Arr; x.arr = std::move(v); return x; }
+  static Value mapv(Map v = {}) { Value x; x.type = Type::MapT; x.map = std::move(v); return x; }
+
+  bool is_nil() const { return type == Type::Nil; }
+  bool is_str() const { return type == Type::Str; }
+  bool is_bin() const { return type == Type::Bin; }
+  bool is_int() const { return type == Type::Int || type == Type::Uint; }
+  bool is_map() const { return type == Type::MapT; }
+
+  int64_t as_int() const {
+    if (type == Type::Int) return i;
+    if (type == Type::Uint) return static_cast<int64_t>(u);
+    if (type == Type::Float) return static_cast<int64_t>(d);
+    throw std::runtime_error("msgpack: not an int");
+  }
+  double as_double() const {
+    if (type == Type::Float) return d;
+    if (type == Type::Int) return static_cast<double>(i);
+    if (type == Type::Uint) return static_cast<double>(u);
+    throw std::runtime_error("msgpack: not a number");
+  }
+  const std::string& as_str() const {
+    if (type != Type::Str) throw std::runtime_error("msgpack: not a str");
+    return s;
+  }
+  const std::string& as_bytes() const {  // str or bin
+    if (type != Type::Str && type != Type::Bin)
+      throw std::runtime_error("msgpack: not bytes");
+    return s;
+  }
+  bool truthy() const {
+    switch (type) {
+      case Type::Nil: return false;
+      case Type::Bool: return b;
+      case Type::Int: return i != 0;
+      case Type::Uint: return u != 0;
+      case Type::Float: return d != 0.0;
+      default: return true;
+    }
+  }
+
+  // map access; returns nil for missing keys (mirrors dict.get)
+  const Value& get(const std::string& key) const {
+    static const Value knil;
+    if (type != Type::MapT) return knil;
+    for (const auto& kv : map)
+      if (kv.first.type == Type::Str && kv.first.s == key) return kv.second;
+    return knil;
+  }
+  void set(const std::string& key, Value v) {
+    if (type != Type::MapT) { type = Type::MapT; }
+    for (auto& kv : map)
+      if (kv.first.type == Type::Str && kv.first.s == key) {
+        kv.second = std::move(v);
+        return;
+      }
+    map.emplace_back(Value::str(key), std::move(v));
+  }
+};
+
+// ------------------------------------------------------------------ encoding
+
+inline void pack_into(std::string& out, const Value& v);
+
+inline void put_be(std::string& out, uint64_t x, int nbytes) {
+  for (int k = nbytes - 1; k >= 0; --k)
+    out.push_back(static_cast<char>((x >> (8 * k)) & 0xff));
+}
+
+inline void pack_uint(std::string& out, uint64_t x) {
+  if (x < 0x80) {
+    out.push_back(static_cast<char>(x));
+  } else if (x <= 0xff) {
+    out.push_back(static_cast<char>(0xcc)); put_be(out, x, 1);
+  } else if (x <= 0xffff) {
+    out.push_back(static_cast<char>(0xcd)); put_be(out, x, 2);
+  } else if (x <= 0xffffffffULL) {
+    out.push_back(static_cast<char>(0xce)); put_be(out, x, 4);
+  } else {
+    out.push_back(static_cast<char>(0xcf)); put_be(out, x, 8);
+  }
+}
+
+inline void pack_int(std::string& out, int64_t x) {
+  if (x >= 0) { pack_uint(out, static_cast<uint64_t>(x)); return; }
+  if (x >= -32) {
+    out.push_back(static_cast<char>(x));
+  } else if (x >= INT8_MIN) {
+    out.push_back(static_cast<char>(0xd0)); put_be(out, static_cast<uint8_t>(x), 1);
+  } else if (x >= INT16_MIN) {
+    out.push_back(static_cast<char>(0xd1)); put_be(out, static_cast<uint16_t>(x), 2);
+  } else if (x >= INT32_MIN) {
+    out.push_back(static_cast<char>(0xd2)); put_be(out, static_cast<uint32_t>(x), 4);
+  } else {
+    out.push_back(static_cast<char>(0xd3)); put_be(out, static_cast<uint64_t>(x), 8);
+  }
+}
+
+inline void pack_into(std::string& out, const Value& v) {
+  using T = Value::Type;
+  switch (v.type) {
+    case T::Nil: out.push_back(static_cast<char>(0xc0)); break;
+    case T::Bool: out.push_back(static_cast<char>(v.b ? 0xc3 : 0xc2)); break;
+    case T::Int: pack_int(out, v.i); break;
+    case T::Uint: pack_uint(out, v.u); break;
+    case T::Float: {
+      out.push_back(static_cast<char>(0xcb));
+      uint64_t bits;
+      static_assert(sizeof(bits) == sizeof(v.d), "double width");
+      std::memcpy(&bits, &v.d, 8);
+      put_be(out, bits, 8);
+      break;
+    }
+    case T::Str: {
+      size_t n = v.s.size();
+      if (n < 32) out.push_back(static_cast<char>(0xa0 | n));
+      else if (n <= 0xff) { out.push_back(static_cast<char>(0xd9)); put_be(out, n, 1); }
+      else if (n <= 0xffff) { out.push_back(static_cast<char>(0xda)); put_be(out, n, 2); }
+      else { out.push_back(static_cast<char>(0xdb)); put_be(out, n, 4); }
+      out.append(v.s);
+      break;
+    }
+    case T::Bin: {
+      size_t n = v.s.size();
+      if (n <= 0xff) { out.push_back(static_cast<char>(0xc4)); put_be(out, n, 1); }
+      else if (n <= 0xffff) { out.push_back(static_cast<char>(0xc5)); put_be(out, n, 2); }
+      else { out.push_back(static_cast<char>(0xc6)); put_be(out, n, 4); }
+      out.append(v.s);
+      break;
+    }
+    case T::Arr: {
+      size_t n = v.arr.size();
+      if (n < 16) out.push_back(static_cast<char>(0x90 | n));
+      else if (n <= 0xffff) { out.push_back(static_cast<char>(0xdc)); put_be(out, n, 2); }
+      else { out.push_back(static_cast<char>(0xdd)); put_be(out, n, 4); }
+      for (const auto& e : v.arr) pack_into(out, e);
+      break;
+    }
+    case T::MapT: {
+      size_t n = v.map.size();
+      if (n < 16) out.push_back(static_cast<char>(0x80 | n));
+      else if (n <= 0xffff) { out.push_back(static_cast<char>(0xde)); put_be(out, n, 2); }
+      else { out.push_back(static_cast<char>(0xdf)); put_be(out, n, 4); }
+      for (const auto& kv : v.map) {
+        pack_into(out, kv.first);
+        pack_into(out, kv.second);
+      }
+      break;
+    }
+  }
+}
+
+inline std::string pack(const Value& v) {
+  std::string out;
+  pack_into(out, v);
+  return out;
+}
+
+// One frame as sent on the wire: 4-byte big-endian length + msgpack body
+// (matches dynamo_tpu/runtime/hub/codec.py).
+inline std::string frame_encode(const Value& v) {
+  std::string payload = pack(v);
+  std::string out;
+  out.reserve(payload.size() + 4);
+  put_be(out, payload.size(), 4);
+  out.append(payload);
+  return out;
+}
+
+// ------------------------------------------------------------------ decoding
+
+struct Reader {
+  const uint8_t* p;
+  size_t n;
+  size_t off = 0;
+
+  uint8_t byte() {
+    if (off >= n) throw std::runtime_error("msgpack: truncated");
+    return p[off++];
+  }
+  uint64_t be(int nbytes) {
+    if (off + nbytes > n) throw std::runtime_error("msgpack: truncated");
+    uint64_t x = 0;
+    for (int k = 0; k < nbytes; ++k) x = (x << 8) | p[off + k];
+    off += nbytes;
+    return x;
+  }
+  std::string bytes(size_t len) {
+    if (off + len > n) throw std::runtime_error("msgpack: truncated");
+    std::string s(reinterpret_cast<const char*>(p + off), len);
+    off += len;
+    return s;
+  }
+};
+
+inline Value unpack_one(Reader& r, int depth = 0) {
+  if (depth > 64) throw std::runtime_error("msgpack: nesting too deep");
+  uint8_t c = r.byte();
+  if (c < 0x80) return Value::integer(c);                        // pos fixint
+  if (c >= 0xe0) return Value::integer(static_cast<int8_t>(c));  // neg fixint
+  if ((c & 0xf0) == 0x80) {  // fixmap
+    Value v = Value::mapv();
+    size_t cnt = c & 0x0f;
+    for (size_t k = 0; k < cnt; ++k) {
+      Value key = unpack_one(r, depth + 1);
+      v.map.emplace_back(std::move(key), unpack_one(r, depth + 1));
+    }
+    return v;
+  }
+  if ((c & 0xf0) == 0x90) {  // fixarray
+    Value v = Value::array();
+    size_t cnt = c & 0x0f;
+    for (size_t k = 0; k < cnt; ++k) v.arr.push_back(unpack_one(r, depth + 1));
+    return v;
+  }
+  if ((c & 0xe0) == 0xa0) return Value::str(r.bytes(c & 0x1f));  // fixstr
+  switch (c) {
+    case 0xc0: return Value::nil();
+    case 0xc2: return Value::boolean(false);
+    case 0xc3: return Value::boolean(true);
+    case 0xc4: return Value::bin(r.bytes(r.be(1)));
+    case 0xc5: return Value::bin(r.bytes(r.be(2)));
+    case 0xc6: return Value::bin(r.bytes(r.be(4)));
+    case 0xca: {
+      uint32_t bits = static_cast<uint32_t>(r.be(4));
+      float f;
+      std::memcpy(&f, &bits, 4);
+      return Value::real(f);
+    }
+    case 0xcb: {
+      uint64_t bits = r.be(8);
+      double d;
+      std::memcpy(&d, &bits, 8);
+      return Value::real(d);
+    }
+    case 0xcc: return Value::integer(static_cast<int64_t>(r.be(1)));
+    case 0xcd: return Value::integer(static_cast<int64_t>(r.be(2)));
+    case 0xce: return Value::integer(static_cast<int64_t>(r.be(4)));
+    case 0xcf: {
+      uint64_t x = r.be(8);
+      if (x > static_cast<uint64_t>(INT64_MAX)) return Value::uinteger(x);
+      return Value::integer(static_cast<int64_t>(x));
+    }
+    case 0xd0: return Value::integer(static_cast<int8_t>(r.be(1)));
+    case 0xd1: return Value::integer(static_cast<int16_t>(r.be(2)));
+    case 0xd2: return Value::integer(static_cast<int32_t>(r.be(4)));
+    case 0xd3: return Value::integer(static_cast<int64_t>(r.be(8)));
+    case 0xd9: return Value::str(r.bytes(r.be(1)));
+    case 0xda: return Value::str(r.bytes(r.be(2)));
+    case 0xdb: return Value::str(r.bytes(r.be(4)));
+    case 0xdc: {
+      Value v = Value::array();
+      size_t cnt = r.be(2);
+      for (size_t k = 0; k < cnt; ++k) v.arr.push_back(unpack_one(r, depth + 1));
+      return v;
+    }
+    case 0xdd: {
+      Value v = Value::array();
+      size_t cnt = r.be(4);
+      for (size_t k = 0; k < cnt; ++k) v.arr.push_back(unpack_one(r, depth + 1));
+      return v;
+    }
+    case 0xde: case 0xdf: {
+      Value v = Value::mapv();
+      size_t cnt = r.be(c == 0xde ? 2 : 4);
+      for (size_t k = 0; k < cnt; ++k) {
+        Value key = unpack_one(r, depth + 1);
+        v.map.emplace_back(std::move(key), unpack_one(r, depth + 1));
+      }
+      return v;
+    }
+    default:
+      throw std::runtime_error("msgpack: unsupported tag " + std::to_string(c));
+  }
+}
+
+inline Value unpack(const void* data, size_t len) {
+  Reader r{static_cast<const uint8_t*>(data), len};
+  Value v = unpack_one(r);
+  if (r.off != r.n) throw std::runtime_error("msgpack: trailing bytes");
+  return v;
+}
+
+}  // namespace msgpack

@@ -1,0 +1,242 @@
+"""Native (C++) hub daemon + C-FFI KV-event publisher.
+
+dynamo-hubd (native/hubd.cpp) must be a drop-in for the asyncio
+HubServer: every test here drives it through the unmodified Python
+HubClient over the real wire protocol — KV/lease/watch, pub/sub,
+competing-consumer queues, object store — then the C event library
+(native/kv_events.cpp) publishes RouterEvents a Python subscriber
+decodes. Mirrors the reference's binding tests, which spawn real
+nats-server/etcd subprocesses (SURVEY.md §4, test_kv_bindings.py)."""
+
+import asyncio
+import contextlib
+
+import msgpack
+import pytest
+
+from dynamo_tpu.llm.kv_router.protocols import RouterEvent
+from dynamo_tpu.runtime.hub import native
+from dynamo_tpu.runtime.hub.client import HubClient, HubError
+
+pytestmark = pytest.mark.skipif(
+    __import__("shutil").which("g++") is None, reason="g++ unavailable"
+)
+
+
+@contextlib.asynccontextmanager
+async def native_hub():
+    proc, port = native.spawn_hub()
+    client = await HubClient.connect(f"127.0.0.1:{port}")
+    try:
+        yield client, port
+    finally:
+        await client.close()
+        proc.terminate()
+        proc.wait(timeout=5)
+
+
+async def test_kv_roundtrip_and_transactions():
+    async with native_hub() as (c, _):
+        rev1 = await c.kv_put("a/x", b"1")
+        rev2 = await c.kv_put("a/y", b"2")
+        assert rev2 > rev1
+        got = await c.kv_get("a/x")
+        assert got["value"] == b"1" and got["lease"] == 0
+        assert await c.kv_get("missing") is None
+        pairs = await c.kv_get_prefix("a/")
+        assert {p["key"]: p["value"] for p in pairs} == {"a/x": b"1", "a/y": b"2"}
+        # create-if-absent + create-or-validate (etcd txn semantics)
+        assert await c.kv_create("a/x", b"other") is False
+        assert await c.kv_create("a/z", b"3") is True
+        assert await c.kv_create_or_validate("a/z", b"3") is True
+        assert await c.kv_create_or_validate("a/z", b"NOT3") is False
+        assert await c.kv_del("a/", prefix=True) == 3
+        assert await c.kv_get_prefix("a/") == []
+
+
+async def test_watch_snapshot_and_events():
+    async with native_hub() as (c, _):
+        await c.kv_put("w/pre", b"0")
+        watch = await c.watch_prefix("w/")
+        assert [e["key"] for e in watch.snapshot] == ["w/pre"]
+        await c.kv_put("w/live", b"1")
+        ev = await asyncio.wait_for(watch.events.get(), 5)
+        assert (ev["type"], ev["key"], ev["value"]) == ("put", "w/live", b"1")
+        await c.kv_del("w/live")
+        ev = await asyncio.wait_for(watch.events.get(), 5)
+        assert (ev["type"], ev["key"], ev["value"]) == ("delete", "w/live", None)
+        await watch.cancel()
+
+
+async def test_lease_expiry_purges_keys_and_fires_watch():
+    async with native_hub() as (c, _):
+        lease = await c.lease_grant(ttl=0.6, keepalive=False)
+        await c.kv_put("inst/worker", b"me", lease=lease)
+        watch = await c.watch_prefix("inst/")
+        assert len(watch.snapshot) == 1
+        assert await lease.is_valid()
+        ev = await asyncio.wait_for(watch.events.get(), 5)  # TTL expiry
+        assert ev["type"] == "delete" and ev["key"] == "inst/worker"
+        assert not await lease.is_valid()
+        assert await c.kv_get("inst/worker") is None
+
+
+async def test_lease_keepalive_and_revoke():
+    async with native_hub() as (c, _):
+        lease = await c.lease_grant(ttl=0.5, keepalive=True)
+        await c.kv_put("ka/x", b"1", lease=lease)
+        await asyncio.sleep(1.2)  # outlives TTL only because keepalives flow
+        assert await lease.is_valid()
+        await lease.revoke()
+        assert not await lease.is_valid()
+        assert await c.kv_get("ka/x") is None
+
+
+async def test_pubsub_wildcard():
+    async with native_hub() as (c, _):
+        sub = await c.subscribe("ns.comp.>")
+        exact = await c.subscribe("ns.comp.kv_events")
+        n = await c.publish("ns.comp.kv_events", b"payload")
+        assert n == 2
+        for s in (sub, exact):
+            ev = await asyncio.wait_for(s.events.get(), 5)
+            assert ev["subject"] == "ns.comp.kv_events"
+            assert ev["data"] == b"payload"
+        assert await c.publish("other.comp.kv_events", b"x") == 0
+
+
+async def test_queues_blocking_and_competing():
+    async with native_hub() as (c, _):
+        # non-blocking pop on empty
+        assert await c.q_pop("q1", block=False) is None
+        assert await c.q_push("q1", b"a") == 1
+        assert await c.q_pop("q1", block=False) == b"a"
+        # blocking pop answered by a later push
+        popper = asyncio.create_task(c.q_pop("q1", block=True, timeout=5))
+        await asyncio.sleep(0.1)
+        await c.q_push("q1", b"b")
+        assert await asyncio.wait_for(popper, 5) == b"b"
+        # blocking pop times out -> None
+        assert await c.q_pop("q1", block=True, timeout=0.3) is None
+        # competing consumers: each item delivered exactly once
+        c2 = await HubClient.connect(c.addr)
+        try:
+            p1 = asyncio.create_task(c.q_pop("q2", block=True, timeout=5))
+            p2 = asyncio.create_task(c2.q_pop("q2", block=True, timeout=5))
+            await asyncio.sleep(0.1)
+            await c.q_push("q2", b"i1")
+            await c.q_push("q2", b"i2")
+            got = {await asyncio.wait_for(p1, 5), await asyncio.wait_for(p2, 5)}
+            assert got == {b"i1", b"i2"}
+        finally:
+            await c2.close()
+        assert await c.q_len("q2") == 0
+
+
+async def test_object_store_and_stats():
+    async with native_hub() as (c, _):
+        assert await c.obj_put("bucket", "card.json", b"{}") is True
+        assert await c.obj_get("bucket", "card.json") == b"{}"
+        assert await c.obj_list("bucket") == ["card.json"]
+        assert await c.obj_del("bucket", "card.json") is True
+        assert await c.obj_get("bucket", "card.json") is None
+        stats = await c.stats()
+        assert stats["conns"] >= 1 and "revision" in stats
+
+
+async def test_error_reply():
+    async with native_hub() as (c, _):
+        with pytest.raises(HubError):
+            await c.request("kv_put", key="x", value=b"1", lease=0xDEAD)
+        with pytest.raises(HubError):
+            await c.request("no_such_op")
+
+
+async def test_c_ffi_publisher_roundtrip():
+    """The C library's events parse as RouterEvents — wire-compatible with
+    the in-process KvEventPublisher (u64 hashes above int64 included)."""
+    from dynamo_tpu.llm.kv_router.c_ffi import NativeKvEventPublisher
+
+    async with native_hub() as (c, port):
+        sub = await c.subscribe("ns.worker.kv_events")
+        pub = await asyncio.to_thread(
+            NativeKvEventPublisher, "127.0.0.1", port, "ns", "worker", 42, 16
+        )
+        try:
+            big = 2**63 + 12345  # exceeds int64: must survive as uint64
+            await asyncio.to_thread(
+                pub.publish_stored, 1, [(big, 111, 7), (1002, 222, 8)],
+                parent_hash=None,
+            )
+            ev = await asyncio.wait_for(sub.events.get(), 5)
+            router = RouterEvent.from_dict(msgpack.unpackb(ev["data"], raw=False))
+            assert router.worker_id == 42
+            assert router.event.type == "stored"
+            assert router.event.parent_hash is None
+            assert router.event.block_size == 16
+            assert [(b.block_hash, b.tokens_hash, b.page_id)
+                    for b in router.event.blocks] == [(big, 111, 7), (1002, 222, 8)]
+
+            await asyncio.to_thread(pub.publish_removed, 2, [big, 1002])
+            ev = await asyncio.wait_for(sub.events.get(), 5)
+            router = RouterEvent.from_dict(msgpack.unpackb(ev["data"], raw=False))
+            assert router.event.type == "removed"
+            assert router.event.block_hashes == [big, 1002]
+        finally:
+            pub.close()
+
+
+async def test_frames_coalesced_with_fin_are_processed():
+    """Fire-and-forget frames sent immediately before close() must still
+    take effect even when data and FIN arrive in one read batch (the C
+    publisher's shutdown pattern)."""
+    from dynamo_tpu.runtime.hub import codec
+
+    async with native_hub() as (c, port):
+        sub = await c.subscribe("f.>")
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        writer.write(
+            codec.encode_frame({"op": "publish", "subject": "f.x", "data": b"hi"})
+        )
+        writer.close()  # FIN rides right behind the frame
+        ev = await asyncio.wait_for(sub.events.get(), 5)
+        assert ev["data"] == b"hi"
+        reader.feed_eof()
+
+
+async def test_distributed_runtime_against_native_hub():
+    """The full component runtime (discovery, lease-attached endpoints,
+    request/response data plane) serves through the native hub unchanged."""
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+    from dynamo_tpu.runtime.pipeline.context import Context
+    from dynamo_tpu.runtime.pipeline.engine import LambdaEngine
+
+    proc, port = native.spawn_hub()
+    try:
+        worker = await DistributedRuntime.from_settings(hub_addr=f"127.0.0.1:{port}")
+        frontend = await DistributedRuntime.from_settings(hub_addr=f"127.0.0.1:{port}")
+        try:
+            ep = worker.namespace("nh").component("echo").endpoint("generate")
+
+            async def gen(ctx: Context):
+                for t in ctx.payload["tokens"]:
+                    yield {"tok": t}
+
+            served = await ep.serve_engine(LambdaEngine(gen))
+            client = await (
+                frontend.namespace("nh").component("echo").endpoint("generate")
+            ).client()
+            await client.wait_for_instances(timeout=10)
+            ctx = Context({"tokens": [1, 2, 3]})
+            out = [
+                f async for f in await client.generate(ctx.payload, context=ctx)
+            ]
+            assert [f["tok"] for f in out] == [1, 2, 3]
+            await served.shutdown()
+            await client.close()
+        finally:
+            await frontend.shutdown()
+            await worker.shutdown()
+    finally:
+        proc.terminate()
+        proc.wait(timeout=5)
