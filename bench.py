@@ -29,6 +29,7 @@ ISL = int(os.environ.get("BENCH_ISL", "512"))
 OSL = int(os.environ.get("BENCH_OSL", "64"))
 CONCURRENCY = int(os.environ.get("BENCH_CONCURRENCY", "256"))
 DECODE_STEPS = int(os.environ.get("BENCH_DECODE_STEPS", "16"))
+PREFILL_GROUP = int(os.environ.get("BENCH_PREFILL_GROUP", "32768"))
 # int8 W8A8 serving is the default protocol: the reference's baselines
 # serve FP8 on H100 (BASELINE.md "70B FP8"), so the quantized path is the
 # apples-to-apples configuration. BENCH_QUANT=none for bf16.
@@ -60,6 +61,7 @@ def main() -> None:
             max_model_len=ISL + OSL + 32,
             prefill_chunk=ISL,
             decode_steps=DECODE_STEPS,
+            prefill_group_tokens=PREFILL_GROUP,
             quantization=QUANT,
         )
     )

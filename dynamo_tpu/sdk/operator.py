@@ -1,0 +1,229 @@
+"""Graph deployment operator: declarative specs reconciled to processes.
+
+The reference ships a Go Kubernetes operator whose CRDs
+(`DynamoGraphDeployment` / `DynamoComponentDeployment`, reference:
+deploy/dynamo/operator/api/v1alpha1/*.go) a controller reconciles into
+Deployments (dynamocomponentdeployment_controller.go, ~1.6k lines).
+This is the hub-native equivalent of that control loop: deployment
+specs are documents under the KV prefix ``deploy/graphs/{name}``, a
+watcher-driven reconciler converges running Supervisors (process
+groups, sdk/supervisor.py) to the declared state:
+
+- spec created  -> load the graph entry, start a Supervisor
+- replica count changed -> live scale the service's Watcher
+- entry changed -> replace (teardown + recreate)
+- spec deleted  -> graceful teardown
+
+Spec document (JSON):
+    {"entry": "examples/llm/graphs/agg.py:Frontend",
+     "services": {"Worker": {"workers": 2, "tpu": 1}, ...}}
+
+CLI (the `kubectl apply` analogue, reference llmctl/deploy flow):
+    python -m dynamo_tpu.sdk.operator run   --hub HOST:PORT
+    python -m dynamo_tpu.sdk.operator apply --hub HOST:PORT name spec.json
+    python -m dynamo_tpu.sdk.operator delete --hub HOST:PORT name
+    python -m dynamo_tpu.sdk.operator list  --hub HOST:PORT
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+from typing import Optional
+
+from dynamo_tpu.runtime.hub.client import HubClient
+from dynamo_tpu.sdk.config import ServiceConfig
+from dynamo_tpu.sdk.supervisor import Supervisor, load_entry
+from dynamo_tpu.utils.logging import configure_logging, get_logger
+
+log = get_logger("dynamo_tpu.operator")
+
+GRAPH_PREFIX = "deploy/graphs/"
+
+
+class GraphOperator:
+    """Reconciles ``deploy/graphs/*`` specs into running Supervisors."""
+
+    def __init__(self, hub_addr: str, extra_env: Optional[dict] = None):
+        self.hub_addr = hub_addr
+        self.extra_env = dict(extra_env or {})
+        self.deployments: dict[str, tuple[dict, Supervisor]] = {}
+        self._client: Optional[HubClient] = None
+        self._watch = None
+        self._task: Optional[asyncio.Task] = None
+
+    # ------------------------------------------------------------ lifecycle
+
+    async def start(self) -> None:
+        self._client = await HubClient.connect(self.hub_addr)
+        self._watch = await self._client.watch_prefix(GRAPH_PREFIX)
+        for entry in self._watch.snapshot:
+            name = self._name_of(entry["key"])
+            try:
+                await self._apply(name, entry["value"])
+            except Exception:  # noqa: BLE001 — a bad persisted spec must
+                # not crash-loop the operator on restart; skip it and
+                # deploy the healthy ones (same guard as _loop)
+                log.exception("initial reconcile of %r failed", name)
+        self._task = asyncio.create_task(self._loop())
+
+    async def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+        for name in list(self.deployments):
+            await self._teardown(name)
+        if self._watch:
+            await self._watch.cancel()
+            self._watch = None
+        if self._client:
+            await self._client.close()
+            self._client = None
+
+    async def _loop(self) -> None:
+        async for ev in self._watch:
+            name = self._name_of(ev["key"])
+            try:
+                if ev["type"] == "put":
+                    await self._apply(name, ev["value"])
+                elif ev["type"] == "delete":
+                    await self._teardown(name)
+            except Exception:  # noqa: BLE001 — reconciler must survive bad specs
+                log.exception("reconcile of %r failed", name)
+
+    @staticmethod
+    def _name_of(key: str) -> str:
+        return key[len(GRAPH_PREFIX):]
+
+    # ------------------------------------------------------------ reconcile
+
+    async def _apply(self, name: str, raw: bytes) -> None:
+        spec = json.loads(raw)
+        current = self.deployments.get(name)
+        if current is not None:
+            old_spec, sup = current
+            if old_spec.get("entry") == spec.get("entry"):
+                # converge replica counts in place (the controller's
+                # no-restart path, reference controller Update branch)
+                for svc, svc_spec in (spec.get("services") or {}).items():
+                    want = int(svc_spec.get("workers", 1))
+                    watcher = sup.watchers.get(svc)
+                    if watcher is not None and watcher.numprocesses != want:
+                        log.info("%s/%s: scale %d -> %d", name, svc,
+                                 watcher.numprocesses, want)
+                        await sup.scale(svc, want)
+                self.deployments[name] = (spec, sup)
+                return
+            log.info("%s: entry changed; replacing deployment", name)
+            await self._teardown(name)
+
+        entry_ident = spec["entry"]
+        entry_cls = load_entry(entry_ident)
+        cfg = ServiceConfig(spec.get("services") or {})
+        sup = Supervisor.for_graph(
+            entry_ident, entry_cls, config=cfg, hub_addr=self.hub_addr
+        )
+        for w in sup.watchers.values():
+            w.env.update(self.extra_env)
+        await sup.start()
+        self.deployments[name] = (spec, sup)
+        log.info("%s: deployed %s (%s)", name, entry_ident,
+                 {s: w.numprocesses for s, w in sup.watchers.items()})
+
+    async def _teardown(self, name: str) -> None:
+        current = self.deployments.pop(name, None)
+        if current is None:
+            return
+        _, sup = current
+        await sup.stop()
+        log.info("%s: torn down", name)
+
+
+# ------------------------------------------------------------------ CLI
+
+
+async def _cmd_run(args) -> int:
+    op = GraphOperator(args.hub)
+    await op.start()
+    log.info("operator watching %s on hub %s", GRAPH_PREFIX, args.hub)
+    stop = asyncio.Event()
+    import signal
+
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+    await op.stop()
+    return 0
+
+
+async def _cmd_apply(args) -> int:
+    with open(args.spec) as f:
+        spec = json.load(f)
+    if "entry" not in spec:
+        print("spec must contain 'entry'", file=sys.stderr)
+        return 2
+    client = await HubClient.connect(args.hub)
+    try:
+        await client.kv_put(GRAPH_PREFIX + args.name, json.dumps(spec).encode())
+    finally:
+        await client.close()
+    print(f"applied {args.name}")
+    return 0
+
+
+async def _cmd_delete(args) -> int:
+    client = await HubClient.connect(args.hub)
+    try:
+        n = await client.kv_del(GRAPH_PREFIX + args.name)
+    finally:
+        await client.close()
+    print(f"deleted {args.name}" if n else f"{args.name} not found")
+    return 0 if n else 1
+
+
+async def _cmd_list(args) -> int:
+    client = await HubClient.connect(args.hub)
+    try:
+        for entry in await client.kv_get_prefix(GRAPH_PREFIX):
+            spec = json.loads(entry["value"])
+            services = {
+                s: c.get("workers", 1)
+                for s, c in (spec.get("services") or {}).items()
+            }
+            print(f"{entry['key'][len(GRAPH_PREFIX):]}\t{spec['entry']}\t{services}")
+    finally:
+        await client.close()
+    return 0
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    configure_logging()
+    p = argparse.ArgumentParser(prog="dynamo_tpu.sdk.operator")
+    p.add_argument("--hub", default=None, help="hub address host:port")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    sub.add_parser("run")
+    ap = sub.add_parser("apply")
+    ap.add_argument("name")
+    ap.add_argument("spec", help="JSON spec file")
+    dp = sub.add_parser("delete")
+    dp.add_argument("name")
+    sub.add_parser("list")
+    args = p.parse_args(argv)
+    if args.hub is None:
+        from dynamo_tpu.runtime.hub.client import hub_addr_from_env
+
+        args.hub = hub_addr_from_env()
+    cmd = {"run": _cmd_run, "apply": _cmd_apply,
+           "delete": _cmd_delete, "list": _cmd_list}[args.cmd]
+    return asyncio.run(cmd(args))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

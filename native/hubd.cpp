@@ -396,12 +396,26 @@ class Hub {
         reply(c, m, Value::integer(n));
       } else if (op == "q_push") {
         const std::string name = m.get("name").as_str();
+        // hand to the first waiter whose connection is still live —
+        // dead-but-unreaped waiters must not eat the item (server.py
+        // skips done futures the same way)
+        bool delivered = false;
         auto wit = pop_waiters.find(name);
-        if (wit != pop_waiters.end() && !wit->second.empty()) {
-          PopWaiter w = wit->second.front();
-          wit->second.erase(wit->second.begin());
-          if (wit->second.empty()) pop_waiters.erase(wit);
-          answer_pop(w, m.get("data"));
+        if (wit != pop_waiters.end()) {
+          auto& v = wit->second;
+          while (!v.empty()) {
+            PopWaiter w = v.front();
+            v.erase(v.begin());
+            auto cit = conns.find(w.conn_id);
+            if (cit != conns.end() && !cit->second->dead) {
+              answer_pop(w, m.get("data"));
+              delivered = true;
+              break;
+            }
+          }
+          if (v.empty()) pop_waiters.erase(wit);
+        }
+        if (delivered) {
           reply(c, m, Value::integer(0));
         } else {
           auto& q = queues[name];

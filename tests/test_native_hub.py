@@ -186,6 +186,58 @@ async def test_c_ffi_publisher_roundtrip():
             pub.close()
 
 
+async def test_native_hub_soak():
+    """Hundreds of interleaved ops across several connections: pub/sub
+    fan-out, competing queue consumers, watch storms (reference:
+    lib/runtime/tests/soak.rs high-volume stream stress)."""
+    async with native_hub() as (c, port):
+        clients = [await HubClient.connect(f"127.0.0.1:{port}") for _ in range(4)]
+        try:
+            subs = [await cl.subscribe("soak.>") for cl in clients]
+
+            async def publisher(cl, tag, n):
+                for k in range(n):
+                    await cl.publish(f"soak.{tag}", f"{tag}:{k}".encode())
+
+            async def popper(cl, results):
+                while True:
+                    item = await cl.q_pop("soakq", block=True, timeout=2.0)
+                    if item is None:
+                        return
+                    results.append(item)
+
+            async def watcher_churn(cl, n):
+                for k in range(n):
+                    w = await cl.watch_prefix(f"soak/w{k % 5}/")
+                    await cl.kv_put(f"soak/w{k % 5}/key", str(k).encode())
+                    ev = await asyncio.wait_for(w.events.get(), 5)
+                    assert ev["type"] == "put"
+                    await w.cancel()
+
+            n_msgs, n_items = 50, 200
+            results: list[bytes] = []
+            await asyncio.gather(
+                publisher(clients[0], "a", n_msgs),
+                publisher(clients[1], "b", n_msgs),
+                *(popper(cl, results) for cl in clients),
+                *(c.q_push("soakq", f"i{k}".encode()) for k in range(n_items)),
+                watcher_churn(clients[2], 20),
+            )
+            # every queue item delivered exactly once
+            assert sorted(results) == sorted(f"i{k}".encode() for k in range(n_items))
+            # every subscriber saw every message
+            for sub in subs:
+                got = []
+                for _ in range(2 * n_msgs):
+                    got.append((await asyncio.wait_for(sub.events.get(), 5))["data"])
+                assert len(got) == 2 * n_msgs
+            stats = await c.stats()
+            assert stats["watches"] == 0  # churned watches all cancelled
+        finally:
+            for cl in clients:
+                await cl.close()
+
+
 async def test_frames_coalesced_with_fin_are_processed():
     """Fire-and-forget frames sent immediately before close() must still
     take effect even when data and FIN arrive in one read batch (the C

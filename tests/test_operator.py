@@ -1,0 +1,135 @@
+"""Graph deployment operator (sdk/operator.py): declarative specs under
+deploy/graphs/* reconciled into live process groups — the hub-native
+equivalent of the reference's K8s CRD controllers (reference:
+deploy/dynamo/operator dynamocomponentdeployment_controller.go)."""
+
+import asyncio
+import json
+import os
+
+from dynamo_tpu.runtime.component import EndpointId
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.sdk.operator import GRAPH_PREFIX, GraphOperator, main
+
+from .helpers import hub_pair
+
+GRAPH = os.path.join(os.path.dirname(__file__), "sdk_graph.py")
+ENTRY = f"{GRAPH}:EchoFrontend"
+
+
+async def _call(drt, path: str, payload: dict, timeout: float = 30.0):
+    eid = EndpointId.parse(path)
+    ep = drt.namespace(eid.namespace).component(eid.component).endpoint(eid.name)
+    client = await ep.client()
+    await client.wait_for_instances(timeout=timeout)
+    out = [item async for item in await client.generate(payload)]
+    await client.close()
+    return out
+
+
+async def test_operator_reconciles_graph_lifecycle():
+    async with hub_pair() as (server, client):
+        hub_addr = f"127.0.0.1:{server.port}"
+        op = GraphOperator(hub_addr, extra_env={"JAX_PLATFORMS": "cpu"})
+        await op.start()
+        try:
+            # apply -> deployed
+            spec = {"entry": ENTRY, "services": {"EchoBackend": {"workers": 1}}}
+            await client.kv_put(
+                GRAPH_PREFIX + "demo", json.dumps(spec).encode()
+            )
+            for _ in range(100):
+                if "demo" in op.deployments:
+                    break
+                await asyncio.sleep(0.1)
+            assert "demo" in op.deployments
+
+            drt = await DistributedRuntime.from_settings(hub_addr=hub_addr)
+            try:
+                out = await _call(
+                    drt, "dyn://sdktest.EchoFrontend.generate", {"text": "up now"}
+                )
+                assert out == [{"word": "UP"}, {"word": "NOW"}]
+            finally:
+                await drt.shutdown()
+
+            # replica change -> live rescale, no restart of the deployment
+            _, sup = op.deployments["demo"]
+            spec["services"]["EchoBackend"]["workers"] = 2
+            await client.kv_put(
+                GRAPH_PREFIX + "demo", json.dumps(spec).encode()
+            )
+            for _ in range(100):
+                if sup.watchers["EchoBackend"].numprocesses == 2:
+                    break
+                await asyncio.sleep(0.1)
+            assert sup.watchers["EchoBackend"].numprocesses == 2
+            assert op.deployments["demo"][1] is sup  # same supervisor
+
+            # delete -> teardown
+            await client.kv_del(GRAPH_PREFIX + "demo")
+            for _ in range(100):
+                if "demo" not in op.deployments:
+                    break
+                await asyncio.sleep(0.1)
+            assert op.deployments == {}
+        finally:
+            await op.stop()
+
+
+async def test_operator_survives_bad_spec():
+    async with hub_pair() as (server, client):
+        op = GraphOperator(f"127.0.0.1:{server.port}")
+        await op.start()
+        try:
+            await client.kv_put(GRAPH_PREFIX + "broken", b"{not json")
+            await client.kv_put(
+                GRAPH_PREFIX + "nosuch",
+                json.dumps({"entry": "missing/file.py:Nope"}).encode(),
+            )
+            await asyncio.sleep(0.5)
+            assert op.deployments == {}  # rejected, operator still alive
+            assert not op._task.done()
+        finally:
+            await op.stop()
+
+
+def test_cli_apply_list_delete(tmp_path, capsys):
+    import threading
+
+    from dynamo_tpu.runtime.hub.server import HubServer
+
+    # a hub on a background loop so the CLI's asyncio.run can reach it
+    started = threading.Event()
+    box = {}
+
+    def run_hub():
+        async def go():
+            hub = HubServer()
+            await hub.start("127.0.0.1", 0)
+            box["port"] = hub.port
+            box["stop"] = asyncio.Event()
+            box["loop"] = asyncio.get_running_loop()
+            started.set()
+            await box["stop"].wait()
+            await hub.stop()
+
+        asyncio.run(go())
+
+    t = threading.Thread(target=run_hub)
+    t.start()
+    started.wait(5)
+    hub = f"127.0.0.1:{box['port']}"
+
+    spec = tmp_path / "spec.json"
+    spec.write_text(json.dumps(
+        {"entry": ENTRY, "services": {"EchoBackend": {"workers": 3}}}
+    ))
+    assert main(["--hub", hub, "apply", "demo", str(spec)]) == 0
+    assert main(["--hub", hub, "list"]) == 0
+    out = capsys.readouterr().out
+    assert "demo" in out and "'EchoBackend': 3" in out
+    assert main(["--hub", hub, "delete", "demo"]) == 0
+    assert main(["--hub", hub, "delete", "demo"]) == 1  # already gone
+    box["loop"].call_soon_threadsafe(box["stop"].set)
+    t.join(timeout=5)
