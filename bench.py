@@ -105,9 +105,16 @@ def main() -> None:
         records = [dict() for _ in prompts]
         await asyncio.gather(*(one(p, r) for p, r in zip(prompts, records)))
         wall = time.perf_counter() - t0
-        return records, wall
 
-    records, wall = asyncio.run(run())
+        # prefix-cache TTFT probe (BASELINE.md: KV-aware routing's 3x TTFT
+        # win comes from prefix hits): identical prompt twice, idle engine
+        probe = rng.randint(1, cfg.vocab_size, size=ISL).tolist()
+        cold, warm = {}, {}
+        await one(probe, cold)
+        await one(probe, warm)
+        return records, wall, cold["ttft"] / warm["ttft"]
+
+    records, wall, prefix_speedup = asyncio.run(run())
     total_tokens = sum(r["tokens"] for r in records)
     toks_per_sec_chip = total_tokens / wall / n_chips
     ttft_p50 = float(np.percentile([r["ttft"] for r in records], 50))
@@ -137,6 +144,8 @@ def main() -> None:
                     "total_toks_per_sec_chip": round(
                         (CONCURRENCY * ISL + total_tokens) / wall / n_chips, 1
                     ),
+                    # cold/warm TTFT on an identical prompt (prefix cache)
+                    "prefix_hit_ttft_speedup": round(prefix_speedup, 2),
                 },
             }
         )
