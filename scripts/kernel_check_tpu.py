@@ -101,7 +101,7 @@ def main() -> None:
     steps_n = 16
     kv_len = 480
 
-    def time_scan(b, with_attn):
+    def time_scan(b, with_attn, quant=False):
         w_pages = -(-(kv_len + steps_n + page) // page)
         num_slots = (b * w_pages + 17) * page
         tables = jnp.asarray(
@@ -136,6 +136,10 @@ def main() -> None:
             return out, kv
 
         params = llama.init_params(cfg, jax.random.PRNGKey(0), dtype=dtype)
+        if quant:
+            from dynamo_tpu.ops.quant import quantize_params
+
+            params = quantize_params(params, cfg)
         kv = jax.device_put(llama.init_kv_cache(cfg, num_slots, dtype=dtype))
         tokens = jnp.ones((b,), jnp.int32)
         positions = jnp.full((b,), kv_len, jnp.int32)
@@ -167,6 +171,7 @@ def main() -> None:
     for b in (64, 128, 256):
         full = time_scan(b, with_attn=True)
         no_attn = time_scan(b, with_attn=False)
+        full_q = time_scan(b, with_attn=True, quant=True)
         attn_ms = (full - no_attn) * 1e3
         kv_bytes = b * kv_len * kw * 2 * 2 * cfg.num_layers  # K+V bf16, 16 L
         gbps = kv_bytes / max(full - no_attn, 1e-9) / 1e9
@@ -177,10 +182,14 @@ def main() -> None:
                 "attn_ms_per_step": round(attn_ms, 3),
                 "attn_GBps": round(gbps, 1),
                 "decode_toks_per_s": round(b / full, 0),
+                # int8 W8A8 weights (ops/quant.py), attention still bf16
+                "full_ms_per_step_int8": round(full_q * 1e3, 3),
+                "decode_toks_per_s_int8": round(b / full_q, 0),
             }
         )
         print(f"B={b}: full {full * 1e3:.2f} ms/step, attention "
-              f"{attn_ms:.2f} ms -> {gbps:.0f} GB/s, {b / full:.0f} tok/s")
+              f"{attn_ms:.2f} ms -> {gbps:.0f} GB/s, {b / full:.0f} tok/s; "
+              f"int8 {full_q * 1e3:.2f} ms -> {b / full_q:.0f} tok/s")
 
     # ---- flash prefill kernel: compiled agreement + chunk-batch rate --
     from dynamo_tpu.ops.attention import slots_from_pages

@@ -48,6 +48,7 @@ def wrap(obj, name):
 def main():
     engine = JaxEngine(EngineConfig(
         model="llama-3.2-1b", dtype="bfloat16",
+        quantization=os.environ.get("PROF_QUANT") or None,
         max_batch_size=CONC, max_model_len=ISL + OSL + 32,
         prefill_chunk=ISL, decode_steps=int(os.environ.get("PROF_STEPS", "16")),
     ))
