@@ -145,6 +145,55 @@ class GraphOperator:
         log.info("%s: torn down", name)
 
 
+class OperatorConnector:
+    """Planner ScaleConnector that scales by editing the deployment spec
+    in hub KV — the GraphOperator reconciles the change. This is the
+    reference's planner-on-Kubernetes mode (the planner patches CRD
+    replica counts, the operator converges the Deployment); here the
+    "CRD" is the deploy/graphs/* document.
+
+    Components map onto graph services via `component_to_service`
+    (planner speaks runtime component names, specs speak @service names).
+    """
+
+    def __init__(
+        self,
+        client: HubClient,
+        deployment: str,
+        component_to_service: dict[str, str],
+        max_replicas: Optional[int] = None,
+    ):
+        self._client = client
+        self._key = GRAPH_PREFIX + deployment
+        self._map = component_to_service
+        self.max_replicas = max_replicas
+
+    async def _bump(self, component: str, delta: int) -> bool:
+        service = self._map.get(component)
+        if service is None:
+            return False
+        entry = await self._client.kv_get(self._key)
+        if entry is None:
+            return False
+        spec = json.loads(entry["value"])
+        services = spec.setdefault("services", {})
+        svc_spec = services.setdefault(service, {})
+        cur = int(svc_spec.get("workers", 1))
+        want = cur + delta
+        if want < 1 or (self.max_replicas is not None and want > self.max_replicas):
+            return False
+        svc_spec["workers"] = want
+        await self._client.kv_put(self._key, json.dumps(spec).encode())
+        log.info("%s/%s: replicas %d -> %d", self._key, service, cur, want)
+        return True
+
+    async def add_component(self, component: str) -> bool:
+        return await self._bump(component, +1)
+
+    async def remove_component(self, component: str) -> bool:
+        return await self._bump(component, -1)
+
+
 # ------------------------------------------------------------------ CLI
 
 

@@ -77,6 +77,43 @@ async def test_operator_reconciles_graph_lifecycle():
             await op.stop()
 
 
+async def test_planner_connector_scales_through_operator():
+    """OperatorConnector (the planner's ScaleConnector) edits the spec;
+    the reconciler converges the process group — the reference's
+    planner-patches-CRD/operator-converges split."""
+    from dynamo_tpu.sdk.operator import OperatorConnector
+
+    async with hub_pair() as (server, client):
+        hub_addr = f"127.0.0.1:{server.port}"
+        op = GraphOperator(hub_addr, extra_env={"JAX_PLATFORMS": "cpu"})
+        await op.start()
+        try:
+            spec = {"entry": ENTRY, "services": {"EchoBackend": {"workers": 1}}}
+            await client.kv_put(GRAPH_PREFIX + "auto", json.dumps(spec).encode())
+            for _ in range(100):
+                if "auto" in op.deployments:
+                    break
+                await asyncio.sleep(0.1)
+            _, sup = op.deployments["auto"]
+
+            conn = OperatorConnector(
+                client, "auto", {"backend": "EchoBackend"}, max_replicas=2
+            )
+            assert await conn.add_component("backend") is True
+            for _ in range(100):
+                if sup.watchers["EchoBackend"].numprocesses == 2:
+                    break
+                await asyncio.sleep(0.1)
+            assert sup.watchers["EchoBackend"].numprocesses == 2
+            # cap and floor
+            assert await conn.add_component("backend") is False  # > max
+            assert await conn.remove_component("backend") is True
+            assert await conn.remove_component("backend") is False  # floor 1
+            assert await conn.add_component("unknown") is False
+        finally:
+            await op.stop()
+
+
 async def test_operator_survives_bad_spec():
     async with hub_pair() as (server, client):
         op = GraphOperator(f"127.0.0.1:{server.port}")
