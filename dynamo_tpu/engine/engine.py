@@ -60,7 +60,7 @@ from dynamo_tpu.llm.protocols.common import (
     PreprocessedRequest,
 )
 from dynamo_tpu.models import llama
-from dynamo_tpu.ops.sampling import sample_tokens
+from dynamo_tpu.ops.sampling import bump_counts, sample_tokens
 from dynamo_tpu.parallel import mesh as meshmod
 from dynamo_tpu.runtime.pipeline.context import Context
 
@@ -84,6 +84,7 @@ class _DecodeBuild:
     JaxEngine._maybe_dispatch_decode)."""
 
     __slots__ = ("positions", "tables", "act", "temp", "topk", "topp",
+                 "fp", "prp", "rp", "seeds", "use_ext", "want_lps",
                  "overrides", "active", "steps", "all_greedy")
 
     def __init__(self, **kw):
@@ -275,6 +276,7 @@ class JaxEngine:
         self._prefilling: deque[Sequence] = deque()
         self._inflight: Optional[_Dispatch] = None
         self._carry_toks = jnp.zeros(config.max_batch_size, jnp.int32)
+        self._carry_lps = jnp.zeros(config.max_batch_size, jnp.float32)
         # slot -> first-token carry override: (device token vector, row)
         # from a batched prefill dispatch, or a host int (disagg inject)
         self._overrides: dict[int, object] = {}
@@ -295,11 +297,29 @@ class JaxEngine:
         # per all_greedy variant — static so the pure-greedy batch skips
         # the sampling shortlist entirely)
         self._step_fn = jax.jit(
-            self._model_step, donate_argnums=(1,), static_argnums=(15,)
+            self._model_step, donate_argnums=(1,), static_argnums=(15, 16)
         )
-        # multi-step decode: `decode_steps` iterations per dispatch
+        # prefill step on the penalty/seeded path (separate trace: counts
+        # threaded through, donated so the scatter updates in place)
+        self._step_ext_fn = jax.jit(
+            self._model_step, donate_argnums=(1, 17), static_argnums=(15, 16)
+        )
+        # multi-step decode: `decode_steps` iterations per dispatch;
+        # want_lps static so the common no-logprobs batch skips the
+        # per-step logsumexp over [B, V]
         self._decode_fn = jax.jit(
-            self._decode_multi, donate_argnums=(1,), static_argnums=(10,)
+            self._decode_multi, donate_argnums=(1,), static_argnums=(11, 12)
+        )
+        # decode with penalties / per-request seeds (rare path; counts
+        # [B, V] int8 donated through the scan)
+        self._decode_ext_fn = jax.jit(
+            self._decode_multi, donate_argnums=(1, 13), static_argnums=(11, 12)
+        )
+        # occurrence counts for penalty sampling, allocated on first use
+        # (B x V int8; ~33 MB at B=256, V=128k)
+        self._counts = None
+        self._reset_count_fn = jax.jit(
+            self._reset_and_count, donate_argnums=(0,), static_argnums=(3,)
         )
         # disagg KV transfer: in-place scatter of received blocks / gather
         # of computed blocks (reference: the NIXL read/write data plane,
@@ -392,7 +412,26 @@ class JaxEngine:
     def _model_step(self, params, kv, tokens, positions, write_slots, slot_matrix,
                     last_idx, temp, topk, topp, key, wtables=None,
                     btables=None, embeds=None, embeds_mask=None,
-                    all_greedy=False):
+                    all_greedy=False, want_lps=False, counts=None,
+                    slot_rows=None, fp=None, prp=None, rp=None,
+                    final_row=None, seeds=None):
+        """One prefill step. Returns ((sampled [n], logprobs [n]), kv) —
+        plus updated counts when the penalty path is active (counts
+        gathered per slot row, the final-chunk rows' sampled token
+        bumped). `want_lps` (static) gates the logsumexp; when off the
+        logprob vector is zeros."""
+
+        def _sample(lg, key, **kw):
+            if want_lps:
+                return sample_tokens(
+                    lg, key, temp, topk, topp, all_greedy=all_greedy,
+                    return_logprobs=True, **kw,
+                )
+            toks = sample_tokens(
+                lg, key, temp, topk, topp, all_greedy=all_greedy, **kw
+            )
+            return toks, jnp.zeros(toks.shape[0], jnp.float32)
+
         if self._pp:
             hidden, kv = self._pp_forward(
                 params, kv, tokens, positions, write_slots, slot_matrix
@@ -401,8 +440,8 @@ class JaxEngine:
                 hidden, last_idx[:, None, None].astype(jnp.int32), axis=1
             )[:, 0]
             lg = llama.logits(params, self.model_cfg, last_h)
-            toks = sample_tokens(lg, key, temp, topk, topp, all_greedy=all_greedy)
-            return toks, kv
+            toks, lps = _sample(lg, key)
+            return (toks, lps), kv
         if wtables is not None:
             # pallas prefill: page-scatter write + flash attention over
             # the streamed pages (the XLA row scatter serializes; the
@@ -428,15 +467,41 @@ class JaxEngine:
             hidden, last_idx[:, None, None].astype(jnp.int32), axis=1
         )[:, 0]  # [B, D]
         lg = llama.logits(params, self.model_cfg, last_h)
-        toks = sample_tokens(lg, key, temp, topk, topp, all_greedy=all_greedy)
-        return toks, kv
+        if counts is not None:
+            # penalties/seeds on the first sampled token: counts rows
+            # live per SLOT; gather this group's rows
+            row_counts = counts[slot_rows]
+            toks, lps = _sample(
+                lg, key, counts=row_counts,
+                freq_pen=fp, pres_pen=prp, rep_pen=rp,
+                seeds=seeds, positions=last_idx + positions[:, 0],
+            )
+            # bump only final-chunk rows (others' samples are garbage);
+            # scatter back through the slot mapping
+            cur = counts[slot_rows, toks].astype(jnp.int32)
+            inc = jnp.where(final_row, 1, 0)
+            counts = counts.at[slot_rows, toks].set(
+                jnp.minimum(cur + inc, 127).astype(jnp.int8)
+            )
+            return (toks, lps), kv, counts
+        toks, lps = _sample(lg, key)
+        return (toks, lps), kv
 
-    def _decode_multi(self, params, kv, tokens, positions, block_tables, active,
-                      temp, topk, topp, key, all_greedy=False):
+    def _decode_multi(self, params, kv, tokens, carry_lps, positions,
+                      block_tables, active, temp, topk, topp, key,
+                      all_greedy=False, want_lps=False, counts=None,
+                      fp=None, prp=None, rp=None, seeds=None, fresh=None):
         """`decode_steps` decode iterations in ONE dispatch (lax.scan with
         on-device token feedback + slot computation) — the antidote to
         per-token host round trips, which dominate wall clock when the
-        device is remote or fast. Returns sampled tokens [K, B]."""
+        device is remote or fast. Returns ((tokens [K+1, B],
+        logprobs [K+1, B]), kv) — row 0 is the input carry — plus updated
+        counts on the penalty path.
+
+        `counts` (+ fp/prp/rp/seeds) switches on the penalty/seeded
+        sampling path: carry tokens of `fresh` rows (prefill/disagg
+        overrides never counted before) are bumped first, then each
+        step's sampled token."""
         s = self.page_size
         b, w = block_tables.shape
         smat = None
@@ -445,8 +510,18 @@ class JaxEngine:
                 block_tables[:, :, None] * s + jnp.arange(s, dtype=jnp.int32)
             ).reshape(b, -1)
 
+        use_pen = counts is not None
+        if use_pen:
+            # `fresh` rows carry a token never counted before (disagg
+            # injects; locally-prefilled first tokens were bumped by the
+            # prefill ext step already and are NOT fresh)
+            counts = bump_counts(counts, tokens, active & fresh)
+
         def body(carry, _):
-            tokens, positions, kv, key = carry
+            if use_pen:
+                tokens, positions, kv, key, counts = carry
+            else:
+                tokens, positions, kv, key = carry
             key, sub = jax.random.split(key)
             max_len = self.config.max_model_len
             if self._attn_pallas:
@@ -491,17 +566,46 @@ class JaxEngine:
                     kv, wslots, attn,
                 )
             lg = llama.logits(params, self.model_cfg, hidden[:, 0])
-            toks = sample_tokens(lg, sub, temp, topk, topp, all_greedy=all_greedy)
-            return (toks, positions + 1, kv, key), toks
 
-        (_, _, kv, _), out = jax.lax.scan(
-            body, (tokens, positions, kv, key), None,
-            length=self.config.decode_steps,
-        )
+            def _sample(**kw):
+                if want_lps:
+                    return sample_tokens(
+                        lg, sub, temp, topk, topp, all_greedy=all_greedy,
+                        return_logprobs=True, **kw,
+                    )
+                t = sample_tokens(
+                    lg, sub, temp, topk, topp, all_greedy=all_greedy, **kw
+                )
+                return t, jnp.zeros(t.shape[0], jnp.float32)
+
+            if use_pen:
+                toks, lps = _sample(
+                    counts=counts, freq_pen=fp, pres_pen=prp, rep_pen=rp,
+                    seeds=seeds, positions=positions,
+                )
+                new_counts = bump_counts(counts, toks, active)
+                return (toks, positions + 1, kv, key, new_counts), (toks, lps)
+            toks, lps = _sample()
+            return (toks, positions + 1, kv, key), (toks, lps)
+
+        if use_pen:
+            (_, _, kv, _, counts), (out, out_lps) = jax.lax.scan(
+                body, (tokens, positions, kv, key, counts), None,
+                length=self.config.decode_steps,
+            )
+        else:
+            (_, _, kv, _), (out, out_lps) = jax.lax.scan(
+                body, (tokens, positions, kv, key), None,
+                length=self.config.decode_steps,
+            )
         # row 0 = the input carry (prefill first tokens ride in via slot
         # overrides): syncing the dispatch delivers them with no separate
         # fetch — a per-sequence fetch costs a full tunnel RTT
-        return jnp.concatenate([tokens[None], out], axis=0), kv
+        toks_all = jnp.concatenate([tokens[None], out], axis=0)
+        lps_all = jnp.concatenate([carry_lps[None], out_lps], axis=0)
+        if use_pen:
+            return (toks_all, lps_all), kv, counts
+        return (toks_all, lps_all), kv
 
     # ------------------------------------------------------------------
     # engine protocol
@@ -519,6 +623,14 @@ class JaxEngine:
             raise ValueError(
                 f"prompt of {len(pre.token_ids)} tokens exceeds "
                 f"max_model_len={self.config.max_model_len}"
+            )
+        so = pre.sampling_options
+        if self._pp and (
+            so.frequency_penalty or so.presence_penalty
+            or (so.repetition_penalty not in (None, 1.0)) or so.seed is not None
+        ):
+            raise ValueError(
+                "sampling penalties / per-request seeds unsupported with pp>1"
             )
         # a prompt needing more pages than the pool can ever supply would
         # hang admission forever (and head-of-line block the queue)
@@ -751,9 +863,49 @@ class JaxEngine:
                 "prompt_tokens": seq.prompt_len,
             }
             self.slots[slot] = seq
+            if seq.has_penalties:
+                self._count_prompt(seq)
             self._prefilling.append(seq)
             progressed = True
         return progressed
+
+    def _reset_and_count(self, counts, row, tokens, reset=True):
+        """Zero a slot's occurrence-count row (first chunk) and
+        scatter-add prompt tokens into it (ops/sampling.count_tokens)."""
+        from dynamo_tpu.ops.sampling import count_tokens
+
+        if reset:
+            counts = counts.at[row].set(0)
+        return count_tokens(counts, row, tokens)
+
+    def _ensure_counts(self):
+        if self._counts is None:
+            self._counts = jnp.zeros(
+                (self.config.max_batch_size, self.model_cfg.vocab_size),
+                jnp.int8,
+            )
+        return self._counts
+
+    def _count_prompt(self, seq: Sequence) -> None:
+        """Seed the slot's count row with the prompt so penalties see
+        "the text so far" (prompt + completion, OpenAI semantics).
+        Chunked to the prefill buckets to bound compiled shapes; token
+        id 0 in a prompt is not counted (pad sentinel)."""
+        self._ensure_counts()
+        tokens = seq.tokens
+        buckets = self.config.prefill_buckets()
+        row = jnp.asarray(seq.slot, jnp.int32)
+        start = 0
+        with self._kv_lock:
+            while start < len(tokens):
+                chunk = tokens[start:start + buckets[-1]]
+                bucket = next(b for b in buckets if b >= len(chunk))
+                padded = np.zeros(bucket, np.int32)
+                padded[: len(chunk)] = chunk
+                self._counts = self._reset_count_fn(
+                    self._counts, row, jnp.asarray(padded), start == 0
+                )
+                start += len(chunk)
 
     def _reserve_pages(self, seq: Sequence) -> bool:
         """Prefix-match (HBM, then host tier) and allocate pages covering
@@ -894,7 +1046,7 @@ class JaxEngine:
                         self._finish(seq, FINISH_REASON_ERROR)
                         continue
                     if seq.num_computed >= seq.total_tokens:
-                        self._mark_decode_ready(seq, (tok1, 0))
+                        self._mark_decode_ready(seq, (tok1[0], tok1[1], 0))
                     else:
                         self._prefilling.append(seq)
                 continue
@@ -903,7 +1055,7 @@ class JaxEngine:
                     # final chunk: first token rides into the next decode
                     # dispatch as the slot's carry override, emitted from
                     # that dispatch's row 0 at sync — no per-seq fetch
-                    self._mark_decode_ready(seq, (toks, j))
+                    self._mark_decode_ready(seq, (toks[0], toks[1], j))
                 else:
                     self._prefilling.append(seq)
         await asyncio.sleep(0)
@@ -930,6 +1082,17 @@ class JaxEngine:
         temp = np.zeros(n, np.float32)
         topk = np.zeros(n, np.int32)
         topp = np.ones(n, np.float32)
+        # penalties/seeds need a slot-keyed count row; prefill_only seqs
+        # (slot -1, disagg) sample their first token on the plain path
+        use_ext = any(
+            (s.has_penalties or s.seed >= 0) and s.slot >= 0 for s in seqs
+        )
+        slot_rows = np.zeros(n, np.int32)
+        fp = np.zeros(n, np.float32)
+        prp = np.zeros(n, np.float32)
+        rp = np.ones(n, np.float32)
+        seeds = np.full(n, -1, np.int32)
+        final_row = np.zeros(n, bool)
         ps = self.page_size
         ppc = -(-bucket // ps)  # page blocks per chunk (pallas write path)
         wtables = np.zeros((n, ppc), np.int32)
@@ -994,9 +1157,15 @@ class JaxEngine:
             temp[j] = seq.temperature
             topk[j] = seq.top_k
             topp[j] = seq.top_p
+            slot_rows[j] = seq.slot if seq.slot >= 0 else 0
+            fp[j] = seq.frequency_penalty
+            prp[j] = seq.presence_penalty
+            rp[j] = seq.repetition_penalty
+            seeds[j] = seq.seed
+            final_row[j] = seq.num_computed + chunk >= seq.total_tokens
         with self._kv_lock:
             self._key, sub = jax.random.split(self._key)
-            toks, self.kv = self._step_fn(
+            common = (
                 self.params, self.kv,
                 jnp.asarray(tok_arr), jnp.asarray(pos_arr),
                 jnp.asarray(wslots.reshape(-1)),
@@ -1008,18 +1177,27 @@ class JaxEngine:
                 jnp.asarray(emb) if has_embeds else None,
                 jnp.asarray(emb_mask) if has_embeds else None,
                 bool((temp <= 0.0).all()),
+                any(s.want_logprobs for s in seqs),
             )
+            if use_ext:
+                (toks, lps), self.kv, self._counts = self._step_ext_fn(
+                    *common, self._ensure_counts(), jnp.asarray(slot_rows),
+                    jnp.asarray(fp), jnp.asarray(prp), jnp.asarray(rp),
+                    jnp.asarray(final_row), jnp.asarray(seeds),
+                )
+            else:
+                (toks, lps), self.kv = self._step_fn(*common)
         for j, seq in enumerate(seqs):
             chunk = min(seq.total_tokens - seq.num_computed, bucket)
             seq.num_computed += chunk
             self._register_full_pages(seq)
-        return toks
+        return toks, lps
 
     def _prefill_chunk_dispatch(self, seq: Sequence):
         """Single-sequence chunk dispatch (disagg prefill_only path);
         returns the sampled-token device vector [1] when this was the
         final chunk, else None."""
-        toks = self._prefill_group_dispatch([seq], self._bucket_for(
+        toks, _lps = self._prefill_group_dispatch([seq], self._bucket_for(
             min(seq.total_tokens - seq.num_computed, self.config.prefill_chunk)
         ))
         return toks[:1] if seq.num_computed >= seq.total_tokens else None
@@ -1124,6 +1302,12 @@ class JaxEngine:
         temp = np.zeros(b, np.float32)
         topk = np.zeros(b, np.int32)
         topp = np.ones(b, np.float32)
+        fp = np.zeros(b, np.float32)
+        prp = np.zeros(b, np.float32)
+        rp = np.ones(b, np.float32)
+        seeds = np.full(b, -1, np.int32)
+        use_ext = False
+        want_lps = False
         for i, seq in active:
             positions[i] = seq.device_pos
             tables[i, : len(seq.page_ids)] = seq.page_ids
@@ -1131,6 +1315,12 @@ class JaxEngine:
             temp[i] = seq.temperature
             topk[i] = seq.top_k
             topp[i] = seq.top_p
+            fp[i] = seq.frequency_penalty
+            prp[i] = seq.presence_penalty
+            rp[i] = seq.repetition_penalty
+            seeds[i] = seq.seed
+            use_ext = use_ext or seq.has_penalties or seq.seed >= 0
+            want_lps = want_lps or seq.want_logprobs
             seq.device_pos += k_steps
 
         overrides = {
@@ -1139,7 +1329,9 @@ class JaxEngine:
         self._overrides.clear()
         return _DecodeBuild(
             positions=positions, tables=tables, act=act, temp=temp,
-            topk=topk, topp=topp, overrides=overrides, active=active,
+            topk=topk, topp=topp, fp=fp, prp=prp, rp=rp, seeds=seeds,
+            use_ext=use_ext, want_lps=want_lps,
+            overrides=overrides, active=active,
             steps=k_steps,
             all_greedy=bool((temp[act] <= 0.0).all()) if act.any() else True,
         )
@@ -1153,6 +1345,9 @@ class JaxEngine:
 
     def _run_decode_dispatch_locked(self, bld: "_DecodeBuild") -> _Dispatch:
         toks = self._carry_toks
+        lps = self._carry_lps
+        fresh = np.zeros(len(self.slots), bool)  # rows carrying a token
+        # never counted before (prefill first tokens, disagg injects)
         if bld.overrides:
             # batch the carry overrides into one scatter per source
             # vector — a per-slot .at[].set is a separate dispatch (~ms
@@ -1161,35 +1356,61 @@ class JaxEngine:
             ints: list[tuple[int, int]] = []
             for slot, val in bld.overrides.items():
                 if isinstance(val, tuple):
-                    vec, row = val
-                    ent = by_vec.setdefault(id(vec), (vec, [], []))
-                    ent[1].append(slot)
-                    ent[2].append(row)
+                    vec, lvec, row = val
+                    ent = by_vec.setdefault(id(vec), (vec, lvec, [], []))
+                    ent[2].append(slot)
+                    ent[3].append(row)
                 else:
+                    # disagg-injected first token: sampled remotely, never
+                    # counted locally -> bump as fresh in the decode scan
+                    fresh[slot] = True
                     ints.append((slot, int(val)))
-            for vec, slots, rows in by_vec.values():
-                toks = toks.at[jnp.asarray(slots, jnp.int32)].set(
-                    vec[jnp.asarray(rows, jnp.int32)]
-                )
+            for vec, lvec, slots, rows in by_vec.values():
+                sl = jnp.asarray(slots, jnp.int32)
+                rw = jnp.asarray(rows, jnp.int32)
+                toks = toks.at[sl].set(vec[rw])
+                if bld.want_lps:  # each .at[].set is a tunnel dispatch;
+                    lps = lps.at[sl].set(lvec[rw])  # skip when unused
             if ints:
-                toks = toks.at[jnp.asarray([s for s, _ in ints], jnp.int32)].set(
+                sl = jnp.asarray([s for s, _ in ints], jnp.int32)
+                toks = toks.at[sl].set(
                     jnp.asarray([v for _, v in ints], jnp.int32)
                 )
+                if bld.want_lps:
+                    # remotely-sampled first tokens (disagg) have no
+                    # local logprob; NaN -> emitted as None
+                    lps = lps.at[sl].set(jnp.nan)
         self._key, sub = jax.random.split(self._key)
-        out, self.kv = self._decode_fn(
-            self.params, self.kv,
-            toks, jnp.asarray(bld.positions), jnp.asarray(bld.tables),
-            jnp.asarray(bld.act), jnp.asarray(bld.temp),
-            jnp.asarray(bld.topk), jnp.asarray(bld.topp),
-            sub, bld.all_greedy,
-        )
+        if bld.use_ext:
+            (out, out_lps), self.kv, self._counts = self._decode_ext_fn(
+                self.params, self.kv,
+                toks, lps, jnp.asarray(bld.positions), jnp.asarray(bld.tables),
+                jnp.asarray(bld.act), jnp.asarray(bld.temp),
+                jnp.asarray(bld.topk), jnp.asarray(bld.topp),
+                sub, bld.all_greedy, bld.want_lps, self._ensure_counts(),
+                jnp.asarray(bld.fp), jnp.asarray(bld.prp),
+                jnp.asarray(bld.rp), jnp.asarray(bld.seeds),
+                jnp.asarray(fresh),
+            )
+        else:
+            (out, out_lps), self.kv = self._decode_fn(
+                self.params, self.kv,
+                toks, lps, jnp.asarray(bld.positions), jnp.asarray(bld.tables),
+                jnp.asarray(bld.act), jnp.asarray(bld.temp),
+                jnp.asarray(bld.topk), jnp.asarray(bld.topp),
+                sub, bld.all_greedy, bld.want_lps,
+            )
         self._step_count += 1
         self._carry_toks = out[-1]
+        self._carry_lps = out_lps[-1]
         out.copy_to_host_async()
-        return _Dispatch(out, bld.active, bld.steps)
+        out_lps.copy_to_host_async()
+        return _Dispatch((out, out_lps), bld.active, bld.steps)
 
     async def _sync_dispatch(self, d: _Dispatch) -> None:
-        out = await asyncio.to_thread(np.asarray, d.out_dev)  # [K+1, B]
+        out, out_lps = await asyncio.to_thread(
+            lambda: (np.asarray(d.out_dev[0]), np.asarray(d.out_dev[1]))
+        )  # [K+1, B] each
         # row 0 is the dispatch's input carry: sequences that entered with
         # a freshly-prefilled first token emit it here, in stream order
         # before their decode tokens — one fetch covers everything
@@ -1197,7 +1418,10 @@ class JaxEngine:
             if self.slots[i] is seq and seq.carry_pending:
                 seq.carry_pending = False
                 seq.num_computed = seq.total_tokens  # prefill KV all valid
-                self._append_token(seq, int(out[0, i]), extra_meta=seq.first_meta)
+                self._append_token(
+                    seq, int(out[0, i]), logprob=float(out_lps[0, i]),
+                    extra_meta=seq.first_meta,
+                )
                 seq.first_meta = None
         for step in range(1, out.shape[0]):
             for i, seq in d.snapshot:
@@ -1206,7 +1430,9 @@ class JaxEngine:
                     continue
                 seq.num_computed += 1
                 self._register_full_pages(seq)
-                self._append_token(seq, int(out[step, i]))
+                self._append_token(
+                    seq, int(out[step, i]), logprob=float(out_lps[step, i])
+                )
 
     def _ensure_pages_through(self, seq: Sequence, upto_pos: int) -> bool:
         while upto_pos // self.page_size >= len(seq.page_ids):
@@ -1379,10 +1605,20 @@ class JaxEngine:
             parent_hash=blocks[0].parent_sequence_hash if blocks else None,
         )
 
-    def _append_token(self, seq: Sequence, token: int, extra_meta: Optional[dict] = None) -> None:
+    def _append_token(
+        self, seq: Sequence, token: int,
+        logprob: Optional[float] = None, extra_meta: Optional[dict] = None,
+    ) -> None:
         seq.blocks.extend([token])
         seq.generated += 1
         frame = EngineOutput(token_ids=[token])
+        if seq.want_logprobs:
+            # NaN = no local logprob (disagg remotely-sampled first token)
+            lp = None if logprob is None or logprob != logprob else logprob
+            if lp is not None:
+                seq.cum_logprob += lp
+            frame.log_probs = [lp]
+            frame.cum_log_probs = seq.cum_logprob
         if extra_meta:
             frame.meta = extra_meta
         seq.out_queue.put_nowait(frame.to_dict())

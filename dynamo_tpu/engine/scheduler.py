@@ -68,9 +68,23 @@ class Sequence:
     temperature: float = 0.0
     top_k: int = 0
     top_p: float = 1.0
+    frequency_penalty: float = 0.0
+    presence_penalty: float = 0.0
+    repetition_penalty: float = 1.0
+    seed: int = -1                 # -1 = engine stream key
+    want_logprobs: bool = False
+    cum_logprob: float = 0.0
     max_new_tokens: int = 0
     eos_ids: frozenset[int] = frozenset()
     ignore_eos: bool = False
+
+    @property
+    def has_penalties(self) -> bool:
+        return (
+            self.frequency_penalty != 0.0
+            or self.presence_penalty != 0.0
+            or self.repetition_penalty != 1.0
+        )
 
     @classmethod
     def from_request(
@@ -86,6 +100,13 @@ class Sequence:
         seq.temperature = 0.0 if so.greedy else float(so.temperature or 0.0)
         seq.top_k = int(so.top_k or 0)
         seq.top_p = float(so.top_p if so.top_p is not None else 1.0)
+        seq.frequency_penalty = float(so.frequency_penalty or 0.0)
+        seq.presence_penalty = float(so.presence_penalty or 0.0)
+        seq.repetition_penalty = float(
+            so.repetition_penalty if so.repetition_penalty else 1.0
+        )
+        seq.seed = int(so.seed) if so.seed is not None else -1
+        seq.want_logprobs = bool(getattr(so, "logprobs", False))
         budget = max_model_len - seq.prompt_len
         mt = pre.stop_conditions.max_tokens
         seq.max_new_tokens = max(0, min(budget, mt) if mt is not None else budget)

@@ -157,7 +157,9 @@ class Backend(Operator):
             # frame meta (e.g. first-frame prefix_cached_tokens), merged so a
             # fully-jailed frame's meta is not dropped
             pending_ids: list[int] = []
+            pending_lps: list = []   # aligned with pending_ids (logprobs mode)
             pending_meta: dict = {}
+            cum_lp = None
             async for raw in upstream:
                 out = EngineOutput.from_dict(raw) if isinstance(raw, dict) else raw
                 if request.is_stopped() and not decoder.finished:
@@ -166,6 +168,8 @@ class Backend(Operator):
                         pending_meta.update(out.meta)
                     yield EngineOutput(
                         token_ids=pending_ids,
+                        log_probs=pending_lps or None,
+                        cum_log_probs=cum_lp,
                         finish_reason=FINISH_REASON_CANCELLED,
                         meta=pending_meta or None,
                     ).to_dict()
@@ -182,16 +186,27 @@ class Backend(Operator):
                 # only the consumed prefix: tokens past a mid-chunk stop must
                 # not leak into usage accounting downstream
                 pending_ids.extend(out.token_ids[:consumed])
+                if out.log_probs:
+                    consumed_lps = out.log_probs[:consumed]
+                    pending_lps.extend(consumed_lps)
+                    # running sum over CONSUMED tokens (a mid-chunk stop
+                    # must not credit the discarded tail)
+                    cum_lp = (cum_lp or 0.0) + sum(
+                        lp for lp in consumed_lps if lp is not None
+                    )
                 if out.meta:
                     pending_meta.update(out.meta)
                 if text_parts or decoder.finished:
                     yield EngineOutput(
                         token_ids=pending_ids,
                         text="".join(text_parts) or None,
+                        log_probs=pending_lps or None,
+                        cum_log_probs=cum_lp,
                         finish_reason=decoder.finish_reason,
                         meta=pending_meta or None,
                     ).to_dict()
                     pending_ids = []
+                    pending_lps = []
                     pending_meta = {}
                 if decoder.finished:
                     # tell the engine to stop producing (remote: stop frame)
@@ -203,6 +218,8 @@ class Backend(Operator):
                     yield EngineOutput(
                         token_ids=pending_ids,
                         text=decoder.flush(),
+                        log_probs=pending_lps or None,
+                        cum_log_probs=cum_lp,
                         finish_reason=out.finish_reason,
                         meta=pending_meta or None,
                     ).to_dict()
@@ -213,6 +230,8 @@ class Backend(Operator):
                 yield EngineOutput(
                     token_ids=pending_ids,
                     text=decoder.flush(),
+                    log_probs=pending_lps or None,
+                    cum_log_probs=cum_lp,
                     finish_reason=FINISH_REASON_ERROR,
                     meta=pending_meta or None,
                 ).to_dict()

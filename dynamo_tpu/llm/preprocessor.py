@@ -15,6 +15,7 @@ HTTP layer renders them as SSE events.
 
 from __future__ import annotations
 
+import asyncio
 import datetime
 import json
 from typing import AsyncIterator, Optional
@@ -202,27 +203,119 @@ class OpenAIPreprocessor(Operator):
 
         delta = DeltaGenerator(req.model, kind=kind)
         delta.prompt_tokens = len(pre.token_ids)
-        upstream = await next_engine.generate(request.map(pre.to_dict()))
+        want_lps = pre.sampling_options.logprobs
 
-        async def _out() -> AsyncIterator[dict]:
-            # reference: annotations emitted ahead of the stream
+        def _logprobs_payload(out: EngineOutput) -> Optional[dict]:
+            if not want_lps or not out.log_probs:
+                return None
+            toks = [self.tokenizer.decode([t]) for t in out.token_ids]
+            if kind == "chat":
+                return {
+                    "content": [
+                        {"token": t, "logprob": lp}
+                        for t, lp in zip(toks, out.log_probs)
+                    ]
+                }
+            return {"tokens": toks, "token_logprobs": list(out.log_probs)}
+
+        n = max(1, pre.sampling_options.n or 1)
+        if n == 1:
+            upstream = await next_engine.generate(request.map(pre.to_dict()))
+
+            async def _out() -> AsyncIterator[dict]:
+                # reference: annotations emitted ahead of the stream
+                if "formatted_prompt" in pre.annotations:
+                    yield {"__annotation__": "formatted_prompt", "data": prompt}
+                if "token_ids" in pre.annotations:
+                    yield {"__annotation__": "token_ids", "data": pre.token_ids}
+                finish_sent = False
+                async for raw in upstream:
+                    out = EngineOutput.from_dict(raw) if isinstance(raw, dict) else raw
+                    text = out.text
+                    if text is None and out.tokens:
+                        text = "".join(out.tokens)
+                    delta.completion_tokens += len(out.token_ids)
+                    if text or out.finish_reason:
+                        if out.finish_reason:
+                            finish_sent = True
+                        yield delta.chunk(
+                            text, out.finish_reason,
+                            logprobs=_logprobs_payload(out),
+                        )
+                if not finish_sent:
+                    yield delta.chunk(None, "stop")
+                yield {**delta.chunk(None, None), "usage": delta.usage(), "choices": []}
+
+            return _out()
+
+        # ---- n > 1: fan the prompt out into n engine streams (the prefix
+        # cache shares the prompt compute; choices are merged by index —
+        # reference behavior: vLLM's n sampling). Seeded requests derive
+        # per-choice seeds so choices differ but stay reproducible.
+        streams = []
+        for idx in range(n):
+            d = pre.to_dict()
+            so = dict(d["sampling_options"])
+            if so.get("seed") is not None:
+                so["seed"] = int(so["seed"]) + idx
+            d["sampling_options"] = so
+            # forked contexts: choice idx finishing (backend stop) must
+            # not cancel its siblings; client disconnect cancels all
+            streams.append(await next_engine.generate(request.fork(d, str(idx))))
+
+        # bounded: pumps block when the client consumes slowly, keeping
+        # the n==1 path's backpressure
+        queue: asyncio.Queue = asyncio.Queue(maxsize=8)
+
+        async def _pump(idx: int, stream) -> None:
+            try:
+                async for raw in stream:
+                    await queue.put((idx, raw))
+            except Exception as exc:  # noqa: BLE001 — surfaced to the consumer
+                await queue.put((idx, exc))
+            finally:
+                await queue.put((idx, None))
+
+        tasks = [
+            asyncio.create_task(_pump(idx, s)) for idx, s in enumerate(streams)
+        ]
+
+        async def _out_n() -> AsyncIterator[dict]:
             if "formatted_prompt" in pre.annotations:
                 yield {"__annotation__": "formatted_prompt", "data": prompt}
             if "token_ids" in pre.annotations:
                 yield {"__annotation__": "token_ids", "data": pre.token_ids}
-            finish_sent = False
-            async for raw in upstream:
-                out = EngineOutput.from_dict(raw) if isinstance(raw, dict) else raw
-                text = out.text
-                if text is None and out.tokens:
-                    text = "".join(out.tokens)
-                delta.completion_tokens += len(out.token_ids)
-                if text or out.finish_reason:
-                    if out.finish_reason:
-                        finish_sent = True
-                    yield delta.chunk(text, out.finish_reason)
-            if not finish_sent:
-                yield delta.chunk(None, "stop")
-            yield {**delta.chunk(None, None), "usage": delta.usage(), "choices": []}
+            finish_sent = [False] * n
+            live = n
+            try:
+                while live:
+                    idx, raw = await queue.get()
+                    if raw is None:
+                        live -= 1
+                        continue
+                    if isinstance(raw, Exception):
+                        # one choice's engine failure fails the request
+                        # (n==1 semantics) rather than masquerading as a
+                        # normally-finished choice
+                        raise raw
+                    out = EngineOutput.from_dict(raw) if isinstance(raw, dict) else raw
+                    text = out.text
+                    if text is None and out.tokens:
+                        text = "".join(out.tokens)
+                    delta.completion_tokens += len(out.token_ids)
+                    if text or out.finish_reason:
+                        if out.finish_reason:
+                            finish_sent[idx] = True
+                        yield delta.chunk(
+                            text, out.finish_reason,
+                            logprobs=_logprobs_payload(out), index=idx,
+                        )
+                for idx in range(n):
+                    if not finish_sent[idx]:
+                        yield delta.chunk(None, "stop", index=idx)
+                yield {**delta.chunk(None, None), "usage": delta.usage(), "choices": []}
+            finally:
+                for t in tasks:
+                    t.cancel()
 
-        return _out()
+        return _out_n()

@@ -54,13 +54,17 @@ class SamplingOptions:
     repetition_penalty: Optional[float] = None
     seed: Optional[int] = None
     greedy: bool = False
+    # report per-token logprobs of the sampled tokens (OpenAI `logprobs`)
+    logprobs: bool = False
 
     def to_dict(self) -> dict:
         return asdict(self)
 
     @classmethod
     def from_dict(cls, d: dict | None) -> "SamplingOptions":
-        return cls(**(d or {}))
+        d = dict(d or {})
+        known = {f for f in cls.__dataclass_fields__}
+        return cls(**{k: v for k, v in d.items() if k in known})
 
 
 @dataclass

@@ -120,6 +120,7 @@ class ChatCompletionRequest:
             repetition_penalty=self.ext.repetition_penalty,
             seed=self.seed,
             greedy=self.ext.greed_sampling,
+            logprobs=self.logprobs,
         )
 
     def stop_conditions(self) -> StopConditions:
@@ -142,6 +143,11 @@ class CompletionRequest:
     stop: list[str] = field(default_factory=list)
     seed: Optional[int] = None
     echo: bool = False
+    # legacy completions logprobs: int (top-k count); we report the
+    # sampled token's logprob (top_logprobs alternatives unsupported)
+    logprobs: Optional[int] = None
+    frequency_penalty: Optional[float] = None
+    presence_penalty: Optional[float] = None
     ext: DynExt = field(default_factory=DynExt)
     raw: dict = field(default_factory=dict)
 
@@ -165,6 +171,9 @@ class CompletionRequest:
             stop=list(stop),
             seed=body.get("seed"),
             echo=bool(body.get("echo", False)),
+            logprobs=body.get("logprobs"),
+            frequency_penalty=body.get("frequency_penalty"),
+            presence_penalty=body.get("presence_penalty"),
             ext=DynExt.from_request(body),
             raw=body,
         )
@@ -175,8 +184,14 @@ class CompletionRequest:
             temperature=self.temperature,
             top_p=self.top_p,
             top_k=self.ext.top_k,
+            frequency_penalty=self.frequency_penalty,
+            presence_penalty=self.presence_penalty,
+            repetition_penalty=self.ext.repetition_penalty,
             seed=self.seed,
             greedy=self.ext.greed_sampling,
+            # legacy API: logprobs=0 still returns the sampled token's
+            # logprob (0 top-alternatives); only absence disables
+            logprobs=self.logprobs is not None,
         )
 
     def stop_conditions(self) -> StopConditions:
@@ -215,7 +230,16 @@ class DeltaGenerator:
             "model": self.model,
         }
 
-    def chunk(self, text: Optional[str], finish_reason: Optional[str] = None) -> dict:
+    def chunk(
+        self,
+        text: Optional[str],
+        finish_reason: Optional[str] = None,
+        logprobs: Optional[dict] = None,
+        index: int = 0,
+    ) -> dict:
+        """`logprobs`: chat -> {"content": [{token, logprob}...]};
+        completions -> {"tokens": [...], "token_logprobs": [...]}.
+        `index`: choice index for n>1 fan-out."""
         out = self._base()
         if self.kind == "chat":
             delta: dict[str, Any] = {}
@@ -224,13 +248,17 @@ class DeltaGenerator:
                 self._first = False
             if text:
                 delta["content"] = text
-            out["choices"] = [
-                {"index": 0, "delta": delta, "finish_reason": finish_reason}
-            ]
+            choice = {"index": index, "delta": delta, "finish_reason": finish_reason}
+            if logprobs is not None:
+                choice["logprobs"] = logprobs
+            out["choices"] = [choice]
         else:
-            out["choices"] = [
-                {"index": 0, "text": text or "", "finish_reason": finish_reason}
-            ]
+            choice = {
+                "index": index, "text": text or "", "finish_reason": finish_reason
+            }
+            if logprobs is not None:
+                choice["logprobs"] = logprobs
+            out["choices"] = [choice]
         return out
 
     def usage(self) -> dict:
@@ -242,38 +270,50 @@ class DeltaGenerator:
 
 
 async def aggregate_chat_stream(chunks: AsyncIterator[dict]) -> dict:
-    """Fold stream chunks into a full chat completion
+    """Fold stream chunks into a full chat completion, per choice index
     (reference: chat_completions/aggregator.rs)."""
-    text_parts: list[str] = []
-    finish_reason = None
+    per: dict[int, dict] = {}
     base: dict = {}
     usage = None
-    role = "assistant"
     async for chunk in chunks:
         if not base:
             base = {k: chunk.get(k) for k in ("id", "created", "model")}
         if chunk.get("usage"):
             usage = chunk["usage"]
         for choice in chunk.get("choices", []):
+            idx = choice.get("index", 0)
+            acc = per.setdefault(
+                idx,
+                {"text": [], "finish": None, "role": "assistant", "lps": []},
+            )
             delta = choice.get("delta", {})
             if delta.get("role"):
-                role = delta["role"]
+                acc["role"] = delta["role"]
             if delta.get("content"):
-                text_parts.append(delta["content"])
+                acc["text"].append(delta["content"])
+            if choice.get("logprobs") and choice["logprobs"].get("content"):
+                acc["lps"].extend(choice["logprobs"]["content"])
             if choice.get("finish_reason"):
-                finish_reason = choice["finish_reason"]
+                acc["finish"] = choice["finish_reason"]
+    if not per:  # stream carried no choice entries: one empty choice
+        per[0] = {"text": [], "finish": None, "role": "assistant", "lps": []}
+    choices = []
+    for idx in sorted(per):
+        acc = per[idx]
+        choice = {
+            "index": idx,
+            "message": {"role": acc["role"], "content": "".join(acc["text"])},
+            "finish_reason": acc["finish"],
+        }
+        if acc["lps"]:
+            choice["logprobs"] = {"content": acc["lps"]}
+        choices.append(choice)
     out = {
         "id": base.get("id"),
         "object": "chat.completion",
         "created": base.get("created"),
         "model": base.get("model"),
-        "choices": [
-            {
-                "index": 0,
-                "message": {"role": role, "content": "".join(text_parts)},
-                "finish_reason": finish_reason,
-            }
-        ],
+        "choices": choices,
     }
     if usage:
         out["usage"] = usage
@@ -281,9 +321,8 @@ async def aggregate_chat_stream(chunks: AsyncIterator[dict]) -> dict:
 
 
 async def aggregate_completion_stream(chunks: AsyncIterator[dict]) -> dict:
-    """reference: completions/aggregator.rs."""
-    text_parts: list[str] = []
-    finish_reason = None
+    """reference: completions/aggregator.rs (per choice index)."""
+    per: dict[int, dict] = {}
     base: dict = {}
     usage = None
     async for chunk in chunks:
@@ -292,18 +331,39 @@ async def aggregate_completion_stream(chunks: AsyncIterator[dict]) -> dict:
         if chunk.get("usage"):
             usage = chunk["usage"]
         for choice in chunk.get("choices", []):
+            idx = choice.get("index", 0)
+            acc = per.setdefault(
+                idx, {"text": [], "finish": None, "toks": [], "lps": []}
+            )
             if choice.get("text"):
-                text_parts.append(choice["text"])
+                acc["text"].append(choice["text"])
+            lp = choice.get("logprobs")
+            if lp:
+                acc["toks"].extend(lp.get("tokens") or [])
+                acc["lps"].extend(lp.get("token_logprobs") or [])
             if choice.get("finish_reason"):
-                finish_reason = choice["finish_reason"]
+                acc["finish"] = choice["finish_reason"]
+    if not per:
+        per[0] = {"text": [], "finish": None, "toks": [], "lps": []}
+    choices = []
+    for idx in sorted(per):
+        acc = per[idx]
+        choice = {
+            "index": idx,
+            "text": "".join(acc["text"]),
+            "finish_reason": acc["finish"],
+        }
+        if acc["toks"] or acc["lps"]:
+            choice["logprobs"] = {
+                "tokens": acc["toks"], "token_logprobs": acc["lps"]
+            }
+        choices.append(choice)
     out = {
         "id": base.get("id"),
         "object": "text_completion",
         "created": base.get("created"),
         "model": base.get("model"),
-        "choices": [
-            {"index": 0, "text": "".join(text_parts), "finish_reason": finish_reason}
-        ],
+        "choices": choices,
     }
     if usage:
         out["usage"] = usage

@@ -1,4 +1,6 @@
-"""In-jit batched token sampling: greedy / temperature / top-k / top-p.
+"""In-jit batched token sampling: greedy / temperature / top-k / top-p,
+with sampled-token logprobs, repetition/frequency/presence penalties and
+optional per-request seeds.
 
 The reference forwards `SamplingOptions` (reference:
 lib/llm/src/protocols/common.rs:248) into vLLM; here sampling runs on-device
@@ -9,6 +11,21 @@ Top-k/top-p operate on a fixed `CANDIDATES`-wide shortlist (lax.top_k) —
 per-request k is a clamp within it, p a cumulative cutoff over it. This is
 exact for k <= CANDIDATES and a negligible-mass approximation for top-p
 (identical to common GPU serving practice, TPU-friendly static shape).
+
+Logprobs are of the sampled token under the raw (pre-temperature,
+pre-penalty) model distribution — the convention the OpenAI API reports.
+
+Penalties follow the OpenAI definitions over "the text so far" (prompt +
+completion, one shared count buffer):
+  frequency: logit -= frequency_penalty * count(token)
+  presence:  logit -= presence_penalty  * (count(token) > 0)
+  repetition (vLLM/HF-style): seen tokens' positive logits are divided by
+  the penalty, negative multiplied.
+
+Per-request seeds derive each row's key as
+fold_in(fold_in(key(seed), position), 1) — reproducible across runs and
+independent of whatever else shares the batch (vLLM's per-request
+generator semantics). Rows with seed < 0 use the engine's stream key.
 """
 
 from __future__ import annotations
@@ -19,6 +36,36 @@ import jax.numpy as jnp
 CANDIDATES = 64  # shortlist width for top-k/top-p
 
 
+def apply_penalties(
+    logits: jnp.ndarray,        # [B, V] f32
+    counts: jnp.ndarray,        # [B, V] int8 token occurrence counts
+    freq_pen: jnp.ndarray,      # [B] f32 (0 = off)
+    pres_pen: jnp.ndarray,      # [B] f32 (0 = off)
+    rep_pen: jnp.ndarray,       # [B] f32 (1 = off)
+) -> jnp.ndarray:
+    cnt = counts.astype(jnp.float32)
+    seen = cnt > 0
+    logits = logits - freq_pen[:, None] * cnt
+    logits = logits - pres_pen[:, None] * seen.astype(jnp.float32)
+    rep = rep_pen[:, None]
+    penalized = jnp.where(logits > 0, logits / rep, logits * rep)
+    return jnp.where(seen, penalized, logits)
+
+
+def _per_row_keys(base_key: jax.Array, seeds: jnp.ndarray, positions: jnp.ndarray):
+    """[B] keys: seeded rows get a run-independent key derived from
+    (seed, position); unseeded rows split the batch key."""
+
+    def row_key(seed, pos, batch_key):
+        seeded = jax.random.fold_in(
+            jax.random.fold_in(jax.random.PRNGKey(seed), pos), 1
+        )
+        return jax.lax.cond(seed >= 0, lambda: seeded, lambda: batch_key)
+
+    batch_keys = jax.random.split(base_key, seeds.shape[0])
+    return jax.vmap(row_key)(seeds, positions, batch_keys)
+
+
 def sample_tokens(
     logits: jnp.ndarray,       # [B, V] float
     key: jax.Array,            # PRNG key
@@ -26,17 +73,37 @@ def sample_tokens(
     top_k: jnp.ndarray,        # [B] i32 (<= 0 means disabled)
     top_p: jnp.ndarray,        # [B] f32 (>= 1 means disabled)
     all_greedy: bool = False,  # static: whole batch greedy -> argmax only
-) -> jnp.ndarray:
-    """Returns sampled token ids [B] int32.
+    return_logprobs: bool = False,  # static: also return sampled logprob [B]
+    counts: jnp.ndarray | None = None,      # [B, V] int8 (penalties on)
+    freq_pen: jnp.ndarray | None = None,    # [B] f32
+    pres_pen: jnp.ndarray | None = None,    # [B] f32
+    rep_pen: jnp.ndarray | None = None,     # [B] f32
+    seeds: jnp.ndarray | None = None,       # [B] i32 (-1 = engine stream key)
+    positions: jnp.ndarray | None = None,   # [B] i32 (seed derivation)
+):
+    """Returns sampled ids [B] i32, or (ids, logprobs [B] f32) when
+    `return_logprobs`.
 
     `all_greedy` is a trace-time flag the engine sets when no live slot
     samples (the common serving case): it skips the shortlist machinery
     entirely — approx_max_k costs ~2 ms at [64, 128k] on v5e, argmax
     fuses into the logits matmul."""
     b, v = logits.shape
-    logits = logits.astype(jnp.float32)
+    raw = logits.astype(jnp.float32)
+
+    def picked_logprobs(ids):
+        logz = jax.nn.logsumexp(raw, axis=-1)
+        picked = jnp.take_along_axis(raw, ids[:, None], axis=-1)[:, 0]
+        return picked - logz
+
+    logits = raw
+    if counts is not None:
+        logits = apply_penalties(logits, counts, freq_pen, pres_pen, rep_pen)
+
     greedy_ids = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     if all_greedy:
+        if return_logprobs:
+            return greedy_ids, picked_logprobs(greedy_ids)
         return greedy_ids
 
     is_greedy = temperature <= 0.0
@@ -65,7 +132,47 @@ def sample_tokens(
 
     keep = keep_k & keep_p
     masked = jnp.where(keep, cand_logits, -1e30)
-    choice = jax.random.categorical(key, masked, axis=-1)  # [B] index into shortlist
+    if seeds is not None:
+        keys = _per_row_keys(key, seeds, positions)
+        choice = jax.vmap(lambda kk, row: jax.random.categorical(kk, row))(
+            keys, masked
+        )
+    else:
+        choice = jax.random.categorical(key, masked, axis=-1)  # [B] shortlist idx
     sampled_ids = jnp.take_along_axis(cand_ids, choice[:, None], axis=-1)[:, 0]
 
-    return jnp.where(is_greedy, greedy_ids, sampled_ids).astype(jnp.int32)
+    ids = jnp.where(is_greedy, greedy_ids, sampled_ids).astype(jnp.int32)
+    if return_logprobs:
+        return ids, picked_logprobs(ids)
+    return ids
+
+
+def count_tokens(
+    counts: jnp.ndarray,   # [B, V] int8
+    row: jnp.ndarray,      # scalar i32 slot
+    tokens: jnp.ndarray,   # [T] i32 (0-padded; token id 0 never counted)
+) -> jnp.ndarray:
+    """Scatter-add a prompt's tokens into one slot's count row (saturating
+    int8; pad token id 0 is ignored). Used at admission so penalties see
+    the prompt, not just the completion."""
+    onehot = jnp.zeros((counts.shape[1],), jnp.int32).at[tokens].add(
+        jnp.where(tokens > 0, 1, 0)
+    )
+    new_row = jnp.minimum(counts[row].astype(jnp.int32) + onehot, 127).astype(
+        jnp.int8
+    )
+    return counts.at[row].set(new_row)
+
+
+def bump_counts(
+    counts: jnp.ndarray,    # [B, V] int8
+    tokens: jnp.ndarray,    # [B] i32 sampled this step
+    active: jnp.ndarray,    # [B] bool
+) -> jnp.ndarray:
+    """Per-step count update for the sampled tokens (saturating int8)."""
+    rows = jnp.arange(tokens.shape[0])
+    cur = counts[rows, tokens].astype(jnp.int32)
+    inc = jnp.where(active, 1, 0)
+    return counts.at[rows, tokens].set(
+        jnp.minimum(cur + inc, 127).astype(jnp.int8)
+    )

@@ -43,6 +43,31 @@ class StreamController:
         await self._stopped.wait()
 
 
+class LinkedController(StreamController):
+    """Child controller that also observes its parent: a parent
+    stop/kill applies to every fork, a child's stop stays local (n>1
+    fan-out — one finished choice must not cancel its siblings)."""
+
+    def __init__(self, parent: StreamController) -> None:
+        super().__init__()
+        self._parent = parent
+
+    def is_stopped(self) -> bool:
+        return super().is_stopped() or self._parent.is_stopped()
+
+    def is_killed(self) -> bool:
+        return super().is_killed() or self._parent.is_killed()
+
+    async def stopped(self) -> None:
+        own = asyncio.ensure_future(self._stopped.wait())
+        par = asyncio.ensure_future(self._parent.stopped())
+        try:
+            await asyncio.wait({own, par}, return_when=asyncio.FIRST_COMPLETED)
+        finally:
+            own.cancel()
+            par.cancel()
+
+
 class Context(Generic[T]):
     __slots__ = ("payload", "id", "metadata", "controller")
 
@@ -65,6 +90,17 @@ class Context(Generic[T]):
         ctx.id = self.id
         ctx.metadata = self.metadata
         ctx.controller = self.controller
+        return ctx
+
+    def fork(self, payload: U, suffix: str) -> "Context[U]":
+        """Child context with its own stop control (linked to this one):
+        used by n>1 fan-out so one choice's finish doesn't cancel its
+        siblings while a client disconnect still cancels all."""
+        ctx: Context[U] = Context.__new__(Context)
+        ctx.payload = payload
+        ctx.id = f"{self.id}-{suffix}"
+        ctx.metadata = self.metadata
+        ctx.controller = LinkedController(self.controller)
         return ctx
 
     # controller passthroughs
