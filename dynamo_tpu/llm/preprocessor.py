@@ -204,6 +204,11 @@ class OpenAIPreprocessor(Operator):
         delta = DeltaGenerator(req.model, kind=kind)
         delta.prompt_tokens = len(pre.token_ids)
         want_lps = pre.sampling_options.logprobs
+        # legacy completions echo: the response text starts with the
+        # prompt (decoded when the prompt came as token ids)
+        echo_text = None
+        if kind == "completion" and getattr(req, "echo", False):
+            echo_text = prompt or self.tokenizer.decode(pre.token_ids)
 
         def _logprobs_payload(out: EngineOutput) -> Optional[dict]:
             if not want_lps or not out.log_probs:
@@ -228,6 +233,8 @@ class OpenAIPreprocessor(Operator):
                     yield {"__annotation__": "formatted_prompt", "data": prompt}
                 if "token_ids" in pre.annotations:
                     yield {"__annotation__": "token_ids", "data": pre.token_ids}
+                if echo_text:
+                    yield delta.chunk(echo_text)
                 finish_sent = False
                 async for raw in upstream:
                     out = EngineOutput.from_dict(raw) if isinstance(raw, dict) else raw
@@ -285,6 +292,9 @@ class OpenAIPreprocessor(Operator):
                 yield {"__annotation__": "formatted_prompt", "data": prompt}
             if "token_ids" in pre.annotations:
                 yield {"__annotation__": "token_ids", "data": pre.token_ids}
+            if echo_text:
+                for idx in range(n):
+                    yield delta.chunk(echo_text, index=idx)
             finish_sent = [False] * n
             live = n
             try:

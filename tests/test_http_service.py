@@ -123,6 +123,32 @@ async def test_completions_endpoint():
         assert "five dozen" in body["choices"][0]["text"]
 
 
+async def test_completions_echo_and_n():
+    """Legacy completions options: echo=True prefixes the prompt text;
+    n=2 returns two indexed choices."""
+    async with http_service() as (svc, session):
+        r = await session.post(
+            "/v1/completions",
+            json={
+                "model": "tiny",
+                "prompt": "pack my box",
+                "echo": True,
+            },
+        )
+        assert r.status == 200
+        body = await r.json()
+        assert body["choices"][0]["text"].startswith("pack my box")
+
+        r = await session.post(
+            "/v1/completions",
+            json={"model": "tiny", "prompt": "two choices", "n": 2},
+        )
+        assert r.status == 200
+        body = await r.json()
+        assert [c["index"] for c in body["choices"]] == [0, 1]
+        assert all("two choices" in c["text"] for c in body["choices"])
+
+
 async def test_error_paths():
     async with http_service() as (svc, session):
         r = await session.post(
