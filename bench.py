@@ -95,12 +95,16 @@ def main() -> None:
     async def run():
         # warmup at FULL concurrency so every compiled shape family
         # (prefill group sizes, decode batch) is built before measuring;
-        # distinct prompts so no measured request rides the prefix cache
-        warm_prompts = [
-            rng.randint(1, cfg.vocab_size, size=ISL).tolist()
-            for _ in range(CONCURRENCY)
-        ]
-        await asyncio.gather(*(one(p, {}) for p in warm_prompts))
+        # distinct prompts so no measured request rides the prefix cache.
+        # TWO waves: admission timing varies between waves, so the set of
+        # prefill-group row counts (power-of-two families) a wave hits is
+        # not deterministic — one wave can leave a family uncompiled
+        for _ in range(2):
+            warm_prompts = [
+                rng.randint(1, cfg.vocab_size, size=ISL).tolist()
+                for _ in range(CONCURRENCY)
+            ]
+            await asyncio.gather(*(one(p, {}) for p in warm_prompts))
         t0 = time.perf_counter()
         records = [dict() for _ in prompts]
         await asyncio.gather(*(one(p, r) for p, r in zip(prompts, records)))

@@ -254,6 +254,36 @@ async def test_pipeline_chat_logprobs_and_n():
     await engine.close()
 
 
+async def test_penalties_survive_preemption():
+    """A penalized stream preempted mid-decode (pages exhausted) must,
+    after re-admission, still see its full history in the count buffer —
+    _count_prompt recounts prompt + generated-so-far from seq.tokens."""
+    import asyncio
+
+    engine = make_engine(
+        num_pages=20,  # tight: concurrent streams force preemption
+        max_batch_size=4,
+        max_model_len=96,
+        prefill_chunk=16,
+        page_size=8,
+    )
+    prompts = [[10 + 7 * k, 11 + 7 * k, 12 + 7 * k] for k in range(6)]
+    results = await asyncio.gather(*(
+        collect(
+            engine,
+            request(p, max_tokens=8, greedy=True, frequency_penalty=100.0),
+        )
+        for p in prompts
+    ))
+    for (tokens, _), p in zip(results, prompts):
+        assert len(tokens) == 8
+        seen = set(p)
+        for t in tokens:
+            assert t not in seen, f"repeat {t} in {tokens} (prompt {p})"
+            seen.add(t)
+    await engine.close()
+
+
 async def test_engine_penalty_and_plain_mix_in_batch():
     """Penalized and plain requests share one decode dispatch."""
     import asyncio
