@@ -60,7 +60,11 @@ from dynamo_tpu.llm.protocols.common import (
     PreprocessedRequest,
 )
 from dynamo_tpu.models import llama
-from dynamo_tpu.ops.sampling import bump_counts, sample_tokens
+from dynamo_tpu.ops.sampling import (
+    TOP_LOGPROBS_MAX,
+    bump_counts,
+    sample_tokens,
+)
 from dynamo_tpu.parallel import mesh as meshmod
 from dynamo_tpu.runtime.pipeline.context import Context
 
@@ -85,7 +89,7 @@ class _DecodeBuild:
 
     __slots__ = ("positions", "tables", "act", "temp", "topk", "topp",
                  "fp", "prp", "rp", "seeds", "use_ext", "want_lps",
-                 "overrides", "active", "steps", "all_greedy")
+                 "want_tops", "overrides", "active", "steps", "all_greedy")
 
     def __init__(self, **kw):
         for k, v in kw.items():
@@ -277,6 +281,13 @@ class JaxEngine:
         self._inflight: Optional[_Dispatch] = None
         self._carry_toks = jnp.zeros(config.max_batch_size, jnp.int32)
         self._carry_lps = jnp.zeros(config.max_batch_size, jnp.float32)
+        # top-logprob alternatives carry (TOP_LOGPROBS_MAX wide)
+        self._carry_tid = jnp.zeros(
+            (config.max_batch_size, TOP_LOGPROBS_MAX), jnp.int32
+        )
+        self._carry_tlp = jnp.zeros(
+            (config.max_batch_size, TOP_LOGPROBS_MAX), jnp.float32
+        )
         # slot -> first-token carry override: (device token vector, row)
         # from a batched prefill dispatch, or a host int (disagg inject)
         self._overrides: dict[int, object] = {}
@@ -297,23 +308,23 @@ class JaxEngine:
         # per all_greedy variant — static so the pure-greedy batch skips
         # the sampling shortlist entirely)
         self._step_fn = jax.jit(
-            self._model_step, donate_argnums=(1,), static_argnums=(15, 16)
+            self._model_step, donate_argnums=(1,), static_argnums=(15, 16, 24)
         )
         # prefill step on the penalty/seeded path (separate trace: counts
         # threaded through, donated so the scatter updates in place)
         self._step_ext_fn = jax.jit(
-            self._model_step, donate_argnums=(1, 17), static_argnums=(15, 16)
+            self._model_step, donate_argnums=(1, 17), static_argnums=(15, 16, 24)
         )
         # multi-step decode: `decode_steps` iterations per dispatch;
         # want_lps static so the common no-logprobs batch skips the
         # per-step logsumexp over [B, V]
         self._decode_fn = jax.jit(
-            self._decode_multi, donate_argnums=(1,), static_argnums=(11, 12)
+            self._decode_multi, donate_argnums=(1,), static_argnums=(11, 12, 21)
         )
         # decode with penalties / per-request seeds (rare path; counts
         # [B, V] int8 donated through the scan)
         self._decode_ext_fn = jax.jit(
-            self._decode_multi, donate_argnums=(1, 13), static_argnums=(11, 12)
+            self._decode_multi, donate_argnums=(1, 13), static_argnums=(11, 12, 21)
         )
         # occurrence counts for penalty sampling, allocated on first use
         # (B x V int8; ~33 MB at B=256, V=128k)
@@ -414,7 +425,7 @@ class JaxEngine:
                     btables=None, embeds=None, embeds_mask=None,
                     all_greedy=False, want_lps=False, counts=None,
                     slot_rows=None, fp=None, prp=None, rp=None,
-                    final_row=None, seeds=None):
+                    final_row=None, seeds=None, want_tops=False):
         """One prefill step. Returns ((sampled [n], logprobs [n]), kv) —
         plus updated counts when the penalty path is active (counts
         gathered per slot row, the final-chunk rows' sampled token
@@ -425,8 +436,8 @@ class JaxEngine:
             if want_lps:
                 return sample_tokens(
                     lg, key, temp, topk, topp, all_greedy=all_greedy,
-                    return_logprobs=True, **kw,
-                )
+                    return_logprobs=True, top_n=TOP_LOGPROBS_MAX if want_tops else 0, **kw,
+                )  # (ids, lps[, top_ids, top_lps])
             toks = sample_tokens(
                 lg, key, temp, topk, topp, all_greedy=all_greedy, **kw
             )
@@ -440,8 +451,7 @@ class JaxEngine:
                 hidden, last_idx[:, None, None].astype(jnp.int32), axis=1
             )[:, 0]
             lg = llama.logits(params, self.model_cfg, last_h)
-            toks, lps = _sample(lg, key)
-            return (toks, lps), kv
+            return _sample(lg, key), kv
         if wtables is not None:
             # pallas prefill: page-scatter write + flash attention over
             # the streamed pages (the XLA row scatter serializes; the
@@ -471,11 +481,12 @@ class JaxEngine:
             # penalties/seeds on the first sampled token: counts rows
             # live per SLOT; gather this group's rows
             row_counts = counts[slot_rows]
-            toks, lps = _sample(
+            S = _sample(
                 lg, key, counts=row_counts,
                 freq_pen=fp, pres_pen=prp, rep_pen=rp,
                 seeds=seeds, positions=last_idx + positions[:, 0],
             )
+            toks = S[0]
             # bump only final-chunk rows (others' samples are garbage);
             # scatter back through the slot mapping
             cur = counts[slot_rows, toks].astype(jnp.int32)
@@ -483,14 +494,14 @@ class JaxEngine:
             counts = counts.at[slot_rows, toks].set(
                 jnp.minimum(cur + inc, 127).astype(jnp.int8)
             )
-            return (toks, lps), kv, counts
-        toks, lps = _sample(lg, key)
-        return (toks, lps), kv
+            return S, kv, counts
+        return _sample(lg, key), kv
 
     def _decode_multi(self, params, kv, tokens, carry_lps, positions,
                       block_tables, active, temp, topk, topp, key,
                       all_greedy=False, want_lps=False, counts=None,
-                      fp=None, prp=None, rp=None, seeds=None, fresh=None):
+                      fp=None, prp=None, rp=None, seeds=None, fresh=None,
+                      carry_tid=None, carry_tlp=None, want_tops=False):
         """`decode_steps` decode iterations in ONE dispatch (lax.scan with
         on-device token feedback + slot computation) — the antidote to
         per-token host round trips, which dominate wall clock when the
@@ -571,41 +582,50 @@ class JaxEngine:
                 if want_lps:
                     return sample_tokens(
                         lg, sub, temp, topk, topp, all_greedy=all_greedy,
-                        return_logprobs=True, **kw,
-                    )
+                        return_logprobs=True, top_n=TOP_LOGPROBS_MAX if want_tops else 0,
+                        **kw,
+                    )  # (ids, lps[, top_ids, top_lps])
                 t = sample_tokens(
                     lg, sub, temp, topk, topp, all_greedy=all_greedy, **kw
                 )
                 return t, jnp.zeros(t.shape[0], jnp.float32)
 
             if use_pen:
-                toks, lps = _sample(
+                ys = _sample(
                     counts=counts, freq_pen=fp, pres_pen=prp, rep_pen=rp,
                     seeds=seeds, positions=positions,
                 )
+                toks = ys[0]
                 new_counts = bump_counts(counts, toks, active)
-                return (toks, positions + 1, kv, key, new_counts), (toks, lps)
-            toks, lps = _sample()
-            return (toks, positions + 1, kv, key), (toks, lps)
+                return (toks, positions + 1, kv, key, new_counts), ys
+            ys = _sample()
+            return (ys[0], positions + 1, kv, key), ys
 
         if use_pen:
-            (_, _, kv, _, counts), (out, out_lps) = jax.lax.scan(
+            (_, _, kv, _, counts), out_t = jax.lax.scan(
                 body, (tokens, positions, kv, key, counts), None,
                 length=self.config.decode_steps,
             )
         else:
-            (_, _, kv, _), (out, out_lps) = jax.lax.scan(
+            (_, _, kv, _), out_t = jax.lax.scan(
                 body, (tokens, positions, kv, key), None,
                 length=self.config.decode_steps,
             )
         # row 0 = the input carry (prefill first tokens ride in via slot
         # overrides): syncing the dispatch delivers them with no separate
         # fetch — a per-sequence fetch costs a full tunnel RTT
-        toks_all = jnp.concatenate([tokens[None], out], axis=0)
-        lps_all = jnp.concatenate([carry_lps[None], out_lps], axis=0)
+        S = (
+            jnp.concatenate([tokens[None], out_t[0]], axis=0),
+            jnp.concatenate([carry_lps[None], out_t[1]], axis=0),
+        )
+        if want_tops:
+            S = S + (
+                jnp.concatenate([carry_tid[None], out_t[2]], axis=0),
+                jnp.concatenate([carry_tlp[None], out_t[3]], axis=0),
+            )
         if use_pen:
-            return (toks_all, lps_all), kv, counts
-        return (toks_all, lps_all), kv
+            return S, kv, counts
+        return S, kv
 
     # ------------------------------------------------------------------
     # engine protocol
@@ -1046,7 +1066,9 @@ class JaxEngine:
                         self._finish(seq, FINISH_REASON_ERROR)
                         continue
                     if seq.num_computed >= seq.total_tokens:
-                        self._mark_decode_ready(seq, (tok1[0], tok1[1], 0))
+                        self._mark_decode_ready(
+                            seq, (tok1[0], tok1[1], tok1[2], tok1[3], 0)
+                        )
                     else:
                         self._prefilling.append(seq)
                 continue
@@ -1055,7 +1077,9 @@ class JaxEngine:
                     # final chunk: first token rides into the next decode
                     # dispatch as the slot's carry override, emitted from
                     # that dispatch's row 0 at sync — no per-seq fetch
-                    self._mark_decode_ready(seq, (toks[0], toks[1], j))
+                    self._mark_decode_ready(
+                        seq, (toks[0], toks[1], toks[2], toks[3], j)
+                    )
                 else:
                     self._prefilling.append(seq)
         await asyncio.sleep(0)
@@ -1179,25 +1203,31 @@ class JaxEngine:
                 bool((temp <= 0.0).all()),
                 any(s.want_logprobs for s in seqs),
             )
+            want_tops = any(s.top_logprobs > 0 for s in seqs)
             if use_ext:
-                (toks, lps), self.kv, self._counts = self._step_ext_fn(
+                S, self.kv, self._counts = self._step_ext_fn(
                     *common, self._ensure_counts(), jnp.asarray(slot_rows),
                     jnp.asarray(fp), jnp.asarray(prp), jnp.asarray(rp),
-                    jnp.asarray(final_row), jnp.asarray(seeds),
+                    jnp.asarray(final_row), jnp.asarray(seeds), want_tops,
+                )
+            elif want_tops:
+                S, self.kv = self._step_fn(
+                    *common, None, None, None, None, None, None, None, True
                 )
             else:
-                (toks, lps), self.kv = self._step_fn(*common)
+                S, self.kv = self._step_fn(*common)
         for j, seq in enumerate(seqs):
             chunk = min(seq.total_tokens - seq.num_computed, bucket)
             seq.num_computed += chunk
             self._register_full_pages(seq)
-        return toks, lps
+        # (toks, lps[, top_ids, top_lps]) -> uniform 4-tuple
+        return S if len(S) == 4 else (S[0], S[1], None, None)
 
     def _prefill_chunk_dispatch(self, seq: Sequence):
         """Single-sequence chunk dispatch (disagg prefill_only path);
         returns the sampled-token device vector [1] when this was the
         final chunk, else None."""
-        toks, _lps = self._prefill_group_dispatch([seq], self._bucket_for(
+        toks, _lps, _tid, _tlp = self._prefill_group_dispatch([seq], self._bucket_for(
             min(seq.total_tokens - seq.num_computed, self.config.prefill_chunk)
         ))
         return toks[:1] if seq.num_computed >= seq.total_tokens else None
@@ -1308,6 +1338,7 @@ class JaxEngine:
         seeds = np.full(b, -1, np.int32)
         use_ext = False
         want_lps = False
+        want_tops = False
         for i, seq in active:
             positions[i] = seq.device_pos
             tables[i, : len(seq.page_ids)] = seq.page_ids
@@ -1321,6 +1352,7 @@ class JaxEngine:
             seeds[i] = seq.seed
             use_ext = use_ext or seq.has_penalties or seq.seed >= 0
             want_lps = want_lps or seq.want_logprobs
+            want_tops = want_tops or seq.top_logprobs > 0
             seq.device_pos += k_steps
 
         overrides = {
@@ -1330,7 +1362,7 @@ class JaxEngine:
         return _DecodeBuild(
             positions=positions, tables=tables, act=act, temp=temp,
             topk=topk, topp=topp, fp=fp, prp=prp, rp=rp, seeds=seeds,
-            use_ext=use_ext, want_lps=want_lps,
+            use_ext=use_ext, want_lps=want_lps, want_tops=want_tops,
             overrides=overrides, active=active,
             steps=k_steps,
             all_greedy=bool((temp[act] <= 0.0).all()) if act.any() else True,
@@ -1346,6 +1378,7 @@ class JaxEngine:
     def _run_decode_dispatch_locked(self, bld: "_DecodeBuild") -> _Dispatch:
         toks = self._carry_toks
         lps = self._carry_lps
+        tid, tlp = self._carry_tid, self._carry_tlp
         fresh = np.zeros(len(self.slots), bool)  # rows carrying a token
         # never counted before (prefill first tokens, disagg injects)
         if bld.overrides:
@@ -1356,21 +1389,26 @@ class JaxEngine:
             ints: list[tuple[int, int]] = []
             for slot, val in bld.overrides.items():
                 if isinstance(val, tuple):
-                    vec, lvec, row = val
-                    ent = by_vec.setdefault(id(vec), (vec, lvec, [], []))
-                    ent[2].append(slot)
-                    ent[3].append(row)
+                    vec, lvec, tidm, tlpm, row = val
+                    ent = by_vec.setdefault(
+                        id(vec), (vec, lvec, tidm, tlpm, [], [])
+                    )
+                    ent[4].append(slot)
+                    ent[5].append(row)
                 else:
                     # disagg-injected first token: sampled remotely, never
                     # counted locally -> bump as fresh in the decode scan
                     fresh[slot] = True
                     ints.append((slot, int(val)))
-            for vec, lvec, slots, rows in by_vec.values():
+            for vec, lvec, tidm, tlpm, slots, rows in by_vec.values():
                 sl = jnp.asarray(slots, jnp.int32)
                 rw = jnp.asarray(rows, jnp.int32)
                 toks = toks.at[sl].set(vec[rw])
                 if bld.want_lps:  # each .at[].set is a tunnel dispatch;
                     lps = lps.at[sl].set(lvec[rw])  # skip when unused
+                if bld.want_tops and tidm is not None:
+                    tid = tid.at[sl].set(tidm[rw])
+                    tlp = tlp.at[sl].set(tlpm[rw])
             if ints:
                 sl = jnp.asarray([s for s, _ in ints], jnp.int32)
                 toks = toks.at[sl].set(
@@ -1380,37 +1418,55 @@ class JaxEngine:
                     # remotely-sampled first tokens (disagg) have no
                     # local logprob; NaN -> emitted as None
                     lps = lps.at[sl].set(jnp.nan)
+                if bld.want_tops:
+                    tlp = tlp.at[sl].set(jnp.nan)
         self._key, sub = jax.random.split(self._key)
+        fn = self._decode_ext_fn if bld.use_ext else self._decode_fn
+        res = fn(
+            self.params, self.kv,
+            toks, lps, jnp.asarray(bld.positions), jnp.asarray(bld.tables),
+            jnp.asarray(bld.act), jnp.asarray(bld.temp),
+            jnp.asarray(bld.topk), jnp.asarray(bld.topp),
+            sub, bld.all_greedy, bld.want_lps,
+            self._ensure_counts() if bld.use_ext else None,
+            jnp.asarray(bld.fp) if bld.use_ext else None,
+            jnp.asarray(bld.prp) if bld.use_ext else None,
+            jnp.asarray(bld.rp) if bld.use_ext else None,
+            jnp.asarray(bld.seeds) if bld.use_ext else None,
+            jnp.asarray(fresh) if bld.use_ext else None,
+            tid if bld.want_tops else None,
+            tlp if bld.want_tops else None,
+            bld.want_tops,
+        )
         if bld.use_ext:
-            (out, out_lps), self.kv, self._counts = self._decode_ext_fn(
-                self.params, self.kv,
-                toks, lps, jnp.asarray(bld.positions), jnp.asarray(bld.tables),
-                jnp.asarray(bld.act), jnp.asarray(bld.temp),
-                jnp.asarray(bld.topk), jnp.asarray(bld.topp),
-                sub, bld.all_greedy, bld.want_lps, self._ensure_counts(),
-                jnp.asarray(bld.fp), jnp.asarray(bld.prp),
-                jnp.asarray(bld.rp), jnp.asarray(bld.seeds),
-                jnp.asarray(fresh),
-            )
+            S, self.kv, self._counts = res
         else:
-            (out, out_lps), self.kv = self._decode_fn(
-                self.params, self.kv,
-                toks, lps, jnp.asarray(bld.positions), jnp.asarray(bld.tables),
-                jnp.asarray(bld.act), jnp.asarray(bld.temp),
-                jnp.asarray(bld.topk), jnp.asarray(bld.topp),
-                sub, bld.all_greedy, bld.want_lps,
-            )
+            S, self.kv = res
         self._step_count += 1
-        self._carry_toks = out[-1]
-        self._carry_lps = out_lps[-1]
-        out.copy_to_host_async()
-        out_lps.copy_to_host_async()
-        return _Dispatch((out, out_lps), bld.active, bld.steps)
+        self._carry_toks = S[0][-1]
+        self._carry_lps = S[1][-1]
+        if bld.want_tops:
+            self._carry_tid = S[2][-1]
+            self._carry_tlp = S[3][-1]
+        for arr in S:
+            arr.copy_to_host_async()
+        return _Dispatch(S, bld.active, bld.steps)
 
     async def _sync_dispatch(self, d: _Dispatch) -> None:
-        out, out_lps = await asyncio.to_thread(
-            lambda: (np.asarray(d.out_dev[0]), np.asarray(d.out_dev[1]))
-        )  # [K+1, B] each
+        arrs = await asyncio.to_thread(
+            lambda: tuple(np.asarray(a) for a in d.out_dev)
+        )  # (toks, lps[, top_ids, top_lps]) each [K+1, B(, 8)]
+        out, out_lps = arrs[0], arrs[1]
+        tops = arrs[2:] if len(arrs) == 4 else None
+
+        def top_list(seq, step, i):
+            if tops is None or not seq.top_logprobs:
+                return None
+            return [
+                [int(tops[0][step, i, j]), float(tops[1][step, i, j])]
+                for j in range(seq.top_logprobs)
+            ]
+
         # row 0 is the dispatch's input carry: sequences that entered with
         # a freshly-prefilled first token emit it here, in stream order
         # before their decode tokens — one fetch covers everything
@@ -1420,7 +1476,7 @@ class JaxEngine:
                 seq.num_computed = seq.total_tokens  # prefill KV all valid
                 self._append_token(
                     seq, int(out[0, i]), logprob=float(out_lps[0, i]),
-                    extra_meta=seq.first_meta,
+                    tops=top_list(seq, 0, i), extra_meta=seq.first_meta,
                 )
                 seq.first_meta = None
         for step in range(1, out.shape[0]):
@@ -1431,7 +1487,8 @@ class JaxEngine:
                 seq.num_computed += 1
                 self._register_full_pages(seq)
                 self._append_token(
-                    seq, int(out[step, i]), logprob=float(out_lps[step, i])
+                    seq, int(out[step, i]), logprob=float(out_lps[step, i]),
+                    tops=top_list(seq, step, i),
                 )
 
     def _ensure_pages_through(self, seq: Sequence, upto_pos: int) -> bool:
@@ -1607,7 +1664,8 @@ class JaxEngine:
 
     def _append_token(
         self, seq: Sequence, token: int,
-        logprob: Optional[float] = None, extra_meta: Optional[dict] = None,
+        logprob: Optional[float] = None, tops: Optional[list] = None,
+        extra_meta: Optional[dict] = None,
     ) -> None:
         seq.blocks.extend([token])
         seq.generated += 1
@@ -1619,6 +1677,11 @@ class JaxEngine:
                 seq.cum_logprob += lp
             frame.log_probs = [lp]
             frame.cum_log_probs = seq.cum_logprob
+            if tops is not None:
+                # NaN alternatives (disagg first token) are dropped
+                frame.top_log_probs = [
+                    [e for e in tops if e[1] == e[1]]
+                ]
         if extra_meta:
             frame.meta = extra_meta
         seq.out_queue.put_nowait(frame.to_dict())

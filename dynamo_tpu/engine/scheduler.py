@@ -73,6 +73,7 @@ class Sequence:
     repetition_penalty: float = 1.0
     seed: int = -1                 # -1 = engine stream key
     want_logprobs: bool = False
+    top_logprobs: int = 0          # alternatives per position (<= 8)
     cum_logprob: float = 0.0
     max_new_tokens: int = 0
     eos_ids: frozenset[int] = frozenset()
@@ -107,6 +108,13 @@ class Sequence:
         )
         seq.seed = int(so.seed) if so.seed is not None else -1
         seq.want_logprobs = bool(getattr(so, "logprobs", False))
+        from dynamo_tpu.ops.sampling import TOP_LOGPROBS_MAX
+
+        seq.top_logprobs = (
+            max(0, min(int(getattr(so, "top_logprobs", 0) or 0),
+                       TOP_LOGPROBS_MAX))
+            if seq.want_logprobs else 0
+        )
         budget = max_model_len - seq.prompt_len
         mt = pre.stop_conditions.max_tokens
         seq.max_new_tokens = max(0, min(budget, mt) if mt is not None else budget)

@@ -158,6 +158,7 @@ class Backend(Operator):
             # fully-jailed frame's meta is not dropped
             pending_ids: list[int] = []
             pending_lps: list = []   # aligned with pending_ids (logprobs mode)
+            pending_tops: list = []  # aligned top-alternative lists
             pending_meta: dict = {}
             cum_lp = None
             async for raw in upstream:
@@ -169,6 +170,7 @@ class Backend(Operator):
                     yield EngineOutput(
                         token_ids=pending_ids,
                         log_probs=pending_lps or None,
+                        top_log_probs=pending_tops or None,
                         cum_log_probs=cum_lp,
                         finish_reason=FINISH_REASON_CANCELLED,
                         meta=pending_meta or None,
@@ -194,6 +196,8 @@ class Backend(Operator):
                     cum_lp = (cum_lp or 0.0) + sum(
                         lp for lp in consumed_lps if lp is not None
                     )
+                if out.top_log_probs:
+                    pending_tops.extend(out.top_log_probs[:consumed])
                 if out.meta:
                     pending_meta.update(out.meta)
                 if text_parts or decoder.finished:
@@ -201,12 +205,14 @@ class Backend(Operator):
                         token_ids=pending_ids,
                         text="".join(text_parts) or None,
                         log_probs=pending_lps or None,
+                        top_log_probs=pending_tops or None,
                         cum_log_probs=cum_lp,
                         finish_reason=decoder.finish_reason,
                         meta=pending_meta or None,
                     ).to_dict()
                     pending_ids = []
                     pending_lps = []
+                    pending_tops = []
                     pending_meta = {}
                 if decoder.finished:
                     # tell the engine to stop producing (remote: stop frame)
@@ -219,6 +225,7 @@ class Backend(Operator):
                         token_ids=pending_ids,
                         text=decoder.flush(),
                         log_probs=pending_lps or None,
+                        top_log_probs=pending_tops or None,
                         cum_log_probs=cum_lp,
                         finish_reason=out.finish_reason,
                         meta=pending_meta or None,
@@ -231,6 +238,7 @@ class Backend(Operator):
                     token_ids=pending_ids,
                     text=decoder.flush(),
                     log_probs=pending_lps or None,
+                    top_log_probs=pending_tops or None,
                     cum_log_probs=cum_lp,
                     finish_reason=FINISH_REASON_ERROR,
                     meta=pending_meta or None,

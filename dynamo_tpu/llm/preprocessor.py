@@ -214,14 +214,46 @@ class OpenAIPreprocessor(Operator):
             if not want_lps or not out.log_probs:
                 return None
             toks = [self.tokenizer.decode([t]) for t in out.token_ids]
+            tops = out.top_log_probs or [None] * len(toks)
+
+            def top_entries(alts):
+                if not alts:
+                    return []
+                return [
+                    {"token": self.tokenizer.decode([tid]), "logprob": lp}
+                    for tid, lp in alts
+                ]
+
             if kind == "chat":
                 return {
                     "content": [
-                        {"token": t, "logprob": lp}
-                        for t, lp in zip(toks, out.log_probs)
+                        {
+                            "token": t,
+                            "logprob": lp,
+                            **(
+                                {"top_logprobs": top_entries(alts)}
+                                if alts is not None else {}
+                            ),
+                        }
+                        for t, lp, alts in zip(toks, out.log_probs, tops)
                     ]
                 }
-            return {"tokens": toks, "token_logprobs": list(out.log_probs)}
+            payload = {"tokens": toks, "token_logprobs": list(out.log_probs)}
+            if out.top_log_probs:
+                # legacy shape: one {token: logprob} dict per position;
+                # distinct ids can decode to the same text (byte
+                # fallbacks) — keep the best logprob, don't drop mass
+                # to dict-overwrite order
+                def merged(alts):
+                    d: dict = {}
+                    for tid, lp in alts or []:
+                        t = self.tokenizer.decode([tid])
+                        if t not in d or lp > d[t]:
+                            d[t] = lp
+                    return d
+
+                payload["top_logprobs"] = [merged(a) for a in tops]
+            return payload
 
         n = max(1, pre.sampling_options.n or 1)
         if n == 1:

@@ -56,6 +56,9 @@ class SamplingOptions:
     greedy: bool = False
     # report per-token logprobs of the sampled tokens (OpenAI `logprobs`)
     logprobs: bool = False
+    # with logprobs: also the top-n alternatives per position (OpenAI
+    # `top_logprobs`; engine clamps to 8)
+    top_logprobs: int = 0
 
     def to_dict(self) -> dict:
         return asdict(self)
@@ -125,6 +128,8 @@ class EngineOutput:
     text: Optional[str] = None
     cum_log_probs: Optional[float] = None
     log_probs: Optional[list[float]] = None
+    # per emitted token: [[token_id, logprob] x n] alternatives
+    top_log_probs: Optional[list] = None
     finish_reason: Optional[str] = None
     # engine-side metadata (kv hit info, worker id, timing) for annotations
     meta: dict[str, Any] = field(default_factory=dict)
@@ -140,6 +145,7 @@ class EngineOutput:
             text=d.get("text"),
             cum_log_probs=d.get("cum_log_probs"),
             log_probs=d.get("log_probs"),
+            top_log_probs=d.get("top_log_probs"),
             finish_reason=d.get("finish_reason"),
             meta=dict(d.get("meta") or {}),
         )

@@ -71,6 +71,7 @@ class ChatCompletionRequest:
     frequency_penalty: Optional[float] = None
     presence_penalty: Optional[float] = None
     logprobs: bool = False
+    top_logprobs: int = 0
     tools: Optional[list[dict]] = None
     tool_choice: Any = None
     ext: DynExt = field(default_factory=DynExt)
@@ -103,6 +104,7 @@ class ChatCompletionRequest:
             frequency_penalty=body.get("frequency_penalty"),
             presence_penalty=body.get("presence_penalty"),
             logprobs=bool(body.get("logprobs", False)),
+            top_logprobs=int(body.get("top_logprobs") or 0),
             tools=body.get("tools"),
             tool_choice=body.get("tool_choice"),
             ext=DynExt.from_request(body),
@@ -121,6 +123,7 @@ class ChatCompletionRequest:
             seed=self.seed,
             greedy=self.ext.greed_sampling,
             logprobs=self.logprobs,
+            top_logprobs=self.top_logprobs if self.logprobs else 0,
         )
 
     def stop_conditions(self) -> StopConditions:
@@ -192,6 +195,7 @@ class CompletionRequest:
             # legacy API: logprobs=0 still returns the sampled token's
             # logprob (0 top-alternatives); only absence disables
             logprobs=self.logprobs is not None,
+            top_logprobs=int(self.logprobs or 0),
         )
 
     def stop_conditions(self) -> StopConditions:

@@ -34,6 +34,7 @@ import jax
 import jax.numpy as jnp
 
 CANDIDATES = 64  # shortlist width for top-k/top-p
+TOP_LOGPROBS_MAX = 8  # alternatives width (engine carry shapes match)
 
 
 def apply_penalties(
@@ -80,9 +81,12 @@ def sample_tokens(
     rep_pen: jnp.ndarray | None = None,     # [B] f32
     seeds: jnp.ndarray | None = None,       # [B] i32 (-1 = engine stream key)
     positions: jnp.ndarray | None = None,   # [B] i32 (seed derivation)
+    top_n: int = 0,            # static: also return top-n alternatives
 ):
-    """Returns sampled ids [B] i32, or (ids, logprobs [B] f32) when
-    `return_logprobs`.
+    """Returns sampled ids [B] i32; with `return_logprobs` adds the
+    sampled logprob [B] f32; with `top_n` > 0 additionally the top-n
+    alternative ids [B, n] + their raw-distribution logprobs [B, n]
+    (OpenAI `top_logprobs`).
 
     `all_greedy` is a trace-time flag the engine sets when no live slot
     samples (the common serving case): it skips the shortlist machinery
@@ -96,12 +100,23 @@ def sample_tokens(
         picked = jnp.take_along_axis(raw, ids[:, None], axis=-1)[:, 0]
         return picked - logz
 
+    def top_alternatives():
+        # EXACT top_k: unlike the internal sampling shortlist, these are
+        # API output — an approx_max_k miss would drop the true best
+        # tokens (even the sampled one) from the user-visible list
+        n = min(top_n, v)
+        t_lg, t_ids = jax.lax.top_k(raw, n)
+        logz = jax.nn.logsumexp(raw, axis=-1, keepdims=True)
+        return t_ids.astype(jnp.int32), t_lg - logz
+
     logits = raw
     if counts is not None:
         logits = apply_penalties(logits, counts, freq_pen, pres_pen, rep_pen)
 
     greedy_ids = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     if all_greedy:
+        if return_logprobs and top_n > 0:
+            return (greedy_ids, picked_logprobs(greedy_ids), *top_alternatives())
         if return_logprobs:
             return greedy_ids, picked_logprobs(greedy_ids)
         return greedy_ids
@@ -142,6 +157,8 @@ def sample_tokens(
     sampled_ids = jnp.take_along_axis(cand_ids, choice[:, None], axis=-1)[:, 0]
 
     ids = jnp.where(is_greedy, greedy_ids, sampled_ids).astype(jnp.int32)
+    if return_logprobs and top_n > 0:
+        return (ids, picked_logprobs(ids), *top_alternatives())
     if return_logprobs:
         return ids, picked_logprobs(ids)
     return ids

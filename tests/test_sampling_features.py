@@ -254,6 +254,68 @@ async def test_pipeline_chat_logprobs_and_n():
     await engine.close()
 
 
+async def test_engine_top_logprobs():
+    """top_logprobs: per position, the k best alternatives from the raw
+    distribution — the sampled greedy token must lead the list."""
+    engine = make_engine()
+    _, frames = await collect(
+        engine,
+        request([5, 6, 7], max_tokens=4, greedy=True, logprobs=True,
+                top_logprobs=3),
+    )
+    token_frames = [f for f in frames if f.get("token_ids")]
+    assert len(token_frames) == 4
+    for f in token_frames:
+        alts = f["top_log_probs"][0]
+        assert len(alts) == 3
+        # alternatives sorted descending; greedy sampled token == argmax
+        lps = [lp for _, lp in alts]
+        assert lps == sorted(lps, reverse=True)
+        assert alts[0][0] == f["token_ids"][0]
+        np.testing.assert_allclose(alts[0][1], f["log_probs"][0], rtol=1e-5)
+    await engine.close()
+
+
+async def test_pipeline_chat_top_logprobs():
+    from dynamo_tpu.llm.backend import Backend
+    from dynamo_tpu.llm.model_card import ModelDeploymentCard
+    from dynamo_tpu.llm.preprocessor import OpenAIPreprocessor
+    from dynamo_tpu.llm.protocols.openai import ChatCompletionRequest
+    from dynamo_tpu.runtime.pipeline.engine import link
+
+    from .fixtures import tiny_model_dir
+
+    card = ModelDeploymentCard.from_local_path(tiny_model_dir(), name="tiny")
+    engine = make_engine(
+        model=CFG.with_(vocab_size=512), max_model_len=256, num_pages=128
+    )
+    pipeline = link(OpenAIPreprocessor(card), Backend.from_card(card), engine)
+    req = ChatCompletionRequest.from_body({
+        "model": "tiny",
+        "messages": [{"role": "user", "content": "alternatives"}],
+        "max_tokens": 3,
+        "logprobs": True,
+        "top_logprobs": 2,
+        "dyn_ext": {"greed_sampling": True, "ignore_eos": True},
+    })
+    chunks = [c async for c in await pipeline.generate(Context(req))]
+    entries = [
+        e
+        for c in chunks
+        for ch in c.get("choices", [])
+        if ch.get("logprobs")
+        for e in ch["logprobs"]["content"]
+    ]
+    assert len(entries) == 3
+    for e in entries:
+        assert len(e["top_logprobs"]) == 2
+        assert all(
+            isinstance(a["token"], str) and a["logprob"] <= 0.0
+            for a in e["top_logprobs"]
+        )
+    await engine.close()
+
+
 async def test_penalties_survive_preemption():
     """A penalized stream preempted mid-decode (pages exhausted) must,
     after re-admission, still see its full history in the count buffer —
