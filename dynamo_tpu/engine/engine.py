@@ -633,6 +633,9 @@ class JaxEngine:
     async def generate(
         self, request: Context, _preloaded: Optional[tuple] = None
     ) -> AsyncIterator[dict]:
+        if self._closed:
+            # the loop has exited; a queued request would hang forever
+            raise RuntimeError("engine is closed")
         payload = request.payload
         pre = (
             PreprocessedRequest.from_dict(payload)

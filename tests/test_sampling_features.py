@@ -254,6 +254,20 @@ async def test_pipeline_chat_logprobs_and_n():
     await engine.close()
 
 
+async def test_generate_after_close_raises():
+    """A closed engine must refuse requests, not queue them forever."""
+    import pytest
+
+    engine = make_engine()
+    tokens, _ = await collect(engine, request([3, 4], max_tokens=2, greedy=True))
+    assert len(tokens) == 2
+    await engine.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        await engine.generate(
+            Context(request([5, 6], max_tokens=2, greedy=True).to_dict())
+        )
+
+
 async def test_engine_top_logprobs():
     """top_logprobs: per position, the k best alternatives from the raw
     distribution — the sampled greedy token must lead the list."""
