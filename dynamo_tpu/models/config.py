@@ -36,6 +36,11 @@ class ModelConfig:
     attn_bias: bool = False  # qwen2-style qkv bias
     rope_scaling: Optional[dict[str, Any]] = None
     dtype: str = "bfloat16"
+    # gemma-family: GeGLU activation, sqrt(d)-scaled embeddings, and
+    # (offset + w) norm-weight convention (gemma: 1.0)
+    hidden_act: str = "silu"
+    scale_embeddings: bool = False
+    norm_weight_offset: float = 0.0
     # sparse MoE (mixtral-style): 0 experts = dense FFN
     num_experts: int = 0
     num_experts_per_tok: int = 2
@@ -69,9 +74,21 @@ class ModelConfig:
             rope_theta=hf.get("rope_theta", 10000.0),
             rms_norm_eps=hf.get("rms_norm_eps", 1e-5),
             max_position_embeddings=hf.get("max_position_embeddings", 8192),
-            tie_word_embeddings=hf.get("tie_word_embeddings", False),
+            # GemmaConfig defaults tie_word_embeddings=True and
+            # to_diff_dict drops default values from config.json
+            tie_word_embeddings=hf.get(
+                "tie_word_embeddings", hf.get("model_type") == "gemma"
+            ),
             attn_bias=hf.get("model_type") == "qwen2",
             rope_scaling=hf.get("rope_scaling"),
+            # published Gemma configs put "gelu" in hidden_act with the
+            # real activation in hidden_activation; HF's GemmaMLP forces
+            # gelu_pytorch_tanh when the latter is absent
+            hidden_act=(
+                hf.get("hidden_activation") or "gelu_pytorch_tanh"
+            ) if hf.get("model_type") == "gemma" else "silu",
+            scale_embeddings=hf.get("model_type") == "gemma",
+            norm_weight_offset=1.0 if hf.get("model_type") == "gemma" else 0.0,
             num_experts=hf.get("num_local_experts", 0),
             num_experts_per_tok=hf.get("num_experts_per_tok", 2),
         )
@@ -207,6 +224,26 @@ TINY_MOE = _preset(ModelConfig(
     tie_word_embeddings=True,
     num_experts=4,
     num_experts_per_tok=2,
+))
+
+# Gemma-1 family: GeGLU MLP, sqrt(d)-scaled embeddings, (1+w) norms,
+# wide head_dim (256) with kv=1 multi-query attention on the 2B.
+_preset(ModelConfig(
+    name="gemma-2b",
+    vocab_size=256000,
+    hidden_size=2048,
+    intermediate_size=16384,
+    num_layers=18,
+    num_heads=8,
+    num_kv_heads=1,
+    head_dim=256,
+    rope_theta=10000.0,
+    rms_norm_eps=1e-6,
+    max_position_embeddings=8192,
+    tie_word_embeddings=True,
+    hidden_act="gelu_pytorch_tanh",
+    scale_embeddings=True,
+    norm_weight_offset=1.0,
 ))
 
 _preset(ModelConfig(

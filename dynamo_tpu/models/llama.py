@@ -342,8 +342,17 @@ def _attn_block(
     return proj, kv_k, kv_v
 
 
-def _mlp_block(lp: Params, x: jnp.ndarray, tp_axis=None) -> jnp.ndarray:
-    gate = jax.nn.silu(mm(x, lp["w_gate"]))
+_ACTIVATIONS = {
+    "silu": jax.nn.silu,
+    "gelu": lambda x: jax.nn.gelu(x, approximate=False),
+    "gelu_pytorch_tanh": lambda x: jax.nn.gelu(x, approximate=True),
+}
+
+
+def _mlp_block(
+    lp: Params, x: jnp.ndarray, tp_axis=None, act: str = "silu"
+) -> jnp.ndarray:
+    gate = _ACTIVATIONS[act](mm(x, lp["w_gate"]))
     up = mm(x, lp["w_up"])
     out = mm(gate * up, lp["w_down"])
     if tp_axis is not None:
@@ -382,6 +391,9 @@ def forward(
         else:
             real_mask = write_slots.reshape(b_, t_) != 0
     x = params["embed"][tokens]
+    if cfg.scale_embeddings:
+        # gemma: embedding outputs scaled by sqrt(d)
+        x = x * jnp.asarray(cfg.hidden_size ** 0.5, x.dtype)
     if embeds is not None:
         # LLaVA-style injection: image-patch positions take precomputed
         # embeddings instead of the placeholder tokens' lookups
@@ -401,7 +413,10 @@ def forward(
         new_v_layers.append(layer_v)
 
     kv = KVCache(k=tuple(new_k_layers), v=tuple(new_v_layers))
-    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    x = rms_norm(
+        x, params["final_norm"], cfg.rms_norm_eps,
+        weight_offset=cfg.norm_weight_offset,
+    )
     return x, kv
 
 
@@ -412,19 +427,20 @@ def layer_step(lp, cfg, x, cos, sin, kv_k, kv_v, write_slots, attn,
     stage executor (parallel/pipeline.py). `tp_axis` enables manual-tp
     semantics for use inside a shard_map (explicit psums after the
     row-parallel projections)."""
-    attn_in = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+    w_off = cfg.norm_weight_offset
+    attn_in = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps, weight_offset=w_off)
     attn_out, kv_k, kv_v = _attn_block(
         lp, cfg, attn_in, cos, sin, kv_k, kv_v, write_slots, attn, positions,
         tp_axis=tp_axis,
     )
     x = x + attn_out
-    mlp_in = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
+    mlp_in = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps, weight_offset=w_off)
     if cfg.num_experts:
         from dynamo_tpu.models.moe import moe_block
 
         x = x + moe_block(lp, cfg, mlp_in, real_mask=real_mask)
     else:
-        x = x + _mlp_block(lp, mlp_in, tp_axis=tp_axis)
+        x = x + _mlp_block(lp, mlp_in, tp_axis=tp_axis, act=cfg.hidden_act)
     return x, kv_k, kv_v
 
 

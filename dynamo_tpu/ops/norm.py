@@ -1,4 +1,7 @@
-"""RMSNorm with float32 accumulation (Llama-family)."""
+"""RMSNorm with float32 accumulation (Llama-family).
+
+`weight_offset`: Gemma stores norm weights as w with the multiplier
+being (1 + w) — pass 1.0 there, 0.0 for Llama/Mistral/Qwen."""
 
 from __future__ import annotations
 
@@ -6,9 +9,13 @@ import jax
 import jax.numpy as jnp
 
 
-def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float) -> jnp.ndarray:
+def rms_norm(
+    x: jnp.ndarray, weight: jnp.ndarray, eps: float,
+    weight_offset: float = 0.0,
+) -> jnp.ndarray:
     dtype = x.dtype
     xf = x.astype(jnp.float32)
     var = jnp.mean(xf * xf, axis=-1, keepdims=True)
     normed = xf * jax.lax.rsqrt(var + eps)
-    return (normed * weight.astype(jnp.float32)).astype(dtype)
+    w = weight.astype(jnp.float32) + weight_offset
+    return (normed * w).astype(dtype)

@@ -106,6 +106,8 @@ def pp_forward(
     pp = mesh.shape["pp"]
 
     x = params["embed"][tokens]
+    if cfg.scale_embeddings:  # gemma: sqrt(d)-scaled embedding outputs
+        x = x * jnp.asarray(cfg.hidden_size ** 0.5, x.dtype)
     inv_freq = jnp.asarray(rope_inv_freq(cfg))
     cos, sin = rope_cos_sin(inv_freq, positions)
 
@@ -186,5 +188,8 @@ def pp_forward(
       pos_mb, ws_mb, sm_mb)
 
     hidden = outs.reshape(b, *outs.shape[2:])
-    hidden = rms_norm(hidden, params["final_norm"], cfg.rms_norm_eps)
+    hidden = rms_norm(
+        hidden, params["final_norm"], cfg.rms_norm_eps,
+        weight_offset=cfg.norm_weight_offset,
+    )
     return hidden, (k_pool, v_pool)

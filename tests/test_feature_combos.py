@@ -104,3 +104,25 @@ async def test_quant_with_logprobs_and_penalties():
         assert t not in seen
         seen.add(t)
     await engine.close()
+
+
+async def test_gemma_config_serves_quantized():
+    """Gemma-family forward (GeGLU, scaled embeddings, (1+w) norms)
+    through the full engine, int8-quantized."""
+    gcfg = CFG.with_(
+        hidden_act="gelu_pytorch_tanh",
+        scale_embeddings=True,
+        norm_weight_offset=1.0,
+        rms_norm_eps=1e-6,
+    )
+    engine = make_engine(model=gcfg)
+    tokens, frames = await collect(engine, req([7, 8, 9], max_tokens=5))
+    assert len(tokens) == 5
+    # unquantized sanity run: random tiny weights give near-uniform
+    # logits, so int8-vs-bf16 greedy agreement is NOT guaranteed here —
+    # numeric agreement is asserted by test_model's HF oracle instead
+    engine2 = make_engine(model=gcfg, quantization=None)
+    tokens2, _ = await collect(engine2, req([7, 8, 9], max_tokens=5))
+    assert len(tokens2) == 5
+    for e in (engine, engine2):
+        await e.close()

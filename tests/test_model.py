@@ -216,3 +216,72 @@ def test_llama31_rope_scaling_matches_hf():
     )
     inv, _ = ROPE_INIT_FUNCTIONS["llama3"](hf_cfg, "cpu")
     np.testing.assert_allclose(rope_inv_freq(cfg), inv.numpy(), rtol=1e-6)
+
+
+def test_matches_hf_gemma():
+    """Gemma-family oracle: GeGLU MLP, sqrt(d)-scaled embeddings, (1+w)
+    norm convention — HF GemmaForCausalLM with our weights."""
+    torch = pytest.importorskip("torch")
+    from transformers import GemmaConfig, GemmaForCausalLM
+
+    gcfg = CFG.with_(
+        hidden_act="gelu_pytorch_tanh",
+        scale_embeddings=True,
+        norm_weight_offset=1.0,
+        rope_theta=10000.0,
+        rms_norm_eps=1e-6,
+    )
+    hf_cfg = GemmaConfig(
+        vocab_size=gcfg.vocab_size,
+        hidden_size=gcfg.hidden_size,
+        intermediate_size=gcfg.intermediate_size,
+        num_hidden_layers=gcfg.num_layers,
+        num_attention_heads=gcfg.num_heads,
+        num_key_value_heads=gcfg.num_kv_heads,
+        head_dim=gcfg.head_dim,
+        rope_theta=gcfg.rope_theta,
+        rms_norm_eps=gcfg.rms_norm_eps,
+        max_position_embeddings=gcfg.max_position_embeddings,
+        tie_word_embeddings=True,
+        hidden_act="gelu_pytorch_tanh",
+        hidden_activation="gelu_pytorch_tanh",
+        attention_bias=False,
+    )
+    with torch.no_grad():
+        model = GemmaForCausalLM(hf_cfg).eval()
+        params = llama.init_params(gcfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+        sd = model.state_dict()
+
+        def put(name, ours, transpose):
+            arr = np.asarray(ours, np.float32)
+            sd[name].copy_(torch.from_numpy(arr.T if transpose else arr))
+
+        put("model.embed_tokens.weight", params["embed"], False)
+        put("model.norm.weight", params["final_norm"], False)
+        for i, lp in enumerate(params["layers"]):
+            pre = f"model.layers.{i}."
+            put(pre + "input_layernorm.weight", lp["attn_norm"], False)
+            put(pre + "self_attn.q_proj.weight", lp["wq"], True)
+            put(pre + "self_attn.k_proj.weight", lp["wk"], True)
+            put(pre + "self_attn.v_proj.weight", lp["wv"], True)
+            put(pre + "self_attn.o_proj.weight", lp["wo"], True)
+            put(pre + "post_attention_layernorm.weight", lp["mlp_norm"], False)
+            put(pre + "mlp.gate_proj.weight", lp["w_gate"], True)
+            put(pre + "mlp.up_proj.weight", lp["w_up"], True)
+            put(pre + "mlp.down_proj.weight", lp["w_down"], True)
+
+        toks = np.random.RandomState(2).randint(1, 250, size=(1, 16))
+        hf_logits = model(torch.from_numpy(toks)).logits.numpy()
+
+    kv = _kv()
+    slots = _contig_slots(1, 16)[None]
+    hidden, _ = llama.forward(
+        params, gcfg,
+        jnp.asarray(toks, jnp.int32),
+        jnp.asarray(np.arange(16)[None], jnp.int32),
+        kv,
+        jnp.asarray(slots.ravel(), jnp.int32),
+        jnp.asarray(slots, jnp.int32),
+    )
+    ours = llama.logits(params, gcfg, hidden)
+    np.testing.assert_allclose(np.asarray(ours), hf_logits, rtol=2e-3, atol=2e-3)
