@@ -114,6 +114,53 @@ async def test_planner_connector_scales_through_operator():
             await op.stop()
 
 
+async def test_operator_against_native_hub():
+    """The reconciler's watch/KV machinery against the C++ hub daemon:
+    deploy + teardown driven purely through native-hub watches."""
+    import shutil
+
+    import pytest
+
+    if shutil.which("g++") is None:
+        pytest.skip("g++ unavailable")
+    from dynamo_tpu.runtime.hub import native
+    from dynamo_tpu.runtime.hub.client import HubClient
+
+    proc, port = native.spawn_hub()
+    client = await HubClient.connect(f"127.0.0.1:{port}")
+    op = GraphOperator(f"127.0.0.1:{port}", extra_env={"JAX_PLATFORMS": "cpu"})
+    await op.start()
+    try:
+        spec = {"entry": ENTRY, "services": {"EchoBackend": {"workers": 1}}}
+        await client.kv_put(GRAPH_PREFIX + "nat", json.dumps(spec).encode())
+        for _ in range(100):
+            if "nat" in op.deployments:
+                break
+            await asyncio.sleep(0.1)
+        assert "nat" in op.deployments
+
+        drt = await DistributedRuntime.from_settings(hub_addr=f"127.0.0.1:{port}")
+        try:
+            out = await _call(
+                drt, "dyn://sdktest.EchoFrontend.generate", {"text": "native hub"}
+            )
+            assert out == [{"word": "NATIVE"}, {"word": "HUB"}]
+        finally:
+            await drt.shutdown()
+
+        await client.kv_del(GRAPH_PREFIX + "nat")
+        for _ in range(100):
+            if "nat" not in op.deployments:
+                break
+            await asyncio.sleep(0.1)
+        assert op.deployments == {}
+    finally:
+        await op.stop()
+        await client.close()
+        proc.terminate()
+        proc.wait(timeout=5)
+
+
 async def test_operator_survives_bad_spec():
     async with hub_pair() as (server, client):
         op = GraphOperator(f"127.0.0.1:{server.port}")
