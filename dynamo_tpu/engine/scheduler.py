@@ -106,7 +106,13 @@ class Sequence:
         seq.repetition_penalty = float(
             so.repetition_penalty if so.repetition_penalty else 1.0
         )
-        seq.seed = int(so.seed) if so.seed is not None else -1
+        # Fold any user-supplied seed into the non-negative int32 domain:
+        # the engine stores seeds in int32 device buffers and uses -1 as
+        # the "unseeded" sentinel. Folding (rather than rejecting) keeps
+        # OpenAI-style arbitrary-width seeds (e.g. 2**40) and negative
+        # seeds reproducible instead of overflowing numpy assignment or
+        # silently losing determinism.
+        seq.seed = (int(so.seed) & 0x7FFFFFFF) if so.seed is not None else -1
         seq.want_logprobs = bool(getattr(so, "logprobs", False))
         from dynamo_tpu.ops.sampling import TOP_LOGPROBS_MAX
 

@@ -163,13 +163,11 @@ class HttpService:
         ctx = Context(req)
         try:
             stream = await engine.generate(ctx)
-        except RequestError as exc:
+        except Exception as exc:  # noqa: BLE001 — admission or engine failure
+            if not isinstance(exc, ValueError):
+                log.error("engine failed for %s", req.model, exc_info=exc)
             guard.close()
-            return _error_response(400, str(exc))
-        except Exception as exc:  # noqa: BLE001 — engine startup failure
-            log.error("engine failed for %s", req.model, exc_info=exc)
-            guard.close()
-            return _error_response(502, f"engine error: {exc}")
+            return _classify_error(exc)
 
         try:
             if req.stream:
@@ -185,6 +183,29 @@ class HttpService:
             guard.close()
 
     async def _stream_sse(self, request, ctx, stream, guard) -> web.StreamResponse:
+        # Peek the first item BEFORE committing the 200/SSE headers: with
+        # lazily-started streams (the n>1 fan-out) admission errors only
+        # surface at first iteration, and they should map to a real HTTP
+        # status, matching the eager n==1 path.
+        it = stream.__aiter__()
+        first_items: list = []
+        try:
+            first_items.append(await it.__anext__())
+        except StopAsyncIteration:
+            pass
+        except Exception as exc:  # noqa: BLE001 — mapped to a status code
+            if not isinstance(exc, ValueError):
+                log.error("stream failed before first frame for %s", ctx.id,
+                          exc_info=exc)
+            ctx.kill()
+            return _classify_error(exc)
+
+        async def _chained():
+            for x in first_items:
+                yield x
+            async for x in it:
+                yield x
+
         resp = web.StreamResponse(
             headers={
                 "Content-Type": "text/event-stream",
@@ -194,10 +215,15 @@ class HttpService:
         )
         await resp.prepare(request)
         try:
-            async for item in stream:
+            async for item in _chained():
                 if "__annotation__" in item:
-                    # reference: SSE `event:` lines for annotations
+                    # reference: SSE `event:` lines for annotations; the
+                    # internal "ready" frame becomes an SSE comment (spec:
+                    # lines starting with ':' are ignored by clients)
                     name, data = item["__annotation__"], item["data"]
+                    if name == "ready":
+                        await resp.write(b": ready\n\n")
+                        continue
                     await resp.write(
                         f"event: {name}\ndata: {json.dumps(data)}\n\n".encode()
                     )
@@ -211,12 +237,16 @@ class HttpService:
             log.info("client disconnected; killing request %s", ctx.id)
             ctx.kill()
             raise
-        except RuntimeError as exc:
-            # engine error mid-stream: emit an SSE error event then close
+        except Exception as exc:  # noqa: BLE001 — the 200 is already on the
+            # wire, so ANY mid-stream fault (engine, data-plane drop, codec)
+            # becomes an SSE error event + kill rather than an aiohttp
+            # unhandled-exception truncation
             log.error("stream error for request %s: %s", ctx.id, exc)
-            await resp.write(
-                f'event: error\ndata: {json.dumps({"message": str(exc)})}\n\n'.encode()
-            )
+            ctx.kill()
+            with contextlib.suppress(ConnectionResetError):
+                await resp.write(
+                    f'event: error\ndata: {json.dumps({"message": str(exc)})}\n\n'.encode()
+                )
         with contextlib.suppress(ConnectionResetError):
             await resp.write_eof()
         return resp
@@ -232,8 +262,9 @@ class HttpService:
                 full = await aggregate_chat_stream(_data_only())
             else:
                 full = await aggregate_completion_stream(_data_only())
-        except RuntimeError as exc:
-            return _error_response(502, f"engine error: {exc}")
+        except Exception as exc:  # noqa: BLE001 — mapped to a status code
+            ctx.kill()
+            return _classify_error(exc)
         guard.mark_ok()
         return web.json_response(full)
 
@@ -242,4 +273,14 @@ def _error_response(status: int, message: str) -> web.Response:
     return web.json_response(
         {"error": {"message": message, "type": "invalid_request_error"}}, status=status
     )
+
+
+def _classify_error(exc: Exception) -> web.Response:
+    """One policy for mapping stream/admission exceptions to HTTP status:
+    ValueError (incl. RequestError) = the request was invalid -> 400;
+    anything else = server fault -> 502. Post-admission stream faults are
+    normalized to RuntimeError by the preprocessor, so they land in 502."""
+    if isinstance(exc, ValueError):
+        return _error_response(400, str(exc))
+    return _error_response(502, f"engine error: {exc}")
 

@@ -260,6 +260,10 @@ class OpenAIPreprocessor(Operator):
             upstream = await next_engine.generate(request.map(pre.to_dict()))
 
             async def _out() -> AsyncIterator[dict]:
+                # instant first frame: admission succeeded — lets the HTTP
+                # layer's first-item peek commit SSE headers before prefill
+                # finishes (written as an SSE comment, invisible to clients)
+                yield {"__annotation__": "ready", "data": None}
                 # reference: annotations emitted ahead of the stream
                 if "formatted_prompt" in pre.annotations:
                     yield {"__annotation__": "formatted_prompt", "data": prompt}
@@ -291,45 +295,64 @@ class OpenAIPreprocessor(Operator):
         # cache shares the prompt compute; choices are merged by index —
         # reference behavior: vLLM's n sampling). Seeded requests derive
         # per-choice seeds so choices differ but stay reproducible.
-        streams = []
-        for idx in range(n):
-            d = pre.to_dict()
-            so = dict(d["sampling_options"])
-            if so.get("seed") is not None:
-                so["seed"] = int(so["seed"]) + idx
-            d["sampling_options"] = so
-            # forked contexts: choice idx finishing (backend stop) must
-            # not cancel its siblings; client disconnect cancels all
-            streams.append(await next_engine.generate(request.fork(d, str(idx))))
-
-        # bounded: pumps block when the client consumes slowly, keeping
-        # the n==1 path's backpressure
-        queue: asyncio.Queue = asyncio.Queue(maxsize=8)
-
-        async def _pump(idx: int, stream) -> None:
-            try:
-                async for raw in stream:
-                    await queue.put((idx, raw))
-            except Exception as exc:  # noqa: BLE001 — surfaced to the consumer
-                await queue.put((idx, exc))
-            finally:
-                await queue.put((idx, None))
-
-        tasks = [
-            asyncio.create_task(_pump(idx, s)) for idx, s in enumerate(streams)
-        ]
+        #
+        # Streams and pump tasks are created lazily inside the generator:
+        # if the caller never iterates the returned stream (e.g. it errors
+        # first), nothing was started, so nothing leaks generating tokens.
 
         async def _out_n() -> AsyncIterator[dict]:
-            if "formatted_prompt" in pre.annotations:
-                yield {"__annotation__": "formatted_prompt", "data": prompt}
-            if "token_ids" in pre.annotations:
-                yield {"__annotation__": "token_ids", "data": pre.token_ids}
-            if echo_text:
+            streams = []
+            forks = []
+            try:
                 for idx in range(n):
-                    yield delta.chunk(echo_text, index=idx)
+                    d = pre.to_dict()
+                    so = dict(d["sampling_options"])
+                    if so.get("seed") is not None:
+                        so["seed"] = int(so["seed"]) + idx
+                    d["sampling_options"] = so
+                    # forked contexts: choice idx finishing (backend stop)
+                    # must not cancel its siblings; client disconnect
+                    # cancels all
+                    fctx = request.fork(d, str(idx))
+                    forks.append(fctx)
+                    streams.append(await next_engine.generate(fctx))
+            except BaseException:
+                # mid-creation failure: already-admitted siblings would
+                # otherwise keep generating with no consumer — kill their
+                # contexts before surfacing the error
+                for fctx in forks:
+                    fctx.kill()
+                raise
+
+            # bounded: pumps block when the client consumes slowly, keeping
+            # the n==1 path's backpressure
+            queue: asyncio.Queue = asyncio.Queue(maxsize=8)
+
+            async def _pump(idx: int, stream) -> None:
+                try:
+                    async for raw in stream:
+                        await queue.put((idx, raw))
+                except Exception as exc:  # noqa: BLE001 — surfaced to the consumer
+                    await queue.put((idx, exc))
+                finally:
+                    await queue.put((idx, None))
+
+            tasks = [
+                asyncio.create_task(_pump(idx, s)) for idx, s in enumerate(streams)
+            ]
             finish_sent = [False] * n
             live = n
+            completed = False
             try:
+                # see n==1 path: instant post-admission frame for SSE TTFB
+                yield {"__annotation__": "ready", "data": None}
+                if "formatted_prompt" in pre.annotations:
+                    yield {"__annotation__": "formatted_prompt", "data": prompt}
+                if "token_ids" in pre.annotations:
+                    yield {"__annotation__": "token_ids", "data": pre.token_ids}
+                if echo_text:
+                    for idx in range(n):
+                        yield delta.chunk(echo_text, index=idx)
                 while live:
                     idx, raw = await queue.get()
                     if raw is None:
@@ -338,8 +361,12 @@ class OpenAIPreprocessor(Operator):
                     if isinstance(raw, Exception):
                         # one choice's engine failure fails the request
                         # (n==1 semantics) rather than masquerading as a
-                        # normally-finished choice
-                        raise raw
+                        # normally-finished choice. Past admission, any
+                        # stream fault is a server fault — normalize to
+                        # RuntimeError so HTTP maps it to 5xx, never 400.
+                        if isinstance(raw, RuntimeError):
+                            raise raw
+                        raise RuntimeError(f"engine stream failed: {raw}") from raw
                     out = EngineOutput.from_dict(raw) if isinstance(raw, dict) else raw
                     text = out.text
                     if text is None and out.tokens:
@@ -356,8 +383,15 @@ class OpenAIPreprocessor(Operator):
                     if not finish_sent[idx]:
                         yield delta.chunk(None, "stop", index=idx)
                 yield {**delta.chunk(None, None), "usage": delta.usage(), "choices": []}
+                completed = True
             finally:
                 for t in tasks:
                     t.cancel()
+                if not completed:
+                    # abnormal exit (error or abandoned mid-stream): stop
+                    # the engine-side sequences, don't rely on the caller
+                    # enumerating exception types
+                    for fctx in forks:
+                        fctx.kill()
 
         return _out_n()

@@ -220,7 +220,9 @@ class DeltaGenerator:
         self.model = model
         self.kind = kind
         self.created = int(time.time())
-        self._first = True
+        # choice indices that have already received their `delta.role`
+        # (OpenAI's convention is per-choice, not per-stream)
+        self._role_sent: set[int] = set()
         self.completion_tokens = 0
         self.prompt_tokens = 0
 
@@ -247,9 +249,9 @@ class DeltaGenerator:
         out = self._base()
         if self.kind == "chat":
             delta: dict[str, Any] = {}
-            if self._first:
+            if index not in self._role_sent:
                 delta["role"] = "assistant"
-                self._first = False
+                self._role_sent.add(index)
             if text:
                 delta["content"] = text
             choice = {"index": index, "delta": delta, "finish_reason": finish_reason}
@@ -337,7 +339,8 @@ async def aggregate_completion_stream(chunks: AsyncIterator[dict]) -> dict:
         for choice in chunk.get("choices", []):
             idx = choice.get("index", 0)
             acc = per.setdefault(
-                idx, {"text": [], "finish": None, "toks": [], "lps": []}
+                idx,
+                {"text": [], "finish": None, "toks": [], "lps": [], "tops": []},
             )
             if choice.get("text"):
                 acc["text"].append(choice["text"])
@@ -345,10 +348,11 @@ async def aggregate_completion_stream(chunks: AsyncIterator[dict]) -> dict:
             if lp:
                 acc["toks"].extend(lp.get("tokens") or [])
                 acc["lps"].extend(lp.get("token_logprobs") or [])
+                acc["tops"].extend(lp.get("top_logprobs") or [])
             if choice.get("finish_reason"):
                 acc["finish"] = choice["finish_reason"]
     if not per:
-        per[0] = {"text": [], "finish": None, "toks": [], "lps": []}
+        per[0] = {"text": [], "finish": None, "toks": [], "lps": [], "tops": []}
     choices = []
     for idx in sorted(per):
         acc = per[idx]
@@ -361,6 +365,8 @@ async def aggregate_completion_stream(chunks: AsyncIterator[dict]) -> dict:
             choice["logprobs"] = {
                 "tokens": acc["toks"], "token_logprobs": acc["lps"]
             }
+            if acc["tops"]:
+                choice["logprobs"]["top_logprobs"] = acc["tops"]
         choices.append(choice)
     out = {
         "id": base.get("id"),

@@ -156,7 +156,12 @@ async def test_full_pipeline_chat_echo():
     items = [i async for i in await pipeline.generate(Context(req))]
     annotations = [i for i in items if "__annotation__" in i]
     chunks = [i for i in items if "__annotation__" not in i]
-    assert {a["__annotation__"] for a in annotations} == {"formatted_prompt", "token_ids"}
+    # "ready" is the instant post-admission frame the HTTP layer uses to
+    # commit SSE headers before prefill completes
+    assert {a["__annotation__"] for a in annotations} == {
+        "ready", "formatted_prompt", "token_ids"
+    }
+    assert items[0]["__annotation__"] == "ready"
     text = "".join(
         c["choices"][0]["delta"].get("content", "")
         for c in chunks

@@ -374,3 +374,163 @@ async def test_engine_penalty_and_plain_mix_in_batch():
     assert len(t1) == 6 and len(t2) == 6
     assert len(set(t1)) == 6  # penalized stream has no repeats
     await engine.close()
+
+
+async def test_wide_and_negative_seeds_fold_and_reproduce():
+    """OpenAI-style seeds outside int32 (2**40) and negative seeds must
+    serve (no numpy OverflowError in the decode table build) and stay
+    reproducible — admission folds them into [0, 2**31)
+    (ADVICE r3: engine.py:1355 / scheduler.py:109)."""
+    engine = make_engine()
+    for seed in (2**40 + 17, -5):
+        a, _ = await collect(
+            engine, request([3, 4, 5], max_tokens=6, temperature=1.0, seed=seed)
+        )
+        b, _ = await collect(
+            engine, request([3, 4, 5], max_tokens=6, temperature=1.0, seed=seed)
+        )
+        assert len(a) == 6 and a == b, f"seed {seed} not reproducible: {a} vs {b}"
+    # a wide seed and its int32 fold are the SAME stream (documented fold)
+    c, _ = await collect(
+        engine,
+        request([3, 4, 5], max_tokens=6, temperature=1.0,
+                seed=(2**40 + 17) & 0x7FFFFFFF),
+    )
+    a, _ = await collect(
+        engine, request([3, 4, 5], max_tokens=6, temperature=1.0, seed=2**40 + 17)
+    )
+    assert c == a
+    await engine.close()
+
+
+def test_delta_generator_role_per_choice():
+    """n>1 chat streaming: every choice index gets `delta.role` on its
+    first chunk, not just the first chunk overall (ADVICE r3)."""
+    from dynamo_tpu.llm.protocols.openai import DeltaGenerator
+
+    d = DeltaGenerator("m", kind="chat")
+    c0 = d.chunk("a", index=0)
+    c1 = d.chunk("b", index=1)
+    c0b = d.chunk("c", index=0)
+    assert c0["choices"][0]["delta"].get("role") == "assistant"
+    assert c1["choices"][0]["delta"].get("role") == "assistant"
+    assert "role" not in c0b["choices"][0]["delta"]
+
+
+async def test_completion_aggregator_keeps_top_logprobs():
+    """Non-streaming /v1/completions with logprobs=N must carry the top-N
+    alternatives the streaming chunks emit (ADVICE r3: openai.py:346)."""
+    from dynamo_tpu.llm.protocols.openai import aggregate_completion_stream
+
+    async def _chunks():
+        yield {
+            "id": "x", "created": 1, "model": "m",
+            "choices": [{
+                "index": 0, "text": "hi",
+                "logprobs": {
+                    "tokens": ["hi"], "token_logprobs": [-0.1],
+                    "top_logprobs": [{"hi": -0.1, "yo": -2.0}],
+                },
+            }],
+        }
+        yield {
+            "id": "x", "created": 1, "model": "m",
+            "choices": [{
+                "index": 0, "text": "!", "finish_reason": "stop",
+                "logprobs": {
+                    "tokens": ["!"], "token_logprobs": [-0.2],
+                    "top_logprobs": [{"!": -0.2}],
+                },
+            }],
+        }
+
+    full = await aggregate_completion_stream(_chunks())
+    lp = full["choices"][0]["logprobs"]
+    assert lp["tokens"] == ["hi", "!"]
+    assert lp["top_logprobs"] == [{"hi": -0.1, "yo": -2.0}, {"!": -0.2}]
+
+
+async def test_n_gt_1_stream_never_iterated_cancels_cleanly():
+    """If the caller abandons an n>1 stream without iterating it, no
+    engine streams were started — the engine drains to idle instead of
+    generating until natural stop (ADVICE r3: preprocessor.py:318)."""
+    import asyncio
+
+    from dynamo_tpu.llm.backend import Backend
+    from dynamo_tpu.llm.model_card import ModelDeploymentCard
+    from dynamo_tpu.llm.preprocessor import OpenAIPreprocessor
+    from dynamo_tpu.llm.protocols.openai import ChatCompletionRequest
+    from dynamo_tpu.runtime.pipeline.engine import link
+
+    from .fixtures import tiny_model_dir
+
+    card = ModelDeploymentCard.from_local_path(tiny_model_dir(), name="tiny")
+    engine = make_engine(
+        model=CFG.with_(vocab_size=512), max_model_len=256, num_pages=128
+    )
+    pipeline = link(OpenAIPreprocessor(card), Backend.from_card(card), engine)
+    req = ChatCompletionRequest.from_body({
+        "model": "tiny",
+        "messages": [{"role": "user", "content": "abandoned"}],
+        "max_tokens": 64,
+        "n": 3,
+        "temperature": 1.0,
+        "dyn_ext": {"ignore_eos": True},
+    })
+    stream = await pipeline.generate(Context(req))
+    # never iterate `stream`; lazily-created pumps mean nothing started
+    del stream
+    await asyncio.sleep(0.05)
+    m = engine.metrics()
+    assert m["request_active_slots"] == 0 and m["num_requests_waiting"] == 0, (
+        f"abandoned n>1 request left live sequences: {m}"
+    )
+    await engine.close()
+
+
+async def test_n_gt_1_partial_fanout_failure_kills_admitted_siblings():
+    """If fork k's admission fails mid-creation, the already-admitted
+    forks 0..k-1 must have their contexts killed so the engine stops
+    generating for them (r4 review finding)."""
+    from dynamo_tpu.llm.backend import Backend
+    from dynamo_tpu.llm.model_card import ModelDeploymentCard
+    from dynamo_tpu.llm.preprocessor import OpenAIPreprocessor
+    from dynamo_tpu.llm.protocols.openai import ChatCompletionRequest
+    from dynamo_tpu.runtime.pipeline.engine import link
+
+    from .fixtures import tiny_model_dir
+
+    seen_ctxs = []
+
+    class FlakyEngine:
+        """Admits the first two forks, rejects the third."""
+
+        async def generate(self, ctx):
+            if len(seen_ctxs) >= 2:
+                raise ValueError("admission rejected")
+            seen_ctxs.append(ctx)
+
+            async def _gen():
+                yield {"token_ids": [1], "tokens": ["x"], "text": "x"}
+
+            return _gen()
+
+    card = ModelDeploymentCard.from_local_path(tiny_model_dir(), name="tiny")
+    pipeline = link(OpenAIPreprocessor(card), Backend.from_card(card), FlakyEngine())
+    req = ChatCompletionRequest.from_body({
+        "model": "tiny",
+        "messages": [{"role": "user", "content": "x"}],
+        "max_tokens": 4,
+        "n": 3,
+        "temperature": 1.0,
+    })
+    stream = await pipeline.generate(Context(req))
+    import pytest
+
+    with pytest.raises(ValueError, match="admission rejected"):
+        async for _ in stream:
+            pass
+    assert len(seen_ctxs) == 2
+    assert all(c.is_stopped() for c in seen_ctxs), (
+        "admitted sibling contexts must be killed on partial fan-out failure"
+    )
