@@ -30,8 +30,15 @@ class EngineConfig:
     # None = bf16 weights; "int8" = W8A8 dynamic quantization of the dense
     # projections + vocab head (ops/quant.py) — the TPU-native match for
     # the reference baselines' FP8 serving (docs/architecture.md:76-83).
-    # Attention, KV cache, norms, embeddings stay bf16.
+    # Attention activations, norms, embeddings stay bf16.
     quantization: Optional[str] = None
+
+    # None = KV pages in the model dtype; "int8" = per-token-per-kv-head
+    # symmetric int8 KV pages with f32 scale pools (ops/quant.py
+    # quantize_kv_rows). Decode attention streams every live page each
+    # step, so this halves the dominant HBM traffic of the decode phase;
+    # all attention math still runs f32 after in-kernel dequantization.
+    kv_quantization: Optional[str] = None
 
     # HBM->host KV offload tier (reference: lib/llm/src/kv reuse/manager):
     # 0 disables; else pages whose refcount hits 0 are write-through

@@ -173,6 +173,36 @@ class JaxEngine:
             if config.host_kv_pages:
                 raise ValueError("host KV offload unsupported with sp>1")
 
+        # int8 KV cache: per-token-per-kv-head quantized pages + f32 scale
+        # pools (ops/quant.quantize_kv_rows) — halves the page streaming
+        # that dominates decode. v1 scope: the serving paths (pallas +
+        # gather, prefill + decode, disagg, offload); ring (sp) and the
+        # pp stage executor keep model-dtype KV
+        self._kv_quant = config.kv_quantization
+        if self._kv_quant is not None and self._kv_quant != "int8":
+            raise ValueError(
+                f"unknown kv_quantization {config.kv_quantization!r}; "
+                "expected 'int8'"
+            )
+        if self._kv_quant and self._sp:
+            raise ValueError("kv_quantization unsupported with sp>1 (ring)")
+        if self._kv_quant and mc.pp > 1:
+            raise ValueError("kv_quantization unsupported with pp>1 (v1)")
+        if self._kv_quant and self._attn_pallas and config.page_size % 128:
+            # the int8 kernels put scale-page tokens in lanes: page_size
+            # must be a lane multiple for Mosaic to slice the scale tiles
+            if config.attn_backend == "pallas":
+                raise ValueError(
+                    f"kv_quantization with attn_backend='pallas' needs "
+                    f"page_size % 128 == 0 (got {config.page_size})"
+                )
+            log.warning(
+                "kv_quantization with page_size=%d (not a multiple of 128): "
+                "falling back to gather attention — use page_size=128 to "
+                "keep the pallas kernels", config.page_size,
+            )
+            self._attn_pallas = False
+
         # pipeline-parallel serving: pp > 1 runs the GPipe stage executor
         # (parallel/pipeline.py) — layers AND KV pools live stage-local;
         # gather attention (the pallas kernels are not pp-aware), no
@@ -234,7 +264,11 @@ class JaxEngine:
         self.num_pages = config.num_pages or self._auto_num_pages()
         self.page_size = config.page_size
         num_slots = self.num_pages * self.page_size
-        kv = llama.init_kv_cache(self.model_cfg, num_slots, dtype=self._dtype)
+        kv = llama.init_kv_cache(
+            self.model_cfg, num_slots, dtype=self._dtype,
+            kv_quant=self._kv_quant, page_size=self.page_size,
+            tp=config.mesh.tp,
+        )
         if self._pp:
             from dynamo_tpu.parallel.pipeline import (
                 pp_sharded_put,
@@ -247,9 +281,20 @@ class JaxEngine:
             )
             self.kv = (k_st, v_st)  # stacked [L, N, KW] pair in pp mode
         else:
+            # scale pools [P, SUBL, S] shard over tp on the sublane-row
+            # dim (each shard gets an aligned >=8-row block of its heads)
+            scale_sharding = jax.sharding.NamedSharding(
+                self.mesh, jax.sharding.PartitionSpec(None, "tp", None)
+            )
             self.kv = llama.KVCache(
                 k=tuple(jax.device_put(x, self._kv_sharding) for x in kv.k),
                 v=tuple(jax.device_put(x, self._kv_sharding) for x in kv.v),
+                ks=tuple(
+                    jax.device_put(x, scale_sharding) for x in kv.ks
+                ) if kv.quantized else None,
+                vs=tuple(
+                    jax.device_put(x, scale_sharding) for x in kv.vs
+                ) if kv.quantized else None,
             )
         self.params = params
 
@@ -271,8 +316,11 @@ class JaxEngine:
                 self.model_cfg.num_layers,
                 self.page_size,
                 self.model_cfg.num_kv_heads * self.model_cfg.head_dim,
-                dtype=self._dtype.dtype,
+                dtype=np.int8 if self._kv_quant else self._dtype.dtype,
                 on_event=self._emit_event,
+                scale_width=(
+                    self.model_cfg.num_kv_heads if self._kv_quant else None
+                ),
             )
 
         self.waiting: deque[Sequence] = deque()
@@ -335,19 +383,55 @@ class JaxEngine:
         # disagg KV transfer: in-place scatter of received blocks / gather
         # of computed blocks (reference: the NIXL read/write data plane,
         # patch nixl.py — here device<->host staged, see llm/disagg);
-        # wire format is layer-stacked [L, T, K*Hd]
-        self._inject_fn = jax.jit(
-            lambda kv, slots, nk, nv: llama.KVCache(
+        # wire format is layer-stacked [L, T, K*Hd] (+ [L, T, K] scales
+        # when the source engine runs an int8 KV cache)
+        kh = self.model_cfg.num_kv_heads
+        kv_tp = config.mesh.tp
+        from dynamo_tpu.ops.quant import gather_kv_scales, scatter_kv_scales
+
+        def _inject(kv, slots, nk, nv, nks=None, nvs=None):
+            # nks/nvs: dense wire scales [L, T, K] -> pool-layout scatter
+            return llama.KVCache(
                 k=tuple(x.at[slots].set(nk[l]) for l, x in enumerate(kv.k)),
                 v=tuple(x.at[slots].set(nv[l]) for l, x in enumerate(kv.v)),
-            ),
-            donate_argnums=(0,),
-        )
-        self._extract_fn = jax.jit(
-            lambda kv, slots: (
+                ks=tuple(
+                    scatter_kv_scales(x, slots, nks[l], kh, kv_tp)
+                    for l, x in enumerate(kv.ks)
+                ) if kv.quantized else None,
+                vs=tuple(
+                    scatter_kv_scales(x, slots, nvs[l], kh, kv_tp)
+                    for l, x in enumerate(kv.vs)
+                ) if kv.quantized else None,
+            )
+
+        self._inject_fn = jax.jit(_inject, donate_argnums=(0,))
+
+        def _extract(kv, slots):
+            out = (
                 jnp.stack([x[slots] for x in kv.k]),
                 jnp.stack([x[slots] for x in kv.v]),
             )
+            if kv.quantized:
+                out = out + (
+                    jnp.stack([
+                        gather_kv_scales(x, slots, kh, kv_tp) for x in kv.ks
+                    ]),
+                    jnp.stack([
+                        gather_kv_scales(x, slots, kh, kv_tp) for x in kv.vs
+                    ]),
+                )
+            return out
+
+        self._extract_fn = jax.jit(_extract)
+        # wire-format conversion for mixed quantized/unquantized disagg
+        # pairs: quantize bf16 payloads entering a quantized pool,
+        # dequantize int8 payloads entering a model-dtype pool
+        from dynamo_tpu.ops.quant import dequantize_kv_rows as _dq
+        from dynamo_tpu.ops.quant import quantize_kv_rows as _q
+
+        self._kv_quantize_fn = jax.jit(lambda a: _q(a, kh))
+        self._kv_dequantize_fn = jax.jit(
+            lambda a, s: _dq(a, s, out_dtype=self._dtype)
         )
 
     # ------------------------------------------------------------------
@@ -356,10 +440,18 @@ class JaxEngine:
     def _auto_num_pages(self) -> int:
         cfg, m = self.config, self.model_cfg
         tp = self.config.mesh.tp
-        page_bytes = (
-            m.num_layers * cfg.page_size * m.num_kv_heads * m.head_dim
-            * 2 * self._dtype.dtype.itemsize
-        ) // tp  # per-device bytes for one page's K+V
+        if self._kv_quant:
+            # int8 data pages + [SUBL, S] f32 scale tiles per pool
+            from dynamo_tpu.ops.quant import kv_scale_subl
+
+            data = cfg.page_size * m.num_kv_heads * m.head_dim
+            scales = kv_scale_subl(m.num_kv_heads, tp) * cfg.page_size * 4
+            page_bytes = m.num_layers * 2 * (data + scales) // tp
+        else:
+            page_bytes = (
+                m.num_layers * cfg.page_size * m.num_kv_heads * m.head_dim
+                * 2 * self._dtype.dtype.itemsize
+            ) // tp  # per-device bytes for one page's K+V
         fallback = cfg.max_batch_size * cfg.max_pages_per_seq + 17
         try:
             stats = jax.local_devices()[0].memory_stats()
@@ -460,7 +552,7 @@ class JaxEngine:
                 slot_matrix, write_tables=wtables, page_size=self.page_size,
                 interpret=self._attn_interpret, mesh=self._attn_mesh,
                 block_tables=btables, q_pos0=positions[:, 0],
-                lengths=last_idx + 1,
+                lengths=last_idx + 1, kv_tp=self.config.mesh.tp,
             )
         elif self._sp:
             # long-context mode: whole-prompt ring attention over sp
@@ -468,7 +560,10 @@ class JaxEngine:
                 slot_matrix, self.mesh, page_size=self.page_size
             )
         else:
-            attn = llama.AttnSpec.gather(slot_matrix)
+            attn = llama.AttnSpec.gather(
+                slot_matrix, page_size=self.page_size,
+                kv_tp=self.config.mesh.tp,
+            )
         hidden, kv = llama.forward(
             params, self.model_cfg, tokens, positions, kv, write_slots, attn,
             embeds=embeds, embeds_mask=embeds_mask,
@@ -551,6 +646,7 @@ class JaxEngine:
                     ).astype(jnp.int32),
                     interpret=self._attn_interpret,
                     mesh=self._attn_mesh,
+                    kv_tp=self.config.mesh.tp,
                 )
             else:
                 page_idx = jnp.minimum(positions // s, w - 1)
@@ -565,7 +661,9 @@ class JaxEngine:
                 wslots = jnp.where(
                     active & (positions < max_len), wslots, 0
                 ).astype(jnp.int32)
-                attn = llama.AttnSpec.gather(smat)
+                attn = llama.AttnSpec.gather(
+                    smat, page_size=s, kv_tp=self.config.mesh.tp
+                )
             if self._pp:
                 hidden, kv = self._pp_forward(
                     params, kv, tokens[:, None], positions[:, None],
@@ -710,10 +808,15 @@ class JaxEngine:
         first_token: int,
         k_arr: np.ndarray,
         v_arr: np.ndarray,
+        ks_arr: Optional[np.ndarray] = None,
+        vs_arr: Optional[np.ndarray] = None,
     ) -> AsyncIterator[dict]:
         """Decode-side disagg entry: like generate(), but the prompt's KV
         (computed by a remote prefill worker) is injected instead of
-        computed, and `first_token` (sampled remotely) seeds decode."""
+        computed, and `first_token` (sampled remotely) seeds decode.
+        `ks_arr`/`vs_arr` [L, T, K] are present when the prefill worker
+        serves an int8 KV cache (wire stays int8 — half the transfer
+        bytes); injection converts to this engine's KV dtype as needed."""
         payload = request.payload
         pre = (
             PreprocessedRequest.from_dict(payload)
@@ -727,16 +830,27 @@ class JaxEngine:
                 raise ValueError(
                     f"remote {name} KV shape {tuple(arr.shape)} != expected {want}"
                 )
-        preloaded = (int(first_token), k_arr, v_arr)
+        if (ks_arr is None) != (vs_arr is None):
+            raise ValueError("remote KV scales must come as a k/v pair")
+        if ks_arr is not None:
+            want_s = (m.num_layers, len(pre.token_ids), m.num_kv_heads)
+            for name, arr in (("ks", ks_arr), ("vs", vs_arr)):
+                if tuple(arr.shape) != want_s:
+                    raise ValueError(
+                        f"remote {name} scale shape {tuple(arr.shape)} != "
+                        f"expected {want_s}"
+                    )
+        preloaded = (int(first_token), k_arr, v_arr, ks_arr, vs_arr)
         return await self.generate(request, _preloaded=preloaded)
 
     async def prefill_only(
         self, pre: PreprocessedRequest, ctx: Optional[Context] = None
-    ) -> tuple[int, np.ndarray, np.ndarray]:
+    ) -> tuple:
         """Prefill-side disagg entry: compute the prompt's KV (+ first
         token), extract it host-side, and keep the pages in the prefix
-        cache for future hits. Returns (first_token, k, v) with k/v shaped
-        [L, T, Kh*Hd]."""
+        cache for future hits. Returns (first_token, k, v, ks, vs) with
+        k/v shaped [L, T, Kh*Hd]; ks/vs are [L, T, Kh] scale arrays on an
+        int8-KV engine (the wire format then stays int8), else None."""
         if self._pp:
             raise ValueError("disagg prefill_only unsupported with pp>1 (v1)")
         ctx = ctx or Context(pre.to_dict())
@@ -763,11 +877,13 @@ class JaxEngine:
 
             def _extract():
                 with self._kv_lock:  # vs the decode thread donating kv
-                    k, v = self._extract_fn(self.kv, jnp.asarray(slots))
-                return np.asarray(k), np.asarray(v)
+                    out = self._extract_fn(self.kv, jnp.asarray(slots))
+                return tuple(np.asarray(a) for a in out)
 
-            k_host, v_host = await asyncio.to_thread(_extract)
-            return first_token, k_host, v_host
+            arrs = await asyncio.to_thread(_extract)
+            if len(arrs) == 4:
+                return (first_token, *arrs)
+            return (first_token, arrs[0], arrs[1], None, None)
         finally:
             self.allocator.release(seq.page_ids)
 
@@ -1249,8 +1365,10 @@ class JaxEngine:
     def _inject_chunk(self, seq: Sequence) -> Optional[int]:
         """Scatter one chunk of remotely-computed KV into the sequence's
         pages (disagg decode side); returns the remotely-sampled first
-        token when injection is complete."""
-        first_token, k_arr, v_arr = seq.preloaded
+        token when injection is complete. Payload dtype is converted to
+        this engine's KV dtype when the two sides disagree (int8 wire ->
+        bf16 pool or vice versa)."""
+        first_token, k_arr, v_arr, ks_arr, vs_arr = seq.preloaded
         t = seq.total_tokens
         start = seq.num_computed  # locally-cached prefix needs no injection
         if start < t:
@@ -1263,9 +1381,30 @@ class JaxEngine:
             nv = np.zeros_like(nk)
             nk[:, :chunk] = k_arr[:, start : start + chunk]
             nv[:, :chunk] = v_arr[:, start : start + chunk]
+            nks = nvs = None
+            if ks_arr is not None:
+                sshape = (ks_arr.shape[0], bucket, ks_arr.shape[2])
+                nks = np.ones(sshape, np.float32)
+                nvs = np.ones(sshape, np.float32)
+                nks[:, :chunk] = ks_arr[:, start : start + chunk]
+                nvs[:, :chunk] = vs_arr[:, start : start + chunk]
             with self._kv_lock:
+                nkj, nvj = jnp.asarray(nk), jnp.asarray(nv)
+                if self._kv_quant and nks is None:
+                    # model-dtype wire into an int8 pool: quantize rows
+                    nkj, nksj = self._kv_quantize_fn(nkj)
+                    nvj, nvsj = self._kv_quantize_fn(nvj)
+                elif self._kv_quant:
+                    nksj, nvsj = jnp.asarray(nks), jnp.asarray(nvs)
+                elif nks is not None:
+                    # int8 wire into a model-dtype pool: dequantize
+                    nkj = self._kv_dequantize_fn(nkj, jnp.asarray(nks))
+                    nvj = self._kv_dequantize_fn(nvj, jnp.asarray(nvs))
+                    nksj = nvsj = None
+                else:
+                    nksj = nvsj = None
                 self.kv = self._inject_fn(
-                    self.kv, jnp.asarray(slots), jnp.asarray(nk), jnp.asarray(nv)
+                    self.kv, jnp.asarray(slots), nkj, nvj, nksj, nvsj
                 )
             seq.num_computed += chunk
             self._register_full_pages(seq)
@@ -1619,15 +1758,23 @@ class JaxEngine:
 
         def _gather():
             with self._kv_lock:
-                k, v = self._extract_fn(self.kv, jnp.asarray(slots))
-            return np.asarray(k), np.asarray(v)  # [L, n*ps, kw]
+                out = self._extract_fn(self.kv, jnp.asarray(slots))
+            return tuple(np.asarray(a) for a in out)  # [L, n*ps, ...] each
 
         consumed = 0
         try:
-            k, v = await asyncio.to_thread(_gather)
+            arrs = await asyncio.to_thread(_gather)
+            k, v = arrs[0], arrs[1]
             for i, (sh, lh, parent, pid, buf) in enumerate(batch):
-                buf.value[0] = k[:, i * ps : (i + 1) * ps]
-                buf.value[1] = v[:, i * ps : (i + 1) * ps]
+                sl = slice(i * ps, (i + 1) * ps)
+                if self._kv_quant:
+                    buf.value["kv"][0] = k[:, sl]
+                    buf.value["kv"][1] = v[:, sl]
+                    buf.value["scales"][0] = arrs[2][:, sl]
+                    buf.value["scales"][1] = arrs[3][:, sl]
+                else:
+                    buf.value[0] = k[:, sl]
+                    buf.value[1] = v[:, sl]
                 self.host_pool.put(sh, lh, parent, buf)  # consumes buf
                 consumed = i + 1
         except Exception:
@@ -1647,8 +1794,18 @@ class JaxEngine:
         layer.rs CopyStream H2D)."""
         ps = self.page_size
         blocks = seq.blocks.blocks[start_block : start_block + len(page_ids)]
-        nk = np.stack([self.host_pool.get(b.sequence_hash)[0] for b in blocks], axis=1)
-        nv = np.stack([self.host_pool.get(b.sequence_hash)[1] for b in blocks], axis=1)
+        bufs = [self.host_pool.get(b.sequence_hash) for b in blocks]
+        if self._kv_quant:
+            nk = np.stack([b["kv"][0] for b in bufs], axis=1)
+            nv = np.stack([b["kv"][1] for b in bufs], axis=1)
+            nks = np.stack([b["scales"][0] for b in bufs], axis=1)
+            nvs = np.stack([b["scales"][1] for b in bufs], axis=1)
+            nks = nks.reshape(nks.shape[0], -1, nks.shape[-1])
+            nvs = nvs.reshape(nvs.shape[0], -1, nvs.shape[-1])
+        else:
+            nk = np.stack([b[0] for b in bufs], axis=1)
+            nv = np.stack([b[1] for b in bufs], axis=1)
+            nks = nvs = None
         # [L, n, ps, kw] -> [L, n*ps, kw]
         nk = nk.reshape(nk.shape[0], -1, nk.shape[-1])
         nv = nv.reshape(nv.shape[0], -1, nv.shape[-1])
@@ -1657,7 +1814,9 @@ class JaxEngine:
         )
         with self._kv_lock:
             self.kv = self._inject_fn(
-                self.kv, jnp.asarray(slots), jnp.asarray(nk), jnp.asarray(nv)
+                self.kv, jnp.asarray(slots), jnp.asarray(nk), jnp.asarray(nv),
+                jnp.asarray(nks) if nks is not None else None,
+                jnp.asarray(nvs) if nvs is not None else None,
             )
         self.allocator.register(
             page_ids,

@@ -59,9 +59,17 @@ def device_transfer_kv(
         _expand_slots(dst_page_ids, dst_engine.page_size, n_tokens)
     )
 
-    # 1. gather on the source mesh: [L, n, kw] stacked rows
+    if bool(src_engine._kv_quant) != bool(dst_engine._kv_quant):
+        raise ValueError(
+            "device-path KV transfer needs matching kv_quantization on "
+            "both engines (mixed pairs go through the host-staged plane, "
+            "which converts on injection)"
+        )
+
+    # 1. gather on the source mesh: [L, n, kw] stacked rows (+ [L, n, K]
+    # scale rows on int8-KV engines — int8 over the wire, half the bytes)
     with src_engine._kv_lock:
-        k_rows, v_rows = src_engine._extract_fn(src_engine.kv, src_slots)
+        rows = src_engine._extract_fn(src_engine.kv, src_slots)
 
     # 2. reshard onto the destination pool's layout (device-to-device;
     # the tp-mismatch rearrange happens here as an XLA collective)
@@ -69,11 +77,8 @@ def device_transfer_kv(
     row_sharding = jax.sharding.NamedSharding(
         dst_sh.mesh, jax.sharding.PartitionSpec(None, None, "tp")
     )
-    k_rows = jax.device_put(k_rows, row_sharding)
-    v_rows = jax.device_put(v_rows, row_sharding)
+    rows = tuple(jax.device_put(r, row_sharding) for r in rows)
 
     # 3. scatter into the destination pool, in place
     with dst_engine._kv_lock:
-        dst_engine.kv = dst_engine._inject_fn(
-            dst_engine.kv, dst_slots, k_rows, v_rows
-        )
+        dst_engine.kv = dst_engine._inject_fn(dst_engine.kv, dst_slots, *rows)

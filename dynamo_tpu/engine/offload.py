@@ -52,12 +52,29 @@ class HostKvPool:
         kv_width: int,
         dtype=np.float32,
         on_event: Optional[Callable[[dict], None]] = None,
+        scale_width: Optional[int] = None,
     ):
+        """`scale_width` (= num_kv_heads) switches the pool to int8-KV
+        buffers: each page buffer becomes {"kv": int8 [2, L, ps, K*Hd],
+        "scales": f32 [2, L, ps, K]} — the quantized engine's pages land
+        here without a dequantize, so the host tier holds ~2x the pages
+        of a bf16 pool for the same RAM."""
         self.capacity = capacity_pages
+        self.scale_width = scale_width
         shape = (2, num_layers, page_size, kv_width)
-        self._buffers: Pool[np.ndarray] = Pool(
-            factory=lambda: np.empty(shape, dtype), capacity=capacity_pages
-        )
+        if scale_width:
+            sshape = (2, num_layers, page_size, scale_width)
+
+            def factory():
+                return {
+                    "kv": np.empty(shape, dtype),
+                    "scales": np.empty(sshape, np.float32),
+                }
+        else:
+            def factory():
+                return np.empty(shape, dtype)
+
+        self._buffers: Pool = Pool(factory=factory, capacity=capacity_pages)
         self._entries: "OrderedDict[int, HostPageEntry]" = OrderedDict()
         self.on_event = on_event
         self.lookups = 0

@@ -205,7 +205,7 @@ class PrefillHandler:
 
     async def _handle(self, req: RemotePrefillRequest) -> None:
         pre = PreprocessedRequest.from_dict(req.pre)
-        first_token, k, v = await self.engine.prefill_only(pre)
+        first_token, k, v, ks, vs = await self.engine.prefill_only(pre)
         num_layers = k.shape[0]
         parts = [
             (i, min(i + LAYERS_PER_PART, num_layers))
@@ -221,6 +221,12 @@ class PrefillHandler:
                 "k": _np_to_wire(k[lo:hi]),
                 "v": _np_to_wire(v[lo:hi]),
             }
+            if ks is not None:
+                # int8-KV engine: the wire stays int8 + scales (half the
+                # transfer bytes of a bf16 wire); the decode side converts
+                # to its own KV dtype on injection
+                payload["ks"] = _np_to_wire(ks[lo:hi])
+                payload["vs"] = _np_to_wire(vs[lo:hi])
             handle = await self.drt.data_plane_client.request(
                 req.decode_address,
                 req.ingest_subject,
@@ -242,7 +248,8 @@ class PrefillHandler:
 
 class _PendingTransfer:
     def __init__(self, total_parts: Optional[int] = None):
-        self.parts: dict[int, tuple[np.ndarray, np.ndarray]] = {}
+        # part -> (k, v, ks, vs); ks/vs None on a bf16 wire
+        self.parts: dict[int, tuple] = {}
         self.total: Optional[int] = total_parts
         self.first_token: Optional[int] = None
         self.ready = asyncio.Event()
@@ -288,7 +295,10 @@ class DisaggDecodeWorker:
             pending.total = d["total_parts"]
             pending.first_token = d["first_token"]
             pending.parts[d["part"]] = (
-                _np_from_wire(d["k"]), _np_from_wire(d["v"])
+                _np_from_wire(d["k"]),
+                _np_from_wire(d["v"]),
+                _np_from_wire(d["ks"]) if "ks" in d else None,
+                _np_from_wire(d["vs"]) if "vs" in d else None,
             )
             if len(pending.parts) == pending.total:
                 pending.ready.set()
@@ -360,8 +370,16 @@ class DisaggDecodeWorker:
             self._pending.pop(rid, None)
         k = np.concatenate([pending.parts[i][0] for i in range(pending.total)])
         v = np.concatenate([pending.parts[i][1] for i in range(pending.total)])
+        ks = vs = None
+        if pending.parts[0][2] is not None:
+            ks = np.concatenate(
+                [pending.parts[i][2] for i in range(pending.total)]
+            )
+            vs = np.concatenate(
+                [pending.parts[i][3] for i in range(pending.total)]
+            )
         return await self.engine.generate_remote(
-            request.map(pre.to_dict()), pending.first_token, k, v
+            request.map(pre.to_dict()), pending.first_token, k, v, ks, vs
         )
 
     def stats(self) -> dict[str, Any]:

@@ -25,7 +25,12 @@ import jax.numpy as jnp
 from dynamo_tpu.models.config import ModelConfig
 from dynamo_tpu.ops.attention import paged_attention, write_kv_slots
 from dynamo_tpu.ops.norm import rms_norm
-from dynamo_tpu.ops.quant import is_quantized, mm, quant_matmul
+from dynamo_tpu.ops.quant import (
+    is_quantized,
+    mm,
+    quant_matmul,
+    quantize_kv_rows,
+)
 from dynamo_tpu.ops.rope import apply_rope, rope_cos_sin, rope_inv_freq
 
 Params = dict[str, Any]
@@ -59,7 +64,8 @@ class AttnSpec:
 
     def __init__(self, slot_matrix=None, block_tables=None, lengths=None,
                  write_pos=None, page_size: int = 16, interpret: bool = False,
-                 mesh=None, write_tables=None, q_pos0=None, ring: bool = False):
+                 mesh=None, write_tables=None, q_pos0=None, ring: bool = False,
+                 kv_tp: int = 1):
         self.slot_matrix = slot_matrix
         self.block_tables = block_tables
         self.lengths = lengths
@@ -77,14 +83,18 @@ class AttnSpec:
         # token axis sharded over the mesh's sp axis — attention runs as a
         # ring over ICI (ops/ring_attention.py), KV still lands in the pool
         self.ring = ring
+        # tp degree of the int8-KV scale pools' row layout (static; only
+        # consulted when the cache is quantized)
+        self.kv_tp = kv_tp
 
     @classmethod
     def gather(cls, slot_matrix, write_tables=None, page_size: int = 16,
                interpret: bool = False, mesh=None, block_tables=None,
-               q_pos0=None, lengths=None):
+               q_pos0=None, lengths=None, kv_tp: int = 1):
         return cls(slot_matrix=slot_matrix, write_tables=write_tables,
                    page_size=page_size, interpret=interpret, mesh=mesh,
-                   block_tables=block_tables, q_pos0=q_pos0, lengths=lengths)
+                   block_tables=block_tables, q_pos0=q_pos0, lengths=lengths,
+                   kv_tp=kv_tp)
 
     @classmethod
     def ring(cls, slot_matrix, mesh, page_size: int = 16):
@@ -95,7 +105,7 @@ class AttnSpec:
 
     @classmethod
     def pallas_decode(cls, block_tables, lengths, page_size, write_pos=None,
-                      interpret=False, mesh=None):
+                      interpret=False, mesh=None, kv_tp: int = 1):
         return cls(
             block_tables=block_tables,
             lengths=lengths,
@@ -103,6 +113,7 @@ class AttnSpec:
             page_size=page_size,
             interpret=interpret,
             mesh=mesh,
+            kv_tp=kv_tp,
         )
 
 
@@ -111,12 +122,13 @@ jax.tree_util.register_pytree_node(
     lambda s: (
         (s.slot_matrix, s.block_tables, s.lengths, s.write_pos,
          s.write_tables, s.q_pos0),
-        (s.page_size, s.interpret, s.mesh, s.ring),
+        (s.page_size, s.interpret, s.mesh, s.ring, s.kv_tp),
     ),
     lambda aux, children: AttnSpec(
         slot_matrix=children[0], block_tables=children[1], lengths=children[2],
         write_pos=children[3], write_tables=children[4], q_pos0=children[5],
         page_size=aux[0], interpret=aux[1], mesh=aux[2], ring=aux[3],
+        kv_tp=aux[4],
     ),
 )
 
@@ -136,14 +148,29 @@ class KVCache(NamedTuple):
       "page" a strided scatter across the whole pool and every page DMA
       ~15x slower. [N, K*Hd] keeps row-major tiling, so a page
       ([page_size, K*Hd]) is one contiguous DMA and the reshape to
-      [num_pages, page_size, K*Hd] is a free bitcast."""
+      [num_pages, page_size, K*Hd] is a free bitcast.
+
+    int8 KV mode (`kv_quant="int8"`): k/v hold int8 and `ks`/`vs` hold
+    the per-token-per-kv-head f32 scale pools in the page-blocked
+    transposed layout `[num_pages, SUBL, page_size]` (tokens in lanes —
+    the only layout Mosaic can DMA/slice; see ops/quant.py). Decode
+    attention streams every live page per step, so int8 pages halve the
+    decode phase's dominant HBM traffic; the scale page adds SUBL*S*4
+    bytes per K*Hd*S-byte page (~6% at 8B dims). ks/vs are None in
+    unquantized mode."""
 
     k: tuple
     v: tuple
+    ks: tuple | None = None
+    vs: tuple | None = None
 
     @property
     def num_slots(self) -> int:
         return self.k[0].shape[0]
+
+    @property
+    def quantized(self) -> bool:
+        return self.ks is not None
 
     def stacked(self) -> tuple[jnp.ndarray, jnp.ndarray]:
         """[L, N, K*Hd] copies (host extraction / wire format only)."""
@@ -151,9 +178,30 @@ class KVCache(NamedTuple):
 
 
 def init_kv_cache(
-    cfg: ModelConfig, num_slots: int, dtype=jnp.bfloat16
+    cfg: ModelConfig, num_slots: int, dtype=jnp.bfloat16,
+    kv_quant: str | None = None, page_size: int = 16, tp: int = 1,
 ) -> KVCache:
     shape = (num_slots, cfg.num_kv_heads * cfg.head_dim)
+    if kv_quant is not None:
+        if kv_quant != "int8":
+            raise ValueError(
+                f"unknown kv_quant {kv_quant!r}; expected 'int8'"
+            )
+        from dynamo_tpu.ops.quant import init_kv_scale_pool
+
+        num_pages = num_slots // page_size
+        return KVCache(
+            k=tuple(jnp.zeros(shape, jnp.int8) for _ in range(cfg.num_layers)),
+            v=tuple(jnp.zeros(shape, jnp.int8) for _ in range(cfg.num_layers)),
+            ks=tuple(
+                init_kv_scale_pool(num_pages, page_size, cfg.num_kv_heads, tp)
+                for _ in range(cfg.num_layers)
+            ),
+            vs=tuple(
+                init_kv_scale_pool(num_pages, page_size, cfg.num_kv_heads, tp)
+                for _ in range(cfg.num_layers)
+            ),
+        )
     return KVCache(
         k=tuple(jnp.zeros(shape, dtype) for _ in range(cfg.num_layers)),
         v=tuple(jnp.zeros(shape, dtype) for _ in range(cfg.num_layers)),
@@ -166,11 +214,13 @@ def _attn_block(
     x: jnp.ndarray,          # [B, T, D]
     cos: jnp.ndarray,        # [B, T, Hd]
     sin: jnp.ndarray,
-    kv_k: jnp.ndarray,       # [N, K, Hd] this layer's pools
+    kv_k: jnp.ndarray,       # [N, K*Hd] this layer's pools (int8 when quantized)
     kv_v: jnp.ndarray,
     write_slots: jnp.ndarray,   # [B*T] int32
     attn: "AttnSpec",
     positions: jnp.ndarray,     # [B, T]
+    kv_ks=None,              # [N, K] f32 scale pools (int8 KV mode)
+    kv_vs=None,
     tp_axis=None,  # set when running INSIDE a shard_map (manual tp):
     # row-parallel projections then need an explicit psum
 ):
@@ -181,6 +231,7 @@ def _attn_block(
         tpn = jax.lax.axis_size(tp_axis)
         h //= tpn
         kh //= tpn
+    quant = kv_ks is not None
 
     q = mm(x, lp["wq"])
     k = mm(x, lp["wk"])
@@ -204,6 +255,21 @@ def _attn_block(
             page_size=attn.page_size,
             interpret=attn.interpret,
         )
+        new_k = k[:, 0].reshape(b, kh * hd)
+        new_v = v[:, 0].reshape(b, kh * hd)
+        if quant:
+            # quantize the new rows at trace time; the kernel injects the
+            # int8 rows + scale columns into their pages in VMEM. Dense
+            # [B, K] scales are padded into the pool's sublane-row layout
+            # so each tp shard receives an aligned [B, >=8] block.
+            from dynamo_tpu.ops.quant import _scale_rows, kv_scale_subl
+
+            new_k, nks_dense = quantize_kv_rows(new_k, kh)
+            new_v, nvs_dense = quantize_kv_rows(new_v, kh)
+            subl = kv_scale_subl(kh, attn.kv_tp)
+            rows = _scale_rows(kh, attn.kv_tp)
+            new_ks = jnp.ones((b, subl), jnp.float32).at[:, rows].set(nks_dense)
+            new_vs = jnp.ones((b, subl), jnp.float32).at[:, rows].set(nvs_dense)
         if attn.mesh is not None:
             # tensor parallel: every array argument that carries heads is
             # tp-sharded (q over H, new rows / pools over the folded K*Hd
@@ -211,26 +277,39 @@ def _attn_block(
             # write_pos replicate. Each shard runs the kernel on its
             # local heads — attention has no cross-head math.
             P = jax.sharding.PartitionSpec
+            # quant adds scale pools [P, SUBL, S] + new scale rows [B, SUBL]
+            scale_in = (
+                (P(None, "tp", None), P(None, "tp", None),
+                 P(None, "tp"), P(None, "tp")) if quant else ()
+            )
+            scale_out = (
+                (P(None, "tp", None), P(None, "tp", None)) if quant else ()
+            )
             fused = jax.shard_map(
                 fused,
                 mesh=attn.mesh,
                 in_specs=(
                     P(None, "tp", None), P(None, "tp"), P(None, "tp"),
                     P(None, "tp"), P(None, "tp"), P(), P(), P(),
+                    *scale_in,
                 ),
-                out_specs=(P(None, "tp", None), P(None, "tp"), P(None, "tp")),
+                out_specs=(
+                    P(None, "tp", None), P(None, "tp"), P(None, "tp"),
+                    *scale_out,
+                ),
                 check_vma=False,
             )
-        out, kv_k, kv_v = fused(
-            q[:, 0],
-            k[:, 0].reshape(b, kh * hd),
-            v[:, 0].reshape(b, kh * hd),
-            kv_k,
-            kv_v,
-            attn.block_tables,
-            attn.lengths,
-            attn.write_pos,
-        )
+        if quant:
+            out, kv_k, kv_v, kv_ks, kv_vs = fused(
+                q[:, 0], new_k, new_v, kv_k, kv_v,
+                attn.block_tables, attn.lengths, attn.write_pos,
+                kv_ks, kv_vs, new_ks, new_vs,
+            )
+        else:
+            out, kv_k, kv_v = fused(
+                q[:, 0], new_k, new_v, kv_k, kv_v,
+                attn.block_tables, attn.lengths, attn.write_pos,
+            )
         out = out[:, None]
     elif attn.write_tables is not None:
         # prefill page-scatter: whole [page, K*Hd] blocks via the pallas
@@ -243,27 +322,70 @@ def _attn_block(
         t_pad = -(-t // ps) * ps
         k2 = k.reshape(b, t, kh * hd)
         v2 = v.reshape(b, t, kh * hd)
+        ks2 = vs2 = None
+        if quant:
+            k2, ks2 = quantize_kv_rows(k2, kh)
+            v2, vs2 = quantize_kv_rows(v2, kh)
         if t_pad != t:
             k2 = jnp.pad(k2, ((0, 0), (0, t_pad - t), (0, 0)))
             v2 = jnp.pad(v2, ((0, 0), (0, t_pad - t), (0, 0)))
-        k_pages = k2.reshape(b * (t_pad // ps), ps, kh * hd)
-        v_pages = v2.reshape(b * (t_pad // ps), ps, kh * hd)
+            if quant:
+                # padding scale 1.0 (matches the pool's init value)
+                ks2 = jnp.pad(ks2, ((0, 0), (0, t_pad - t), (0, 0)),
+                              constant_values=1.0)
+                vs2 = jnp.pad(vs2, ((0, 0), (0, t_pad - t), (0, 0)),
+                              constant_values=1.0)
+        n_pg = b * (t_pad // ps)
+        k_pages = k2.reshape(n_pg, ps, kh * hd)
+        v_pages = v2.reshape(n_pg, ps, kh * hd)
+        ks_pages = vs_pages = None
+        if quant:
+            from dynamo_tpu.ops.quant import _scale_rows, kv_scale_subl
+
+            subl = kv_scale_subl(kh, attn.kv_tp)
+            rows = _scale_rows(kh, attn.kv_tp)
+
+            def to_scale_pages(dense):  # [b, t_pad, K] -> [n_pg, SUBL, ps]
+                per_head = dense.reshape(b, t_pad // ps, ps, kh).transpose(
+                    0, 1, 3, 2
+                ).reshape(n_pg, kh, ps)
+                return jnp.ones((n_pg, subl, ps), jnp.float32).at[
+                    :, rows, :
+                ].set(per_head)
+
+            ks_pages = to_scale_pages(ks2)
+            vs_pages = to_scale_pages(vs2)
         wr = functools.partial(
             paged_kv_write, page_size=ps, interpret=attn.interpret
         )
         if attn.mesh is not None:
             P = jax.sharding.PartitionSpec
+            # scale pools/pages [*, SUBL, S]: heads in sublanes
+            scale_in = (
+                (P(None, "tp", None), P(None, "tp", None),
+                 P(None, "tp", None), P(None, "tp", None)) if quant else ()
+            )
+            scale_out = (
+                (P(None, "tp", None), P(None, "tp", None)) if quant else ()
+            )
             wr = jax.shard_map(
                 wr,
                 mesh=attn.mesh,
                 in_specs=(
                     P(None, "tp"), P(None, "tp"), P(),
                     P(None, None, "tp"), P(None, None, "tp"),
+                    *scale_in,
                 ),
-                out_specs=(P(None, "tp"), P(None, "tp")),
+                out_specs=(P(None, "tp"), P(None, "tp"), *scale_out),
                 check_vma=False,
             )
-        kv_k, kv_v = wr(kv_k, kv_v, attn.write_tables, k_pages, v_pages)
+        if quant:
+            kv_k, kv_v, kv_ks, kv_vs = wr(
+                kv_k, kv_v, attn.write_tables, k_pages, v_pages,
+                kv_ks, kv_vs, ks_pages, vs_pages,
+            )
+        else:
+            kv_k, kv_v = wr(kv_k, kv_v, attn.write_tables, k_pages, v_pages)
         if attn.block_tables is not None and attn.q_pos0 is not None:
             # flash prefill: online softmax over streamed pages — never
             # materializes the [B, K, G, T, C] logits/probs the gather
@@ -276,37 +398,58 @@ def _attn_block(
             )
             if attn.mesh is not None:
                 P = jax.sharding.PartitionSpec
+                scale_specs = (
+                    (P(None, "tp", None), P(None, "tp", None)) if quant else ()
+                )
                 fl = jax.shard_map(
                     fl,
                     mesh=attn.mesh,
                     in_specs=(
                         P(None, None, "tp", None), P(None, "tp"),
-                        P(None, "tp"), P(), P(), P(),
+                        P(None, "tp"), P(), P(), P(), *scale_specs,
                     ),
                     out_specs=P(None, None, "tp", None),
                     check_vma=False,
                 )
-            out = fl(
-                q, kv_k, kv_v, attn.block_tables, attn.q_pos0, attn.lengths
-            )
+            if quant:
+                out = fl(
+                    q, kv_k, kv_v, attn.block_tables, attn.q_pos0,
+                    attn.lengths, kv_ks, kv_vs,
+                )
+            else:
+                out = fl(
+                    q, kv_k, kv_v, attn.block_tables, attn.q_pos0,
+                    attn.lengths,
+                )
         else:
-            out = paged_attention(q, kv_k, kv_v, attn.slot_matrix, positions)
+            out = paged_attention(
+                q, kv_k, kv_v, attn.slot_matrix, positions,
+                k_scales=kv_ks, v_scales=kv_vs, scale_tp=attn.kv_tp,
+            )
     elif attn.ring and attn.mesh is not None:
         # sp-sharded whole-prompt prefill: KV lands in the (sp-replicated)
         # pool for later decode; attention rings the fresh chunk blocks
         # around the sp axis (ops/ring_attention.py)
         from dynamo_tpu.ops.ring_attention import ring_attention_sharded
 
+        if quant:
+            raise NotImplementedError("int8 KV unsupported with ring (sp>1)")
         kv_k, kv_v = write_kv_slots(
             kv_k, kv_v, write_slots,
             k.reshape(b * t, kh * hd), v.reshape(b * t, kh * hd),
         )
         out = ring_attention_sharded(q, k, v, attn.mesh)
     else:
-        kv_k, kv_v = write_kv_slots(
-            kv_k, kv_v, write_slots,
-            k.reshape(b * t, kh * hd), v.reshape(b * t, kh * hd),
-        )
+        kr = k.reshape(b * t, kh * hd)
+        vr = v.reshape(b * t, kh * hd)
+        if quant:
+            from dynamo_tpu.ops.quant import scatter_kv_scales
+
+            kr, krs = quantize_kv_rows(kr, kh)
+            vr, vrs = quantize_kv_rows(vr, kh)
+            kv_ks = scatter_kv_scales(kv_ks, write_slots, krs, kh, attn.kv_tp)
+            kv_vs = scatter_kv_scales(kv_vs, write_slots, vrs, kh, attn.kv_tp)
+        kv_k, kv_v = write_kv_slots(kv_k, kv_v, write_slots, kr, vr)
         if attn.block_tables is not None:
             from dynamo_tpu.ops.pallas_attention import paged_decode_attention
 
@@ -317,29 +460,37 @@ def _attn_block(
             )
             if attn.mesh is not None:
                 P = jax.sharding.PartitionSpec
+                scale_specs = (
+                    (P(None, "tp", None), P(None, "tp", None)) if quant else ()
+                )
                 ro = jax.shard_map(
                     ro,
                     mesh=attn.mesh,
                     in_specs=(
                         P(None, "tp", None), P(None, "tp"), P(None, "tp"),
-                        P(), P(),
+                        P(), P(), *scale_specs,
                     ),
                     out_specs=P(None, "tp", None),
                     check_vma=False,
                 )
-            out = ro(
-                q[:, 0],
-                kv_k,
-                kv_v,
-                attn.block_tables,
-                attn.lengths,
-            )[:, None]
+            if quant:
+                out = ro(
+                    q[:, 0], kv_k, kv_v, attn.block_tables, attn.lengths,
+                    kv_ks, kv_vs,
+                )[:, None]
+            else:
+                out = ro(
+                    q[:, 0], kv_k, kv_v, attn.block_tables, attn.lengths,
+                )[:, None]
         else:
-            out = paged_attention(q, kv_k, kv_v, attn.slot_matrix, positions)
+            out = paged_attention(
+                q, kv_k, kv_v, attn.slot_matrix, positions,
+                k_scales=kv_ks, v_scales=kv_vs, scale_tp=attn.kv_tp,
+            )
     proj = mm(out.reshape(b, t, h * hd), lp["wo"])
     if tp_axis is not None:
         proj = jax.lax.psum(proj, tp_axis)
-    return proj, kv_k, kv_v
+    return proj, kv_k, kv_v, kv_ks, kv_vs
 
 
 _ACTIVATIONS = {
@@ -404,15 +555,26 @@ def forward(
 
     new_k_layers = []
     new_v_layers = []
+    new_ks_layers = []
+    new_vs_layers = []
     for l, lp in enumerate(params["layers"]):
-        x, layer_k, layer_v = layer_step(
+        x, layer_k, layer_v, layer_ks, layer_vs = layer_step(
             lp, cfg, x, cos, sin, kv.k[l], kv.v[l],
             write_slots, attn, positions, real_mask=real_mask,
+            kv_ks=kv.ks[l] if kv.quantized else None,
+            kv_vs=kv.vs[l] if kv.quantized else None,
         )
         new_k_layers.append(layer_k)
         new_v_layers.append(layer_v)
+        new_ks_layers.append(layer_ks)
+        new_vs_layers.append(layer_vs)
 
-    kv = KVCache(k=tuple(new_k_layers), v=tuple(new_v_layers))
+    kv = KVCache(
+        k=tuple(new_k_layers),
+        v=tuple(new_v_layers),
+        ks=tuple(new_ks_layers) if kv.quantized else None,
+        vs=tuple(new_vs_layers) if kv.quantized else None,
+    )
     x = rms_norm(
         x, params["final_norm"], cfg.rms_norm_eps,
         weight_offset=cfg.norm_weight_offset,
@@ -421,17 +583,19 @@ def forward(
 
 
 def layer_step(lp, cfg, x, cos, sin, kv_k, kv_v, write_slots, attn,
-               positions, real_mask=None, tp_axis=None):
+               positions, real_mask=None, kv_ks=None, kv_vs=None,
+               tp_axis=None):
     """One transformer layer (attention + FFN, pre-norm residuals) over
     the paged pools — shared by `forward` and the pipeline-parallel
     stage executor (parallel/pipeline.py). `tp_axis` enables manual-tp
     semantics for use inside a shard_map (explicit psums after the
-    row-parallel projections)."""
+    row-parallel projections). kv_ks/kv_vs are the int8-KV scale pools
+    (None in unquantized mode; returned as-is)."""
     w_off = cfg.norm_weight_offset
     attn_in = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps, weight_offset=w_off)
-    attn_out, kv_k, kv_v = _attn_block(
+    attn_out, kv_k, kv_v, kv_ks, kv_vs = _attn_block(
         lp, cfg, attn_in, cos, sin, kv_k, kv_v, write_slots, attn, positions,
-        tp_axis=tp_axis,
+        kv_ks=kv_ks, kv_vs=kv_vs, tp_axis=tp_axis,
     )
     x = x + attn_out
     mlp_in = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps, weight_offset=w_off)
@@ -441,7 +605,7 @@ def layer_step(lp, cfg, x, cos, sin, kv_k, kv_v, write_slots, attn,
         x = x + moe_block(lp, cfg, mlp_in, real_mask=real_mask)
     else:
         x = x + _mlp_block(lp, mlp_in, tp_axis=tp_axis, act=cfg.hidden_act)
-    return x, kv_k, kv_v
+    return x, kv_k, kv_v, kv_ks, kv_vs
 
 
 def logits(params: Params, cfg: ModelConfig, hidden: jnp.ndarray) -> jnp.ndarray:

@@ -69,15 +69,23 @@ def _masked_softmax(logits: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
 
 def paged_attention(
     q: jnp.ndarray,            # [B, T, H, Hd] (rope applied; KV already written)
-    k_cache: jnp.ndarray,      # [N, K*Hd]
+    k_cache: jnp.ndarray,      # [N, K*Hd] (int8 when scale pools are given)
     v_cache: jnp.ndarray,
     slot_matrix: jnp.ndarray,  # [B, C] int32: the sequence's slots, position-ordered
     positions: jnp.ndarray,    # [B, T] int32 absolute position of each query
+    k_scales: jnp.ndarray | None = None,  # [P, SUBL, S] int8-KV scale pools
+    v_scales: jnp.ndarray | None = None,  # (ops/quant pool layout)
+    scale_tp: int = 1,
 ) -> jnp.ndarray:
     """Gathered-slot attention. Gathered slot j holds absolute position j of
     the sequence, so causality is `j <= positions[b, t]`; padded queries and
     0-padded slot-table tails are masked out by the same comparison (their
-    garbage KV rides the trash page)."""
+    garbage KV rides the trash page).
+
+    With scale pools the caches hold per-token-per-kv-head symmetric int8
+    (ops/quant.quantize_kv_rows; pool layout ops/quant.init_kv_scale_pool);
+    rows are dequantized after the gather — this path is the correctness
+    oracle for the int8 pallas kernels."""
     b, t, h, hd = q.shape
     kh = k_cache.shape[1] // hd
     g = h // kh
@@ -86,6 +94,14 @@ def paged_attention(
     c = slot_matrix.shape[1]
     k = k_cache[slot_matrix].reshape(b, c, kh, hd)  # [B, C, K, Hd]
     v = v_cache[slot_matrix].reshape(b, c, kh, hd)
+    if k_scales is not None:
+        from dynamo_tpu.ops.quant import gather_kv_scales
+
+        flat = slot_matrix.reshape(-1)
+        ks = gather_kv_scales(k_scales, flat, kh, scale_tp).reshape(b, c, kh)
+        vs = gather_kv_scales(v_scales, flat, kh, scale_tp).reshape(b, c, kh)
+        k = (k.astype(jnp.float32) * ks[..., None]).astype(q.dtype)
+        v = (v.astype(jnp.float32) * vs[..., None]).astype(q.dtype)
     qg = q.reshape(b, t, kh, g, hd)
     logits = jnp.einsum(
         "btkgd,bskd->bkgts", qg, k, preferred_element_type=jnp.float32

@@ -239,6 +239,269 @@ def lax_cdiv(a, b: int):
     return jax.lax.div(a + (b - 1), b)
 
 
+def _decode_kernel_q(
+    # scalar prefetch
+    lengths_ref,       # [B] i32: attended KV count per sequence (0 = inactive)
+    tables_ref,        # [B, W] i32 page ids (W % pages_per_block == 0)
+    wpos_ref,          # [B] i32 position whose KV this step writes (-1 = none)
+    work_seq_ref,      # [MAXW] i32 sequence of each work item
+    work_blk_ref,      # [MAXW] i32 page-block index of each work item
+    n_work_ref,        # [1] i32 number of valid work items
+    # inputs (VMEM)
+    qb_ref,            # [B, HK, K*Hd] cyclic block-diagonal queries
+    # (HK = SUBL*G; row r carries query head (r%SUBL)*G + r//SUBL in kv
+    # column block r%SUBL, zero when r%SUBL >= local kv heads)
+    knew_ref,          # [B, 1, K*Hd] new-token key rows, int8
+    vnew_ref,
+    ksnew_ref,         # [B, SUBL] new-token scale columns, f32
+    vsnew_ref,
+    # inputs (HBM)
+    k_pages_hbm,       # [num_pages, page_size, K*Hd] int8
+    v_pages_hbm,
+    ks_pages_hbm,      # [num_pages, SUBL, page_size] f32 (tokens in lanes)
+    vs_pages_hbm,
+    # outputs
+    o_ref,             # [B, HK, K*Hd] VMEM (valid diag slice taken outside)
+    ko_pages_hbm,      # aliased k_pages_hbm
+    vo_pages_hbm,
+    kso_pages_hbm,     # aliased ks_pages_hbm
+    vso_pages_hbm,
+    # scratch
+    k_buf,             # [NBUF, ppb, page_size, K*Hd] int8 VMEM
+    v_buf,
+    ks_buf,            # [NBUF, SUBL, ppb*page_size] f32 VMEM (block-wide)
+    vs_buf,
+    ks_stage,          # [NBUF, SUBL, page_size] f32 write-back staging
+    vs_stage,
+    k_sems,            # DMA sems [NBUF] (data + scale copies both count)
+    v_sems,
+    w_sem,             # DMA sem for page write-backs
+    wb_pending,        # SMEM [NBUF]: write-back in flight from this slot
+    *,
+    batch: int,
+    page_size: int,
+    pages_per_block: int,
+    nbuf: int,
+    ablate: str = "",  # perf bisection: "noscale_dma" | "noscale_mul"
+):
+    """int8 variant of `_decode_kernel`: pages are int8 plus transposed
+    f32 scale pages [SUBL>=8, page_size] (ops/quant.py pool layout — the
+    only shape Mosaic can DMA). The streamed-page HBM traffic — 71% of
+    the int8-weights decode step at B=256 (KERNEL_TPU r3) — halves.
+
+    Dequantization never touches the K*Hd data tiles: scales fold into
+    the SCORE matrix lanes instead. Page scale tiles DMA into a
+    block-wide [SUBL, t_blk] buffer, and ONE `pltpu.repeat` (a VPU
+    sublane tile-repeat — measured much cheaper than per-page MXU
+    expansion matmuls) turns it into the [HK, t_blk] multiplier; query
+    rows are CYCLIC (row r ↔ kv head r % SUBL) so the tile-repeat's row
+    order matches by construction. K-scales multiply the scores;
+    V-scales multiply the softmax probs ((p*vs) @ v_int8 == p @
+    dequant(v)). Design notes otherwise as in `_decode_kernel`."""
+    t_blk = pages_per_block * page_size
+    hk = qb_ref.shape[1]
+    kw = qb_ref.shape[2]
+    subl = ksnew_ref.shape[1]
+    g = hk // subl
+    n_work = n_work_ref[0]
+
+    def start_work_dma(w, slot):
+        seq = work_seq_ref[w]
+        blk = work_blk_ref[w]
+        for p in range(pages_per_block):
+            page_id = tables_ref[seq, blk * pages_per_block + p]
+            pltpu.make_async_copy(
+                k_pages_hbm.at[page_id], k_buf.at[slot, p], k_sems.at[slot]
+            ).start()
+            pltpu.make_async_copy(
+                v_pages_hbm.at[page_id], v_buf.at[slot, p], v_sems.at[slot]
+            ).start()
+            if ablate != "noscale_dma":
+                pltpu.make_async_copy(
+                    ks_pages_hbm.at[page_id],
+                    ks_buf.at[slot, :, p * page_size:(p + 1) * page_size],
+                    k_sems.at[slot],
+                ).start()
+                pltpu.make_async_copy(
+                    vs_pages_hbm.at[page_id],
+                    vs_buf.at[slot, :, p * page_size:(p + 1) * page_size],
+                    v_sems.at[slot],
+                ).start()
+
+    def wait_work_dma(slot):
+        # one wait per started copy, with a descriptor matching each
+        # enqueued copy's SIZE — TPU DMA semaphores count bytes, so a
+        # data-page wait cannot stand in for a scale-tile copy
+        for _ in range(pages_per_block):
+            pltpu.make_async_copy(
+                k_pages_hbm.at[0], k_buf.at[slot, 0], k_sems.at[slot]
+            ).wait()
+            pltpu.make_async_copy(
+                v_pages_hbm.at[0], v_buf.at[slot, 0], v_sems.at[slot]
+            ).wait()
+            if ablate != "noscale_dma":
+                pltpu.make_async_copy(
+                    ks_pages_hbm.at[0], ks_buf.at[slot, :, 0:page_size],
+                    k_sems.at[slot],
+                ).wait()
+                pltpu.make_async_copy(
+                    vs_pages_hbm.at[0], vs_buf.at[slot, :, 0:page_size],
+                    v_sems.at[slot],
+                ).wait()
+
+    def drain_wb(slot):
+        @pl.when(wb_pending[slot] == 1)
+        def _():
+            # data + staged scale page per pool, size-matched waits
+            pltpu.make_async_copy(
+                k_buf.at[0, 0], ko_pages_hbm.at[0], w_sem
+            ).wait()
+            pltpu.make_async_copy(
+                ks_stage.at[0], kso_pages_hbm.at[0], w_sem
+            ).wait()
+            pltpu.make_async_copy(
+                v_buf.at[0, 0], vo_pages_hbm.at[0], w_sem
+            ).wait()
+            pltpu.make_async_copy(
+                vs_stage.at[0], vso_pages_hbm.at[0], w_sem
+            ).wait()
+            wb_pending[slot] = 0
+
+    o_ref[...] = jnp.zeros_like(o_ref)
+    for j in range(nbuf):
+        wb_pending[j] = 0
+
+        @pl.when(j < n_work)
+        def _prologue(j=j):
+            start_work_dma(j, j)
+
+    def body(w, carry):
+        m_prev, l_prev, acc = carry
+        seq = work_seq_ref[w]
+        blk = work_blk_ref[w]
+        length = lengths_ref[seq]
+        wpos = wpos_ref[seq]
+        slot = jax.lax.rem(w, nbuf)
+
+        wait_work_dma(slot)
+
+        is_first = blk == 0
+        m_prev = jnp.where(is_first, jnp.full_like(m_prev, _NEG_INF), m_prev)
+        l_prev = jnp.where(is_first, jnp.zeros_like(l_prev), l_prev)
+        acc = jnp.where(is_first, jnp.zeros_like(acc), acc)
+
+        kb = k_buf[slot].reshape(t_blk, kw)
+        vb = v_buf[slot].reshape(t_blk, kw)
+        ksb = ks_buf[slot]                       # [SUBL, t_blk]
+        vsb = vs_buf[slot]
+
+        # fused cache update: inject the new token's int8 K/V row into its
+        # data page and its scale column into the block-wide scale buffer,
+        # store both back and write just that page pair to HBM
+        do_write = (wpos >= 0) & (blk == jax.lax.div(wpos, t_blk))
+        row = jax.lax.broadcasted_iota(jnp.int32, (t_blk, kw), 0)
+        off = wpos - blk * t_blk
+        kb = jnp.where(do_write & (row == off), knew_ref[seq], kb)
+        vb = jnp.where(do_write & (row == off), vnew_ref[seq], vb)
+        p_loc = jax.lax.div(off, page_size)
+        slane = jax.lax.broadcasted_iota(jnp.int32, (subl, t_blk), 1)
+        sc_mask = do_write & (slane == off)
+        ksb = jnp.where(sc_mask, ksnew_ref[seq].reshape(subl, 1), ksb)
+        vsb = jnp.where(sc_mask, vsnew_ref[seq].reshape(subl, 1), vsb)
+
+        @pl.when(do_write)
+        def _store_back():
+            k_buf[slot] = kb.reshape(pages_per_block, page_size, kw)
+            v_buf[slot] = vb.reshape(pages_per_block, page_size, kw)
+            ks_buf[slot] = ksb
+            vs_buf[slot] = vsb
+            # select the written page's [SUBL, S] scale tile (static
+            # slices + runtime select: lane offsets must be static)
+            kt = jnp.zeros((subl, page_size), jnp.float32)
+            vt = jnp.zeros((subl, page_size), jnp.float32)
+            for p in range(pages_per_block):
+                sel = p_loc == p
+                kt = jnp.where(
+                    sel, ksb[:, p * page_size:(p + 1) * page_size], kt
+                )
+                vt = jnp.where(
+                    sel, vsb[:, p * page_size:(p + 1) * page_size], vt
+                )
+            ks_stage[slot] = kt
+            vs_stage[slot] = vt
+            page_id = tables_ref[seq, jax.lax.div(wpos, page_size)]
+            pltpu.make_async_copy(
+                k_buf.at[slot, p_loc], ko_pages_hbm.at[page_id], w_sem
+            ).start()
+            pltpu.make_async_copy(
+                ks_stage.at[slot], kso_pages_hbm.at[page_id], w_sem
+            ).start()
+            pltpu.make_async_copy(
+                v_buf.at[slot, p_loc], vo_pages_hbm.at[page_id], w_sem
+            ).start()
+            pltpu.make_async_copy(
+                vs_stage.at[slot], vso_pages_hbm.at[page_id], w_sem
+            ).start()
+            wb_pending[slot] = 1
+
+        # int8 values are exact in bf16, so the data dot needs no HIGHEST;
+        # K-scales fold into the score lanes afterwards (one VPU repeat)
+        s = jax.lax.dot_general(
+            qb_ref[seq].astype(jnp.float32), kb.astype(jnp.float32),
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [HK, T_blk]
+        if ablate != "noscale_mul":
+            s = s * pltpu.repeat(ksb, g, 0)
+
+        pos = blk * t_blk + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(pos < length, s, _NEG_INF)
+
+        m_curr = jnp.max(s, axis=-1, keepdims=True)            # [HK, 1]
+        m_next = jnp.maximum(m_prev, m_curr)
+        p_blk = jnp.exp(s - m_next)                             # [HK, T]
+        l_curr = jnp.sum(p_blk, axis=-1, keepdims=True)
+        alpha = jnp.exp(m_prev - m_next)
+        l_next = alpha * l_prev + l_curr
+
+        # V-scales fold into the probs: (p * vs) @ v_int8 == p @ dequant(v)
+        pv_in = (
+            p_blk if ablate == "noscale_mul"
+            else p_blk * pltpu.repeat(vsb, g, 0)
+        )
+        o_curr = jax.lax.dot_general(
+            pv_in, vb.astype(jnp.float32),
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc = acc * alpha + o_curr
+        m_prev, l_prev = m_next, l_next
+
+        n_blocks = lax_cdiv(length, t_blk)
+
+        @pl.when(blk == n_blocks - 1)
+        def _emit():
+            o_ref[seq] = (
+                acc / jnp.maximum(l_prev, 1e-30)
+            ).astype(o_ref.dtype)
+
+        nxt = w + nbuf
+
+        @pl.when(nxt < n_work)
+        def _refill():
+            drain_wb(slot)
+            start_work_dma(nxt, slot)
+
+        return m_prev, l_prev, acc
+
+    m0 = jnp.full((hk, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((hk, 1), jnp.float32)
+    a0 = jnp.zeros((hk, kw), jnp.float32)
+    jax.lax.fori_loop(0, n_work, body, (m0, l0, a0))
+    for j in range(nbuf):
+        drain_wb(j)
+
+
 @functools.partial(
     jax.jit,
     static_argnames=["page_size", "pages_per_block", "nbuf", "interpret",
@@ -246,13 +509,19 @@ def lax_cdiv(a, b: int):
 )
 def fused_paged_decode_attention(
     q: jax.Array,             # [B, H, Hd] (rope applied, unscaled)
-    new_k: jax.Array,         # [B, K*Hd] this step's K rows (rope applied)
+    new_k: jax.Array,         # [B, K*Hd] this step's K rows (rope applied;
+    # int8 in quantized mode, pre-quantized by the caller)
     new_v: jax.Array,         # [B, K*Hd]
     k_cache: jax.Array,       # [num_slots, K*Hd] flat slot pool
     v_cache: jax.Array,
     block_tables: jax.Array,  # [B, W] i32 page ids (0 = trash page)
     lengths: jax.Array,       # [B] i32 attended KV count incl. the new token
     write_pos: jax.Array,     # [B] i32 position to store new_k/new_v (-1 = skip)
+    k_scales: jax.Array = None,  # [num_pages, SUBL, page_size] f32 scale
+    # pools (ops/quant pool layout; SUBL >= 8, tokens in lanes)
+    v_scales: jax.Array = None,
+    new_ks: jax.Array = None,    # [B, SUBL] f32 new-row scale columns
+    new_vs: jax.Array = None,
     *,
     page_size: int,
     pages_per_block: int = 4,
@@ -260,13 +529,14 @@ def fused_paged_decode_attention(
     interpret: bool = False,
     ablate: str = "",
     alias_caches: bool = True,
-) -> tuple[jax.Array, jax.Array, jax.Array]:
+):
     """Flash paged decode attention fused with the KV-cache update.
 
-    Returns (out [B, H, Hd], k_cache, v_cache); the caches are updated
-    in place (aliased) — the new token's row is injected into its page in
-    VMEM and only that page is written back, so there is no XLA scatter
-    anywhere on the decode path."""
+    Returns (out [B, H, Hd], k_cache, v_cache[, k_scales, v_scales]); the
+    caches are updated in place (aliased) — the new token's row is
+    injected into its page in VMEM and only that page is written back, so
+    there is no XLA scatter anywhere on the decode path. With scale pools
+    the pages are int8 (`_decode_kernel_q`)."""
     b, h, hd = q.shape
     num_slots, kw = k_cache.shape
     assert kw % hd == 0
@@ -275,6 +545,7 @@ def fused_paged_decode_attention(
     g = h // kh
     num_pages = num_slots // page_size
     t_blk = pages_per_block * page_size
+    quant = k_scales is not None
 
     w = block_tables.shape[1]
     if w % pages_per_block:
@@ -301,10 +572,111 @@ def fused_paged_decode_attention(
     new_k = new_k.reshape(b, 1, kw)
     new_v = new_v.reshape(b, 1, kw)
 
+    scale = hd ** -0.5
+    if quant:
+        ks_pages = k_scales   # already page-blocked [P, SUBL, S]
+        vs_pages = v_scales
+        subl = k_scales.shape[1]
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=6,
+            grid=(1,),
+            in_specs=[
+                pl.BlockSpec(memory_space=pltpu.VMEM),   # qb
+                pl.BlockSpec(memory_space=pltpu.VMEM),   # new_k
+                pl.BlockSpec(memory_space=pltpu.VMEM),   # new_v
+                pl.BlockSpec(memory_space=pltpu.VMEM),   # new_ks
+                pl.BlockSpec(memory_space=pltpu.VMEM),   # new_vs
+                # pools pinned to HBM: under pl.ANY Mosaic may place the
+                # small scale pools in VMEM, where sub-lane-width (K < 128)
+                # memref slices fail to compile
+                pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),  # k_pages
+                pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),  # v_pages
+                pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),  # ks_pages
+                pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),  # vs_pages
+            ],
+            out_specs=[
+                pl.BlockSpec(memory_space=pltpu.VMEM),
+                pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),
+                pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),
+                pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),
+                pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((nbuf, pages_per_block, page_size, kw), jnp.int8),
+                pltpu.VMEM((nbuf, pages_per_block, page_size, kw), jnp.int8),
+                pltpu.VMEM((nbuf, subl, t_blk), jnp.float32),
+                pltpu.VMEM((nbuf, subl, t_blk), jnp.float32),
+                pltpu.VMEM((nbuf, subl, page_size), jnp.float32),
+                pltpu.VMEM((nbuf, subl, page_size), jnp.float32),
+                pltpu.SemaphoreType.DMA((nbuf,)),
+                pltpu.SemaphoreType.DMA((nbuf,)),
+                pltpu.SemaphoreType.DMA,
+                pltpu.SMEM((nbuf,), jnp.int32),
+            ],
+        )
+        kernel = functools.partial(
+            _decode_kernel_q,
+            batch=b,
+            page_size=page_size,
+            pages_per_block=pages_per_block,
+            nbuf=nbuf,
+            ablate=ablate,
+        )
+        # CYCLIC query-row layout (HK = SUBL*G rows): row r carries query
+        # head (r%SUBL)*G + r//SUBL in kv column block r%SUBL — so the
+        # kernel's pltpu.repeat of the [SUBL, T] scale tile lines up with
+        # the score rows with no expansion matmul. Rows whose kv slot is
+        # padding (r%SUBL >= kh) are zero and discarded on the way out.
+        hk = subl * g
+        r = jnp.arange(hk)
+        head_of_row = (r % subl) * g + r // subl
+        valid_row = (r % subl) < kh
+        q_rows = jnp.where(
+            valid_row[None, :, None],
+            (q * scale)[:, jnp.where(valid_row, head_of_row, 0), :],
+            0,
+        ).astype(q.dtype)                                     # [B, HK, Hd]
+        qt = jnp.tile(q_rows, (1, 1, kh))                     # [B, HK, K*Hd]
+        colh = (jnp.arange(kw, dtype=jnp.int32) // hd)[None, None, :]
+        rowh = (r % subl).astype(jnp.int32)[None, :, None]
+        qbq = jnp.where(colh == rowh, qt, 0).astype(q.dtype)
+        # inputs: 0..5 = scalar prefetch, 6 = qb, 7..10 = new rows/scales,
+        # 11..14 = page pools — aliased onto outputs 1..4
+        aliases = {11: 1, 12: 2, 13: 3, 14: 4} if alias_caches else {}
+        out_full, k2, v2, ks2, vs2 = pl.pallas_call(
+            kernel,
+            grid_spec=grid_spec,
+            out_shape=[
+                jax.ShapeDtypeStruct((b, hk, kw), q.dtype),
+                jax.ShapeDtypeStruct(k_pages.shape, jnp.int8),
+                jax.ShapeDtypeStruct(v_pages.shape, jnp.int8),
+                jax.ShapeDtypeStruct(ks_pages.shape, jnp.float32),
+                jax.ShapeDtypeStruct(vs_pages.shape, jnp.float32),
+            ],
+            input_output_aliases=aliases,
+            interpret=interpret,
+        )(lengths, block_tables.astype(jnp.int32), write_pos.astype(jnp.int32),
+          work_seq, work_blk, n_work[None], qbq,
+          new_k.reshape(b, 1, kw), new_v.reshape(b, 1, kw),
+          new_ks, new_vs,
+          k_pages, v_pages, ks_pages, vs_pages)
+        # undo the cyclic layout: row r = j*SUBL + k keeps column block k
+        # (kw spans kh blocks; padding rows k >= kh have no block and are
+        # dropped); head (k*G + j) <- (j, k)
+        out = out_full.astype(jnp.float32).reshape(b, g, subl, kh, hd)
+        out = jnp.einsum("bjkkd->bjkd", out[:, :, :kh])       # [B, G, K, Hd]
+        out = out.transpose(0, 2, 1, 3).reshape(b, h, hd).astype(q.dtype)
+        return (
+            out,
+            k2.reshape(num_slots, kw),
+            v2.reshape(num_slots, kw),
+            ks2,
+            vs2,
+        )
+
     # block-diagonal queries [B, H, K*Hd]: row r (a query head) carries its
     # values in its kv head's column block, zeros elsewhere — one MXU dot
     # then computes every head's scores with no cross-head leakage
-    scale = hd ** -0.5
     qs = (q * scale).astype(q.dtype)
     q_tiled = jnp.tile(qs, (1, 1, kh))                       # [B, H, K*Hd]
     col_head = (jnp.arange(kw, dtype=jnp.int32) // hd)[None, None, :]
@@ -377,6 +749,8 @@ def paged_decode_attention(
     v_cache: jax.Array,
     block_tables: jax.Array,  # [B, W] i32 page ids (0 = trash page)
     lengths: jax.Array,       # [B] i32 valid KV positions (0 = inactive row)
+    k_scales: jax.Array = None,  # [num_pages, SUBL, S] f32 scale pools
+    v_scales: jax.Array = None,
     *,
     page_size: int,
     pages_per_block: int = 4,
@@ -386,7 +760,9 @@ def paged_decode_attention(
     returns [B, H, Hd] in q.dtype."""
     b = q.shape[0]
     kw = k_cache.shape[1]
-    out, _, _ = fused_paged_decode_attention(
+    quant = k_scales is not None
+    subl = k_scales.shape[1] if quant else 0
+    res = fused_paged_decode_attention(
         q,
         jnp.zeros((b, kw), k_cache.dtype),
         jnp.zeros((b, kw), v_cache.dtype),
@@ -395,9 +771,13 @@ def paged_decode_attention(
         block_tables,
         lengths,
         jnp.full((b,), -1, jnp.int32),
+        k_scales,
+        v_scales,
+        jnp.ones((b, subl), jnp.float32) if quant else None,
+        jnp.ones((b, subl), jnp.float32) if quant else None,
         page_size=page_size,
         pages_per_block=pages_per_block,
         interpret=interpret,
         alias_caches=False,
     )
-    return out
+    return res[0]

@@ -40,37 +40,108 @@ def _kernel(tbl_ref, kp_ref, vp_ref, src_k_ref, src_v_ref, ok_ref, ov_ref):
     ov_ref[...] = src_v_ref[...]
 
 
+def _kernel_q(tbl_ref, kp_ref, vp_ref, ksp_ref, vsp_ref,
+              src_k_ref, src_v_ref, src_ks_ref, src_vs_ref,
+              ok_ref, ov_ref, oks_ref, ovs_ref):
+    del kp_ref, vp_ref, ksp_ref, vsp_ref  # aliased through
+    ok_ref[...] = src_k_ref[...]
+    ov_ref[...] = src_v_ref[...]
+    oks_ref[...] = src_ks_ref[...]
+    ovs_ref[...] = src_vs_ref[...]
+
+
 @functools.partial(
-    jax.jit, static_argnames=("page_size", "interpret"), donate_argnums=(0, 1)
+    jax.jit,
+    static_argnames=("page_size", "interpret"),
+    donate_argnums=(0, 1, 5, 6),
 )
 def paged_kv_write(
-    k_cache: jax.Array,   # [num_slots, K*Hd]
+    k_cache: jax.Array,   # [num_slots, K*Hd] (int8 in quantized mode)
     v_cache: jax.Array,
     page_table: jax.Array,  # [n_pages] i32 destination page ids (0 = trash)
     new_k: jax.Array,     # [n_pages, page_size, K*Hd] source page blocks
     new_v: jax.Array,
+    ks_cache: jax.Array = None,  # [num_pages, SUBL, S] f32 scale pools
+    vs_cache: jax.Array = None,  # (ops/quant pool layout)
+    new_ks: jax.Array = None,    # [n_pages, SUBL, S] source scale tiles
+    new_vs: jax.Array = None,
     *,
     page_size: int,
     interpret: bool = False,
 ):
-    """Scatter whole pages into the slot pools, in place (donated)."""
+    """Scatter whole pages into the slot pools, in place (donated).
+    In int8-KV mode the scale pools scatter in the same kernel — their
+    [SUBL, S] tiles ride the same page-table routing."""
     num_slots, kw = k_cache.shape
     num_pages = num_slots // page_size
     n = page_table.shape[0]
     kp = k_cache.reshape(num_pages, page_size, kw)
     vp = v_cache.reshape(num_pages, page_size, kw)
+    quant = ks_cache is not None
+
+    def dst(i, tbl):
+        return (tbl[i], 0, 0)
+
+    def src(i, tbl):
+        return (i, 0, 0)
+
+    if quant:
+        subl = ks_cache.shape[1]
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(n,),
+            in_specs=[
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec((1, page_size, kw), src),
+                pl.BlockSpec((1, page_size, kw), src),
+                pl.BlockSpec((1, subl, page_size), src),
+                pl.BlockSpec((1, subl, page_size), src),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, page_size, kw), dst),
+                pl.BlockSpec((1, page_size, kw), dst),
+                pl.BlockSpec((1, subl, page_size), dst),
+                pl.BlockSpec((1, subl, page_size), dst),
+            ],
+        )
+        ok, ov, oks, ovs = pl.pallas_call(
+            _kernel_q,
+            grid_spec=grid_spec,
+            out_shape=[
+                jax.ShapeDtypeStruct(kp.shape, kp.dtype),
+                jax.ShapeDtypeStruct(vp.shape, vp.dtype),
+                jax.ShapeDtypeStruct(ks_cache.shape, ks_cache.dtype),
+                jax.ShapeDtypeStruct(vs_cache.shape, vs_cache.dtype),
+            ],
+            input_output_aliases={1: 0, 2: 1, 3: 2, 4: 3},
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("arbitrary",),
+            ),
+            interpret=interpret,
+        )(page_table.astype(jnp.int32), kp, vp, ks_cache, vs_cache,
+          new_k, new_v, new_ks, new_vs)
+        return (
+            ok.reshape(num_slots, kw),
+            ov.reshape(num_slots, kw),
+            oks,
+            ovs,
+        )
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(n,),
         in_specs=[
             pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec((1, page_size, kw), lambda i, tbl: (i, 0, 0)),
-            pl.BlockSpec((1, page_size, kw), lambda i, tbl: (i, 0, 0)),
+            pl.BlockSpec((1, page_size, kw), src),
+            pl.BlockSpec((1, page_size, kw), src),
         ],
         out_specs=[
-            pl.BlockSpec((1, page_size, kw), lambda i, tbl: (tbl[i], 0, 0)),
-            pl.BlockSpec((1, page_size, kw), lambda i, tbl: (tbl[i], 0, 0)),
+            pl.BlockSpec((1, page_size, kw), dst),
+            pl.BlockSpec((1, page_size, kw), dst),
         ],
     )
     ok, ov = pl.pallas_call(

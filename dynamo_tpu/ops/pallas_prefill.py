@@ -46,7 +46,9 @@ def _kernel(
     tlen_ref,     # [B] i32 valid query rows in this chunk
     # blocks
     q_ref,        # [1, KH, T_TILE*G, Hd]
-    *page_refs,   # PPB x ([1, page, K*Hd] k), PPB x (v), then outputs/scratch
+    *page_refs,   # PPB x ([1, page, K*Hd] k), PPB x (v), [quant: PPB x
+    # ([1, SUBL, page] k-scale tiles), PPB x (v-scale tiles)], then
+    # outputs/scratch
     t_tile: int,
     page: int,
     kh: int,
@@ -54,14 +56,34 @@ def _kernel(
     hd: int,
     wb: int,
     ppb: int,
+    quant: bool = False,
+    subl: int = 0,
 ):
     k_refs = page_refs[:ppb]
     v_refs = page_refs[ppb:2 * ppb]
-    o_ref = page_refs[2 * ppb]          # [1, KH, T_TILE*G, Hd]
-    m_ref = page_refs[2 * ppb + 1]      # [T_TILE*G, KH] f32
-    l_ref = page_refs[2 * ppb + 2]
-    acc_ref = page_refs[2 * ppb + 3]    # [KH, T_TILE*G, Hd] f32
-    s_ref = page_refs[2 * ppb + 4]      # [T_TILE*G, PPB*page] f32
+    off = 2 * ppb
+    if quant:
+        ks_refs = page_refs[off:off + ppb]
+        vs_refs = page_refs[off + ppb:off + 2 * ppb]
+        off += 2 * ppb
+    o_ref = page_refs[off]          # [1, KH, T_TILE*G, Hd]
+    m_ref = page_refs[off + 1]      # [T_TILE*G, KH] f32
+    l_ref = page_refs[off + 2]
+    acc_ref = page_refs[off + 3]    # [KH, T_TILE*G, Hd] f32
+    s_ref = page_refs[off + 4]      # [T_TILE*G, PPB*page] f32
+
+    def head_scale(sc_ref, k):
+        # one-hot [1, SUBL] @ scale tile [SUBL, page] -> [1, page] lane
+        # vector of head k's per-token scales (HIGHEST: default MXU bf16
+        # truncation would degrade the scales)
+        e_k = jnp.where(
+            jax.lax.broadcasted_iota(jnp.int32, (1, subl), 1) == k, 1.0, 0.0
+        )
+        return jax.lax.dot_general(
+            e_k, sc_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
 
     b, tt, kb = pl.program_id(0), pl.program_id(1), pl.program_id(2)
     scale = hd ** -0.5
@@ -92,11 +114,15 @@ def _kernel(
             qf = q_k.astype(jnp.float32) * scale
             for j in range(ppb):
                 k_j = k_refs[j][0, :, k * hd:(k + 1) * hd]     # [page, Hd]
-                s_ref[:, j * page:(j + 1) * page] = jax.lax.dot_general(
+                s_j = jax.lax.dot_general(
                     qf, k_j.astype(jnp.float32),
                     (((1,), (1,)), ((), ())),
                     preferred_element_type=jnp.float32,
                 )
+                if quant:
+                    # int8 pages: K-scales fold into the score lanes
+                    s_j = s_j * head_scale(ks_refs[j], k)
+                s_ref[:, j * page:(j + 1) * page] = s_j
             s = jnp.where(valid, s_ref[...], _NEG_INF)         # [TG, BLK]
             m_prev = m_ref[:, k]
             m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
@@ -108,8 +134,12 @@ def _kernel(
             pv = jnp.zeros((tg, hd), jnp.float32)
             for j in range(ppb):
                 v_j = v_refs[j][0, :, k * hd:(k + 1) * hd]     # [page, Hd]
+                p_j = p[:, j * page:(j + 1) * page]
+                if quant:
+                    # (p * vs) @ v_int8 == p @ dequant(v)
+                    p_j = p_j * head_scale(vs_refs[j], k)
                 pv = pv + jax.lax.dot_general(
-                    p[:, j * page:(j + 1) * page], v_j.astype(jnp.float32),
+                    p_j, v_j.astype(jnp.float32),
                     (((1,), (0,)), ((), ())),
                     preferred_element_type=jnp.float32,
                 )
@@ -128,11 +158,14 @@ def _kernel(
 )
 def flash_prefill_attention(
     q: jax.Array,             # [B, T, H, Hd] rope applied, unscaled
-    k_cache: jax.Array,       # [num_slots, K*Hd]
+    k_cache: jax.Array,       # [num_slots, K*Hd] (int8 when scales given)
     v_cache: jax.Array,
     block_tables: jax.Array,  # [B, W] i32 position-ordered page ids
     pos0: jax.Array,          # [B] i32 chunk start (page-aligned)
     t_valid: jax.Array,       # [B] i32 valid rows in the chunk (<= T)
+    k_scales: jax.Array = None,  # [num_pages, SUBL, page_size] f32 scale
+    # pools (ops/quant pool layout; SUBL >= 8, tokens in lanes)
+    v_scales: jax.Array = None,
     *,
     page_size: int,
     t_tile: int = 128,
@@ -140,12 +173,16 @@ def flash_prefill_attention(
     interpret: bool = False,
 ) -> jax.Array:
     """Causal chunked-prefill attention over gathered pages; rows past
-    t_valid produce zeros. Returns [B, T, H, Hd] in q.dtype."""
+    t_valid produce zeros. Returns [B, T, H, Hd] in q.dtype. With scale
+    pools the pages hold per-token-per-kv-head int8; scale blocks ride
+    the same page routing and dequantization happens per head slice in
+    VMEM (VPU-cheap next to the halved page DMA traffic)."""
     b, t, h, hd = q.shape
     num_slots, kw = k_cache.shape
     kh = kw // hd
     g = h // kh
     ppb = pages_per_block
+    quant = k_scales is not None
     t_tile = min(t_tile, max(t, 8))
     t_pad = -(-t // t_tile) * t_tile
     if t_pad != t:
@@ -165,11 +202,28 @@ def flash_prefill_attention(
     tg = t_tile * g
     wb = wp // ppb
 
-    def page_spec(j):
+    def page_spec(j, width):
         return pl.BlockSpec(
-            (1, page_size, kw),
+            (1, page_size, width),
             lambda bb, tt, kb, tbl, p0, tl, j=j: (tbl[bb, kb * ppb + j], 0, 0),
         )
+
+    scale_inputs = []
+    scale_specs = []
+    subl = 0
+    if quant:
+        subl = k_scales.shape[1]
+        scale_inputs = [*[k_scales] * ppb, *[v_scales] * ppb]
+
+        def scale_spec(j):
+            return pl.BlockSpec(
+                (1, subl, page_size),
+                lambda bb, tt, kb, tbl, p0, tl, j=j: (
+                    tbl[bb, kb * ppb + j], 0, 0
+                ),
+            )
+
+        scale_specs = [scale_spec(j) for j in range(ppb)] * 2
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
@@ -178,8 +232,9 @@ def flash_prefill_attention(
             pl.BlockSpec(
                 (1, kh, tg, hd), lambda bb, tt, kb, *_: (bb, 0, tt, 0)
             ),
-            *[page_spec(j) for j in range(ppb)],
-            *[page_spec(j) for j in range(ppb)],
+            *[page_spec(j, kw) for j in range(ppb)],
+            *[page_spec(j, kw) for j in range(ppb)],
+            *scale_specs,
         ],
         out_specs=pl.BlockSpec(
             (1, kh, tg, hd), lambda bb, tt, kb, *_: (bb, 0, tt, 0)
@@ -194,7 +249,7 @@ def flash_prefill_attention(
     out = pl.pallas_call(
         functools.partial(
             _kernel, t_tile=t_tile, page=page_size, kh=kh, g=g, hd=hd,
-            wb=wb, ppb=ppb,
+            wb=wb, ppb=ppb, quant=quant, subl=subl,
         ),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, kh, t_pad * g, hd), q.dtype),
@@ -209,6 +264,7 @@ def flash_prefill_attention(
         qk,
         *[k_pages] * ppb,
         *[v_pages] * ppb,
+        *scale_inputs,
     )
     # [B, KH, T*G, Hd] -> [B, T, H, Hd]
     out = out.reshape(b, kh, t_pad, g, hd).transpose(0, 2, 1, 3, 4)

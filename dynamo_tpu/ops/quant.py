@@ -77,6 +77,102 @@ def quant_matmul(x: jnp.ndarray, w: dict, out_dtype=None) -> jnp.ndarray:
     return out.astype(out_dtype or x.dtype)
 
 
+def quantize_kv_rows(
+    rows: jnp.ndarray, num_kv_heads: int
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """KV rows [..., K*Hd] float -> (int8 [..., K*Hd], scales f32
+    [..., K]): symmetric per-row-per-kv-head absmax, the KV analogue of
+    the per-token activation scheme above. 8-bit absmax KV is the
+    standard near-lossless recipe (the reference's FP8 KV cache plays
+    the same role on H100); scales stay f32 — they are ~Hd/4x smaller
+    than the data they describe."""
+    shape = rows.shape
+    hd = shape[-1] // num_kv_heads
+    rf = rows.astype(jnp.float32).reshape(*shape[:-1], num_kv_heads, hd)
+    amax = jnp.max(jnp.abs(rf), axis=-1)
+    scales = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(rf / scales[..., None]), -127, 127)
+    return q.reshape(shape).astype(jnp.int8), scales
+
+
+def dequantize_kv_rows(
+    q: jnp.ndarray, scales: jnp.ndarray, out_dtype=jnp.float32
+) -> jnp.ndarray:
+    """(int8 [..., K*Hd], scales [..., K]) -> float [..., K*Hd]."""
+    shape = q.shape
+    kh = scales.shape[-1]
+    hd = shape[-1] // kh
+    f = q.astype(jnp.float32).reshape(*shape[:-1], kh, hd) * scales[..., None]
+    return f.reshape(shape).astype(out_dtype)
+
+
+# --------------------------------------------------------------------------
+# int8-KV scale POOL layout.
+#
+# Dense per-row scales ([num_slots, K]) cannot be touched by Mosaic: any
+# memref slice narrower than the (8, 128) f32 tile fails to compile (probed
+# on v5e). The pool layout is therefore page-blocked and TRANSPOSED —
+#
+#     [num_pages, SUBL, page_size]   f32, tokens in lanes
+#
+# with SUBL = tp * max(8, K/tp): each tp shard owns a sublane-aligned
+# [num_pages, >=8, page_size] block whose rows 0..K/tp-1 are its local
+# heads (rows above are padding, scale 1.0). Page slices [1, SUBL, S] are
+# tile-aligned for DMA when page_size % 128 == 0, and in-kernel
+# dequantization becomes a LANE-side multiply on the score matrix: scale
+# tiles [SUBL, S] expand to [H, S] with one static 0/1 replication matmul
+# (HIGHEST precision — the MXU's default bf16 truncation would degrade the
+# scales). The XLA paths (gather oracle, wire extract/inject) address the
+# pool through the helpers below; wire format stays dense [..., K].
+
+
+def kv_scale_subl(num_kv_heads: int, tp: int = 1) -> int:
+    """Sublane rows of the scale pool: 8-aligned per tp shard."""
+    return tp * max(8, num_kv_heads // tp)
+
+
+def init_kv_scale_pool(
+    num_pages: int, page_size: int, num_kv_heads: int, tp: int = 1
+) -> jnp.ndarray:
+    return jnp.ones(
+        (num_pages, kv_scale_subl(num_kv_heads, tp), page_size), jnp.float32
+    )
+
+
+def _scale_rows(num_kv_heads: int, tp: int) -> jnp.ndarray:
+    """Pool row index of each head (head-order [K] vector)."""
+    kh_loc = num_kv_heads // tp
+    subl_shard = max(8, kh_loc)
+    g = jnp.arange(num_kv_heads)
+    return (g // kh_loc) * subl_shard + g % kh_loc
+
+
+def scatter_kv_scales(
+    pool: jnp.ndarray,   # [P, SUBL, S]
+    slots: jnp.ndarray,  # [M] flat slot ids
+    scales: jnp.ndarray,  # [M, K] dense per-row scales
+    num_kv_heads: int,
+    tp: int = 1,
+) -> jnp.ndarray:
+    s = pool.shape[2]
+    rows = _scale_rows(num_kv_heads, tp)
+    return pool.at[
+        (slots // s)[:, None], rows[None, :], (slots % s)[:, None]
+    ].set(scales.astype(jnp.float32))
+
+
+def gather_kv_scales(
+    pool: jnp.ndarray,
+    slots: jnp.ndarray,
+    num_kv_heads: int,
+    tp: int = 1,
+) -> jnp.ndarray:
+    """[M, K] dense scales for the given slots."""
+    s = pool.shape[2]
+    rows = _scale_rows(num_kv_heads, tp)
+    return pool[(slots // s)[:, None], rows[None, :], (slots % s)[:, None]]
+
+
 def mm(x: jnp.ndarray, w) -> jnp.ndarray:
     """The model's matmul: quantized or plain depending on the leaf."""
     if is_quantized(w):

@@ -130,7 +130,7 @@ def pp_forward(
         def run_stage(x_in, cos1, sin1, ws1, sm1, pos1, k_local, v_local):
             def body(x, xs):
                 lp, kvk, kvv = xs
-                x, kvk, kvv = llama.layer_step(
+                x, kvk, kvv, _, _ = llama.layer_step(
                     lp, cfg, x, cos1, sin1, kvk, kvv,
                     ws1.reshape(-1), llama.AttnSpec.gather(sm1), pos1,
                     tp_axis="tp",

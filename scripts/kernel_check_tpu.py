@@ -87,6 +87,53 @@ def main() -> None:
     assert err < 2e-2, f"compiled kernel disagrees with oracle: {err}"
     print(f"compiled-mode agreement: max err {err:.2e}")
 
+    # ---- int8-KV compiled agreement: quantized pools + scale tiles ----
+    # (page 128: the scale-pool layout puts page tokens in lanes)
+    from dynamo_tpu.ops.quant import (
+        dequantize_kv_rows,
+        gather_kv_scales,
+        init_kv_scale_pool,
+        quantize_kv_rows,
+        scatter_kv_scales,
+    )
+
+    qpage = 128
+    qnum_pages = 64
+    qn_slots = qnum_pages * qpage
+    kq, ksd = quantize_kv_rows(jnp.asarray(rng.randn(qn_slots, kw), jnp.float32), kh)
+    vq, vsd = quantize_kv_rows(jnp.asarray(rng.randn(qn_slots, kw), jnp.float32), kh)
+    all_slots = jnp.arange(qn_slots, dtype=jnp.int32)
+    ks = scatter_kv_scales(
+        init_kv_scale_pool(qnum_pages, qpage, kh), all_slots, ksd, kh)
+    vs = scatter_kv_scales(
+        init_kv_scale_pool(qnum_pages, qpage, kh), all_slots, vsd, kh)
+    subl = ks.shape[1]
+    qw = 4
+    qtables = rng.permutation(qnum_pages - 1)[: b * qw].reshape(b, qw) + 1
+    qlengths = rng.randint(1, qw * qpage, size=b).astype(np.int32)
+    ref_q = oracle(
+        q, np.asarray(dequantize_kv_rows(kq, ksd)),
+        np.asarray(dequantize_kv_rows(vq, vsd)), qtables, qlengths, qpage,
+    )
+    out_q, *_ = jax.jit(
+        lambda *a: fused_paged_decode_attention(
+            *a, page_size=qpage, alias_caches=False
+        )
+    )(
+        jnp.asarray(q),
+        jnp.zeros((b, kw), jnp.int8), jnp.zeros((b, kw), jnp.int8),
+        kq, vq,
+        jnp.asarray(qtables, jnp.int32), jnp.asarray(qlengths),
+        jnp.full((b,), -1, jnp.int32),
+        ks, vs,
+        jnp.ones((b, subl), jnp.float32), jnp.ones((b, subl), jnp.float32),
+    )
+    err_q = float(np.abs(np.asarray(out_q) - ref_q).max())
+    record["agree_max_err_int8kv"] = err_q
+    assert err_q < 2e-2, f"int8-KV kernel disagrees with oracle: {err_q}"
+    print(f"int8-KV compiled-mode agreement: max err {err_q:.2e}")
+    del kq, vq, ks, vs
+
     # ---- bandwidth: engine-shaped 16-layer decode scan, attention cost
     # isolated by ablation (fused-full minus attention-knocked-out) —
     # the only methodology that is stable through the tunnel (standalone
@@ -101,9 +148,12 @@ def main() -> None:
     steps_n = 16
     kv_len = 480
 
-    def time_scan(b, with_attn, quant=False):
-        w_pages = -(-(kv_len + steps_n + page) // page)
-        num_slots = (b * w_pages + 17) * page
+    def time_scan(b, with_attn, quant=False, kv_quant=False):
+        # int8-KV scale pages put tokens in lanes -> page must be a lane
+        # multiple; bf16 runs keep the serving default
+        pg = 128 if kv_quant else page
+        w_pages = -(-(kv_len + steps_n + pg) // pg)
+        num_slots = (b * w_pages + 17) * pg
         tables = jnp.asarray(
             np.stack([np.arange(1 + i * w_pages, 1 + (i + 1) * w_pages)
                       for i in range(b)]), jnp.int32)
@@ -117,11 +167,11 @@ def main() -> None:
                 key, sub = jax.random.split(key)
                 wslots = (
                     jnp.take_along_axis(
-                        tables, (positions // page)[:, None], axis=1
-                    )[:, 0] * page + positions % page
+                        tables, (positions // pg)[:, None], axis=1
+                    )[:, 0] * pg + positions % pg
                 ).astype(jnp.int32)
                 spec = llama.AttnSpec.pallas_decode(
-                    tables, positions + 1, page, write_pos=positions
+                    tables, positions + 1, pg, write_pos=positions
                 )
                 hidden, kv = llama.forward(
                     params, cfg, tokens[:, None], positions[:, None],
@@ -140,7 +190,10 @@ def main() -> None:
             from dynamo_tpu.ops.quant import quantize_params
 
             params = quantize_params(params, cfg)
-        kv = jax.device_put(llama.init_kv_cache(cfg, num_slots, dtype=dtype))
+        kv = jax.device_put(llama.init_kv_cache(
+            cfg, num_slots, dtype=dtype,
+            kv_quant="int8" if kv_quant else None, page_size=pg,
+        ))
         tokens = jnp.ones((b,), jnp.int32)
         positions = jnp.full((b,), kv_len, jnp.int32)
         key = jax.random.PRNGKey(0)
@@ -172,6 +225,7 @@ def main() -> None:
         full = time_scan(b, with_attn=True)
         no_attn = time_scan(b, with_attn=False)
         full_q = time_scan(b, with_attn=True, quant=True)
+        full_qq = time_scan(b, with_attn=True, quant=True, kv_quant=True)
         attn_ms = (full - no_attn) * 1e3
         kv_bytes = b * kv_len * kw * 2 * 2 * cfg.num_layers  # K+V bf16, 16 L
         gbps = kv_bytes / max(full - no_attn, 1e-9) / 1e9
@@ -185,11 +239,15 @@ def main() -> None:
                 # int8 W8A8 weights (ops/quant.py), attention still bf16
                 "full_ms_per_step_int8": round(full_q * 1e3, 3),
                 "decode_toks_per_s_int8": round(b / full_q, 0),
+                # int8 weights + int8 KV pages (the full quantized stack)
+                "full_ms_per_step_int8kv": round(full_qq * 1e3, 3),
+                "decode_toks_per_s_int8kv": round(b / full_qq, 0),
             }
         )
         print(f"B={b}: full {full * 1e3:.2f} ms/step, attention "
               f"{attn_ms:.2f} ms -> {gbps:.0f} GB/s, {b / full:.0f} tok/s; "
-              f"int8 {full_q * 1e3:.2f} ms -> {b / full_q:.0f} tok/s")
+              f"int8 {full_q * 1e3:.2f} ms -> {b / full_q:.0f} tok/s; "
+              f"int8+int8kv {full_qq * 1e3:.2f} ms -> {b / full_qq:.0f} tok/s")
 
     # ---- flash prefill kernel: compiled agreement + chunk-batch rate --
     from dynamo_tpu.ops.attention import slots_from_pages

@@ -78,7 +78,8 @@ async def test_prefill_extract_inject_roundtrip():
         await local_engine.generate(Context(greedy(prompt, 6).to_dict()))
     )
 
-    first, k, v = await prefill_engine.prefill_only(greedy(prompt, 6))
+    first, k, v, ks, vs = await prefill_engine.prefill_only(greedy(prompt, 6))
+    assert ks is None and vs is None  # bf16 engine -> bf16 wire
     assert k.shape == (CFG.num_layers, 40, CFG.num_kv_heads * CFG.head_dim)
     assert first == ref_tokens[0]
 

@@ -54,7 +54,7 @@ async def test_quant_disagg_roundtrip_bit_identical():
     prompt = list(range(30, 70))
     prefill_e, decode_e, local_e = make_engine(), make_engine(), make_engine()
     ref, _ = await collect(local_e, req(prompt))
-    first, k, v = await prefill_e.prefill_only(req(prompt))
+    first, k, v, ks, vs = await prefill_e.prefill_only(req(prompt))
     assert first == ref[0]
     out = [
         f async for f in await decode_e.generate_remote(
