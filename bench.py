@@ -7,10 +7,10 @@ sampling); measures steady-state decode throughput per chip plus p50
 TTFT/ITL.
 
 Baseline for `vs_baseline`: the north star is tokens/sec/chip parity with
-vLLM on H100 for Llama-3.1-8B (BASELINE.json). We take 2000 tok/s/GPU as
-the parity bar for 8B-class decode throughput and scale it by relative
-parameter count when a smaller preset is benched (smaller chips can't hold
-8B in bf16), so the ratio stays comparable across rounds and chip types.
+vLLM on H100 for Llama-3.1-8B (BASELINE.json), 2000 tok/s/GPU. With int8
+weights the REAL 8B model fits the 16 GB v5e chip and is benched against
+that bar UNSCALED; only when a smaller preset must be used (bf16 runs) is
+the bar scaled by relative parameter count so the ratio stays comparable.
 """
 
 from __future__ import annotations
@@ -27,14 +27,16 @@ _8B_PARAMS = 8.03e9
 
 ISL = int(os.environ.get("BENCH_ISL", "512"))
 OSL = int(os.environ.get("BENCH_OSL", "64"))
-CONCURRENCY = int(os.environ.get("BENCH_CONCURRENCY", "256"))
 DECODE_STEPS = int(os.environ.get("BENCH_DECODE_STEPS", "16"))
-PREFILL_GROUP = int(os.environ.get("BENCH_PREFILL_GROUP", "32768"))
-# int8 W8A8 serving is the default protocol: the reference's baselines
-# serve FP8 on H100 (BASELINE.md "70B FP8"), so the quantized path is the
-# apples-to-apples configuration. BENCH_QUANT=none for bf16.
+# int8 W8A8 weights + int8 KV pages are the default protocol: the
+# reference's baselines serve FP8 on H100 (BASELINE.md "70B FP8"), so the
+# fully-quantized path is the apples-to-apples configuration — and it is
+# what fits the real 8B north-star model on a 16 GB v5e chip.
+# BENCH_QUANT=none / BENCH_KV_QUANT=none for bf16 variants.
 QUANT = os.environ.get("BENCH_QUANT", "int8")
 QUANT = None if QUANT in ("", "none") else QUANT
+KV_QUANT = os.environ.get("BENCH_KV_QUANT", "int8")
+KV_QUANT = None if KV_QUANT in ("", "none") else KV_QUANT
 
 
 def main() -> None:
@@ -50,26 +52,46 @@ def main() -> None:
 
     import __graft_entry__
 
-    cfg = __graft_entry__._pick_config()
+    cfg = __graft_entry__._pick_config(QUANT)
     n_chips = len(jax.local_devices())
-
+    big = cfg.name == "llama-3.1-8b"
+    # 8B on a 16 GB chip: the KV pool budget (~5 GB after int8 weights)
+    # holds ~128 concurrent 608-token sequences; higher concurrency would
+    # thrash the allocator with preemptions instead of adding throughput
+    concurrency = int(
+        os.environ.get("BENCH_CONCURRENCY", "128" if big else "256")
+    )
+    prefill_group = int(
+        os.environ.get("BENCH_PREFILL_GROUP", "16384" if big else "32768")
+    )
     engine = JaxEngine(
         EngineConfig(
             model=cfg,
             dtype="bfloat16",
-            max_batch_size=CONCURRENCY,
+            max_batch_size=concurrency,
             max_model_len=ISL + OSL + 32,
             prefill_chunk=ISL,
             decode_steps=DECODE_STEPS,
-            prefill_group_tokens=PREFILL_GROUP,
+            prefill_group_tokens=prefill_group,
             quantization=QUANT,
+            kv_quantization=KV_QUANT,
+            # int8-KV pallas kernels put page tokens in lanes
+            page_size=128 if KV_QUANT else 64,
+            # HBM->host offload tier ON (the reference baselines run with
+            # their multi-tier KV manager active); sized for the TTFT
+            # probe, small enough to stay out of the headline's way
+            host_kv_pages=int(os.environ.get("BENCH_HOST_KV_PAGES", "16")),
         )
     )
+    # park the offload tier outside its probe: a D2H page gather holds
+    # the KV lock for the whole (tunnel-slow) copy and would serialize
+    # the throughput/paced measurements
+    engine.offload_paused = True
     n_params = engine.param_count
 
     rng = np.random.RandomState(0)
     prompts = [
-        rng.randint(1, cfg.vocab_size, size=ISL).tolist() for _ in range(CONCURRENCY)
+        rng.randint(1, cfg.vocab_size, size=ISL).tolist() for _ in range(concurrency)
     ]
 
     async def one(prompt, record):
@@ -92,6 +114,19 @@ def main() -> None:
         )
         record["tokens"] = len(ticks)
 
+    async def one_shot(prompt, max_tokens):
+        pre = PreprocessedRequest(
+            token_ids=prompt,
+            stop_conditions=StopConditions(
+                max_tokens=max_tokens, ignore_eos=True
+            ),
+            sampling_options=SamplingOptions(greedy=True),
+        )
+        n = 0
+        async for frame in await engine.generate(Context(pre.to_dict())):
+            n += len(frame.get("token_ids") or [])
+        return n
+
     async def run():
         # warmup at FULL concurrency so every compiled shape family
         # (prefill group sizes, decode batch) is built before measuring;
@@ -102,13 +137,47 @@ def main() -> None:
         for _ in range(2):
             warm_prompts = [
                 rng.randint(1, cfg.vocab_size, size=ISL).tolist()
-                for _ in range(CONCURRENCY)
+                for _ in range(concurrency)
             ]
             await asyncio.gather(*(one(p, {}) for p in warm_prompts))
+        # paced arrivals dispatch SMALL prefill groups (and small decode
+        # buckets) the full-concurrency waves never hit — compile every
+        # power-of-two family (rows 1..32) now or the paced phase
+        # measures compiler stalls as TTFT (measured: a 40 s mid-wave
+        # stall from one cold [8, 512] prefill family)
+        for k in (1, 2, 3, 6, 12, 24, 48):
+            if k >= concurrency:
+                break
+            batch = [
+                rng.randint(1, cfg.vocab_size, size=ISL).tolist()
+                for _ in range(k)
+            ]
+            await asyncio.gather(*(one(p, {}) for p in batch))
+        # cached-continuation shape: a prefix-cache hit prefills only the
+        # final partial page — its small bucket family must be compiled
+        # before the warm probe measures it
+        dup = rng.randint(1, cfg.vocab_size, size=ISL).tolist()
+        await one(dup, {})
+        await one(dup, {})
         t0 = time.perf_counter()
         records = [dict() for _ in prompts]
         await asyncio.gather(*(one(p, r) for p, r in zip(prompts, records)))
         wall = time.perf_counter() - t0
+
+        # ---- phase-resolved: a MEASURED prefill-only wave (OSL=1), not
+        # a token-ratio split of the combined wall (VERDICT r3 weak #2)
+        pf_prompts = [
+            rng.randint(1, cfg.vocab_size, size=ISL).tolist()
+            for _ in range(concurrency)
+        ]
+        t1 = time.perf_counter()
+        await asyncio.gather(*(one_shot(p, 1) for p in pf_prompts))
+        prefill_wall = time.perf_counter() - t1
+        # decode phase = combined wall minus the measured prefill wave;
+        # meaningless if the waves' variance swallows the decode share
+        decode_wall = (
+            wall - prefill_wall if wall > prefill_wall * 1.05 else None
+        )
 
         # prefix-cache TTFT probe (BASELINE.md: KV-aware routing's 3x TTFT
         # win comes from prefix hits): identical prompt twice, idle engine
@@ -116,21 +185,116 @@ def main() -> None:
         cold, warm = {}, {}
         await one(probe, cold)
         await one(probe, warm)
-        return records, wall, cold["ttft"] / warm["ttft"]
 
-    records, wall, prefix_speedup = asyncio.run(run())
+        # ---- host-tier offload probe (BASELINE.md's +40% TTFT claim):
+        # serve a fresh prompt, wait for its pages to write-through to
+        # the host pool, EVICT them from HBM, re-serve — restore-from-
+        # host vs full recompute
+        from dynamo_tpu.llm.tokens import compute_block_hashes
+
+        def evict_all():
+            grabbed = []
+            while True:
+                got = engine.allocator.allocate(1)
+                if not got:
+                    break
+                grabbed.extend(got)
+            engine.allocator.release(grabbed)
+
+        async def await_offloaded(tokens):
+            hs = compute_block_hashes(tokens, engine.page_size)
+            hs = hs[: ISL // engine.page_size]
+            for _ in range(200):
+                if engine.host_pool is not None and all(
+                    h in engine.host_pool for h in hs
+                ):
+                    return True
+                engine._wake.set()
+                await asyncio.sleep(0.05)
+            return False
+
+        engine.offload_paused = False
+        # warm cycle: the restore path (H2D inject + registration) has
+        # its own compile families — pay them before measuring
+        wprobe = rng.randint(1, cfg.vocab_size, size=ISL).tolist()
+        await one(wprobe, {})
+        if await await_offloaded(wprobe):
+            evict_all()
+            await one(wprobe, {})
+
+        oprobe = rng.randint(1, cfg.vocab_size, size=ISL).tolist()
+        ocold, owarm = {}, {}
+        await one(oprobe, ocold)
+        offloaded = await await_offloaded(oprobe)
+        # evict every evictable HBM page (incl. the probe's)
+        evict_all()
+        await one(oprobe, owarm)
+        engine.offload_paused = True
+        offload_speedup = ocold["ttft"] / owarm["ttft"] if offloaded else None
+
+        # ---- paced (Poisson) arrivals: the reference benches with
+        # genai-perf's paced load (perf.sh:22-46); closed-loop-burst TTFT
+        # (every request arriving at t=0) says nothing about latency at a
+        # given request RATE. Pace at BENCH_PACED_FRAC of the closed-loop
+        # request rate and report p50/p95 TTFT there.
+        closed_rate = concurrency / wall  # requests/s sustained
+
+        async def paced_run(frac):
+            rate = frac * closed_rate
+            n_paced = concurrency
+            recs = [dict() for _ in range(n_paced)]
+            gaps = rng.exponential(1.0 / rate, size=n_paced)
+            tasks = []
+            tp0 = time.perf_counter()
+            for i in range(n_paced):
+                p = rng.randint(1, cfg.vocab_size, size=ISL).tolist()
+                tasks.append(asyncio.create_task(one(p, recs[i])))
+                await asyncio.sleep(float(gaps[i]))
+            await asyncio.gather(*tasks)
+            return rate, recs, time.perf_counter() - tp0
+
+        # two operating points: below the knee (TTFT ~ service latency)
+        # and at ~50% of closed-loop (the prefill plane saturates when
+        # arrivals come singly — TTFT is queue-dominated there)
+        lo_frac = float(os.environ.get("BENCH_PACED_FRAC", "0.35"))
+        hi_frac = float(os.environ.get("BENCH_PACED_FRAC_HI", "0.5"))
+        paced_rate, paced_records, paced_wall = await paced_run(lo_frac)
+        hi_rate, hi_records, hi_wall = await paced_run(hi_frac)
+
+        return (
+            records, wall, cold["ttft"] / warm["ttft"],
+            prefill_wall, decode_wall,
+            paced_records, paced_rate, paced_wall,
+            hi_records, hi_rate, hi_wall,
+            offload_speedup,
+        )
+
+    (
+        records, wall, prefix_speedup,
+        prefill_wall, decode_wall,
+        paced_records, paced_rate, paced_wall,
+        hi_records, hi_rate, hi_wall,
+        offload_speedup,
+    ) = asyncio.run(run())
     total_tokens = sum(r["tokens"] for r in records)
     toks_per_sec_chip = total_tokens / wall / n_chips
     ttft_p50 = float(np.percentile([r["ttft"] for r in records], 50))
     itls = [r["itl"] for r in records if r["itl"] is not None]
     itl_p50 = float(np.percentile(itls, 50)) if itls else 0.0
 
-    target = PARITY_8B_TOKS_PER_CHIP * (_8B_PARAMS / n_params)
+    if big:
+        # the real north-star model: vs_baseline is the UNSCALED 2000
+        # tok/s/GPU bar (BASELINE.json), no parameter-count modeling
+        target = PARITY_8B_TOKS_PER_CHIP
+    else:
+        target = PARITY_8B_TOKS_PER_CHIP * (_8B_PARAMS / n_params)
+    qtag = f" {QUANT}" if QUANT else ""
+    qtag += " int8kv" if KV_QUANT else ""
     print(
         json.dumps(
             {
-                "metric": f"{cfg.name}{f' {QUANT}' if QUANT else ''} serving "
-                f"decode throughput (ISL={ISL} OSL={OSL} conc={CONCURRENCY})",
+                "metric": f"{cfg.name}{qtag} serving "
+                f"decode throughput (ISL={ISL} OSL={OSL} conc={concurrency})",
                 "value": round(toks_per_sec_chip, 2),
                 "unit": "tokens/sec/chip",
                 "vs_baseline": round(toks_per_sec_chip / target, 4),
@@ -142,14 +306,43 @@ def main() -> None:
                     "parity_target_toks_per_chip": round(target, 1),
                     # the wall includes prefilling ISL tokens per request;
                     # total token throughput shows the full device output
-                    "prefill_toks_per_sec_chip": round(
-                        CONCURRENCY * ISL / wall / n_chips, 1
-                    ),
                     "total_toks_per_sec_chip": round(
-                        (CONCURRENCY * ISL + total_tokens) / wall / n_chips, 1
+                        (concurrency * ISL + total_tokens) / wall / n_chips, 1
                     ),
+                    # MEASURED phases: prefill from a dedicated OSL=1
+                    # wave; decode from the combined wall minus it
+                    "prefill_phase_toks_per_sec_chip": round(
+                        concurrency * ISL / prefill_wall / n_chips, 1
+                    ),
+                    "decode_phase_toks_per_sec_chip": (
+                        round(total_tokens / decode_wall / n_chips, 1)
+                        if decode_wall else None
+                    ),
+                    # Poisson arrivals at two operating points: below
+                    # the knee (default 0.35x closed-loop) and at the
+                    # queue-dominated 0.5x point
+                    "paced_rate_req_s": round(paced_rate, 2),
+                    "paced_p50_ttft_s": round(float(np.percentile(
+                        [r["ttft"] for r in paced_records], 50)), 4),
+                    "paced_p95_ttft_s": round(float(np.percentile(
+                        [r["ttft"] for r in paced_records], 95)), 4),
+                    "paced_toks_per_sec_chip": round(
+                        sum(r["tokens"] for r in paced_records)
+                        / paced_wall / n_chips, 1
+                    ),
+                    "paced_hi_rate_req_s": round(hi_rate, 2),
+                    "paced_hi_p50_ttft_s": round(float(np.percentile(
+                        [r["ttft"] for r in hi_records], 50)), 4),
+                    "paced_hi_p95_ttft_s": round(float(np.percentile(
+                        [r["ttft"] for r in hi_records], 95)), 4),
                     # cold/warm TTFT on an identical prompt (prefix cache)
                     "prefix_hit_ttft_speedup": round(prefix_speedup, 2),
+                    # restore-from-host-tier TTFT vs full recompute
+                    # (HBM pages evicted between serves)
+                    "offload_hit_ttft_speedup": (
+                        round(offload_speedup, 2)
+                        if offload_speedup is not None else None
+                    ),
                 },
             }
         )

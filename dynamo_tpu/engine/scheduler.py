@@ -52,7 +52,9 @@ class Sequence:
     prefilling: bool = False   # admitted but prompt KV not yet complete
     device_pos: int = 0        # next position a decode dispatch will write
     carry_pending: bool = False  # prefill first token awaiting emission
-    # (it rides the next decode dispatch's input carry, emitted at sync)
+    # (it rides the next decode dispatch's input carry; normally emitted
+    # early by the per-group fetch task below, at sync as the fallback)
+    first_task: Optional[object] = None  # in-flight first-token fetch
     # metadata attached to the first emitted token (prefix-hit stats etc.)
     first_meta: Optional[dict] = None
     # disagg: (first_token, k [L,T,Kh*Hd], v) delivered by a remote prefill

@@ -65,7 +65,7 @@ class AttnSpec:
     def __init__(self, slot_matrix=None, block_tables=None, lengths=None,
                  write_pos=None, page_size: int = 16, interpret: bool = False,
                  mesh=None, write_tables=None, q_pos0=None, ring: bool = False,
-                 kv_tp: int = 1):
+                 kv_tp: int = 1, prefix_cols: int = 0):
         self.slot_matrix = slot_matrix
         self.block_tables = block_tables
         self.lengths = lengths
@@ -86,6 +86,9 @@ class AttnSpec:
         # tp degree of the int8-KV scale pools' row layout (static; only
         # consulted when the cache is quantized)
         self.kv_tp = kv_tp
+        # ring cached-prefix gather width in SLOTS (static bucket over
+        # the group's cached pages; bounds the per-layer prefix gather)
+        self.prefix_cols = prefix_cols
 
     @classmethod
     def gather(cls, slot_matrix, write_tables=None, page_size: int = 16,
@@ -97,11 +100,15 @@ class AttnSpec:
                    kv_tp=kv_tp)
 
     @classmethod
-    def ring(cls, slot_matrix, mesh, page_size: int = 16):
-        """Whole-prompt sp-sharded prefill: ring attention over the chunk
-        (which IS the full sequence), page-pool writes as usual."""
+    def ring(cls, slot_matrix, mesh, page_size: int = 16, q_pos0=None,
+             prefix_cols: int = 0):
+        """sp-sharded long-context prefill: ring attention over the chunk.
+        `q_pos0` [B] marks a cached-prefix continuation — the chunk is
+        the uncached tail and the cached pool rows (gathered over the
+        first `prefix_cols` slot columns only) join as extra
+        online-softmax blocks (None = whole-prompt, no prefix pass)."""
         return cls(slot_matrix=slot_matrix, mesh=mesh, page_size=page_size,
-                   ring=True)
+                   ring=True, q_pos0=q_pos0, prefix_cols=prefix_cols)
 
     @classmethod
     def pallas_decode(cls, block_tables, lengths, page_size, write_pos=None,
@@ -122,13 +129,13 @@ jax.tree_util.register_pytree_node(
     lambda s: (
         (s.slot_matrix, s.block_tables, s.lengths, s.write_pos,
          s.write_tables, s.q_pos0),
-        (s.page_size, s.interpret, s.mesh, s.ring, s.kv_tp),
+        (s.page_size, s.interpret, s.mesh, s.ring, s.kv_tp, s.prefix_cols),
     ),
     lambda aux, children: AttnSpec(
         slot_matrix=children[0], block_tables=children[1], lengths=children[2],
         write_pos=children[3], write_tables=children[4], q_pos0=children[5],
         page_size=aux[0], interpret=aux[1], mesh=aux[2], ring=aux[3],
-        kv_tp=aux[4],
+        kv_tp=aux[4], prefix_cols=aux[5],
     ),
 )
 
@@ -427,9 +434,12 @@ def _attn_block(
                 k_scales=kv_ks, v_scales=kv_vs, scale_tp=attn.kv_tp,
             )
     elif attn.ring and attn.mesh is not None:
-        # sp-sharded whole-prompt prefill: KV lands in the (sp-replicated)
+        # sp-sharded long-context prefill: KV lands in the (sp-replicated)
         # pool for later decode; attention rings the fresh chunk blocks
-        # around the sp axis (ops/ring_attention.py)
+        # around the sp axis (ops/ring_attention.py). With q_pos0 set the
+        # chunk is the UNCACHED TAIL of a prefix-cache hit: the cached
+        # rows are gathered from the pool and attended as one extra
+        # online-softmax block before the ring spins.
         from dynamo_tpu.ops.ring_attention import ring_attention_sharded
 
         if quant:
@@ -438,7 +448,23 @@ def _attn_block(
             kv_k, kv_v, write_slots,
             k.reshape(b * t, kh * hd), v.reshape(b * t, kh * hd),
         )
-        out = ring_attention_sharded(q, k, v, attn.mesh)
+        if attn.q_pos0 is not None:
+            # bounded gather: only the page bucket that actually holds
+            # cached rows — NOT the max-context slot matrix (a 128k
+            # config would otherwise materialize ~max_model_len rows per
+            # layer for a one-page hit)
+            c = min(attn.prefix_cols or attn.slot_matrix.shape[1],
+                    attn.slot_matrix.shape[1])
+            sm = attn.slot_matrix[:, :c]
+            pk = kv_k[sm].reshape(b, c, kh, hd)
+            pv = kv_v[sm].reshape(b, c, kh, hd)
+            out = ring_attention_sharded(
+                q, k, v, attn.mesh,
+                pos0=attn.q_pos0, prefix_k=pk, prefix_v=pv,
+                prefix_len=attn.q_pos0,
+            )
+        else:
+            out = ring_attention_sharded(q, k, v, attn.mesh)
     else:
         kr = k.reshape(b * t, kh * hd)
         vr = v.reshape(b * t, kh * hd)
@@ -624,12 +650,23 @@ def logits(params: Params, cfg: ModelConfig, hidden: jnp.ndarray) -> jnp.ndarray
     )
 
 
-def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> Params:
+def init_params(
+    cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16,
+    quantize: bool = False,
+) -> Params:
     """Random-init params (tests, benchmarks); HF loading lives in
-    dynamo_tpu/models/weights.py."""
+    dynamo_tpu/models/weights.py.
+
+    `quantize=True` quantizes each layer's dense projections to int8 AS
+    they are created (ops/quant.py scheme, same result as
+    `quantize_params` on the full tree) — peak device memory stays at
+    "int8 so far + one bf16 layer", which is what lets an 8B model
+    random-init on a 16 GB chip where the bf16 tree alone would OOM."""
     d, f = cfg.hidden_size, cfg.intermediate_size
     qs, kvs = cfg.q_size, cfg.kv_size
     keys = iter(jax.random.split(key, 4 + 9 * cfg.num_layers))
+    if quantize:
+        from dynamo_tpu.ops.quant import QUANT_KEYS, quantize_weight
 
     def dense(k, shape, scale=None):
         scale = scale or (shape[0] ** -0.5)
@@ -659,6 +696,11 @@ def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> Params:
             lp["bq"] = jnp.zeros((qs,), dtype)
             lp["bk"] = jnp.zeros((kvs,), dtype)
             lp["bv"] = jnp.zeros((kvs,), dtype)
+        if quantize:
+            lp = {
+                k: (quantize_weight(v) if k in QUANT_KEYS else v)
+                for k, v in lp.items()
+            }
         layers.append(lp)
 
     params: Params = {
@@ -668,6 +710,13 @@ def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> Params:
     }
     if not cfg.tie_word_embeddings:
         params["lm_head"] = dense(next(keys), (d, cfg.vocab_size))
+    if quantize:
+        head = (
+            params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
+        )
+        from dynamo_tpu.ops.quant import quantize_weight as _qw
+
+        params["lm_head"] = _qw(head)
     return params
 
 

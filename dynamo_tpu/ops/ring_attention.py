@@ -36,9 +36,11 @@ import jax.numpy as jnp
 _NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
 
 
-def _block_attend(q, k, v, q_pos, k_pos, m, l, acc, scale):
+def _block_attend(q, k, v, q_pos, k_pos, m, l, acc, scale, k_valid=None):
     """One online-softmax update of local queries against one KV block.
-    q [B,Tq,H,Hd], k/v [B,Tk,K,Hd]; m/l [B,H,Tq] f32; acc [B,Tq,H,Hd] f32."""
+    q [B,Tq,H,Hd], k/v [B,Tk,K,Hd]; m/l [B,H,Tq] f32; acc [B,Tq,H,Hd] f32.
+    `q_pos` is [Tq] or per-row [B, Tq]; `k_valid` [B, Tk] optionally
+    masks block keys per row (the cached-prefix block's valid length)."""
     b, tq, h, hd = q.shape
     kh = k.shape[2]
     g = h // kh
@@ -47,7 +49,14 @@ def _block_attend(q, k, v, q_pos, k_pos, m, l, acc, scale):
         "btkgd,bskd->bkgts", qg.astype(jnp.float32), k.astype(jnp.float32),
         preferred_element_type=jnp.float32,
     ) * scale  # [B,K,G,Tq,Tk]
-    mask = (k_pos[None, :] <= q_pos[:, None])[None, None, None]  # [1,1,1,Tq,Tk]
+    if q_pos.ndim == 1:
+        q_pos = q_pos[None]
+    if k_pos.ndim == 1:
+        k_pos = k_pos[None]
+    mask = (k_pos[:, None, :] <= q_pos[:, :, None])  # [B|1,Tq,Tk]
+    if k_valid is not None:
+        mask = mask & k_valid[:, None, :]
+    mask = mask[:, None, None]  # [B|1,1,1,Tq,Tk]
     s = jnp.where(mask, s, _NEG_INF)
     m_blk = jnp.max(s, axis=-1)                      # [B,K,G,Tq]
     m_prev = m.reshape(b, kh, g, tq)
@@ -76,21 +85,63 @@ def ring_self_attention(
     q: jax.Array,  # [B, T_local, H, Hd] this shard's queries (rope applied)
     k: jax.Array,  # [B, T_local, K, Hd] this shard's keys
     v: jax.Array,
+    pos0=None,          # [B] i32 absolute start of the (sharded) chunk
+    prefix_k=None,      # [B, C, K, Hd] cached-prefix KV (sp-replicated)
+    prefix_v=None,
+    prefix_len=None,    # [B] i32 valid prefix rows (= pos0 in the engine)
     *,
     axis_name: str = "sp",
 ) -> jax.Array:
     """Causal self-attention with sequence sharded over `axis_name`;
     call inside shard_map/jit over a mesh with that axis. Returns the
-    local output block [B, T_local, H, Hd] in q.dtype."""
+    local output block [B, T_local, H, Hd] in q.dtype.
+
+    With a cached prefix (prefix-cache hit on a long-context prompt),
+    the chunk is the UNCACHED TAIL: `pos0` offsets every position, and
+    one extra online-softmax block over the gathered prefix KV
+    (replicated across the ring — it is ordinary pool data) seeds the
+    state before the ring spins. This is what lets the sp engine keep
+    the prefix cache instead of re-prefilling whole prompts."""
     b, tl, h, hd = q.shape
     scale = hd ** -0.5
     sp = jax.lax.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
-    q_pos = idx * tl + jnp.arange(tl, dtype=jnp.int32)
+    base = idx * tl + jnp.arange(tl, dtype=jnp.int32)
+    if pos0 is None:
+        q_pos = base
+    else:
+        q_pos = pos0.astype(jnp.int32)[:, None] + base[None, :]  # [B, Tl]
 
     m = jnp.full((b, h, tl), _NEG_INF, jnp.float32)
     l = jnp.zeros((b, h, tl), jnp.float32)
     acc = jnp.zeros((b, tl, h, hd), jnp.float32)
+
+    if prefix_k is not None:
+        # chunked: a single block over a 100k-token prefix would
+        # materialize the [B,K,G,Tq,C] f32 scores ring attention exists
+        # to avoid — scan fixed-size prefix blocks with the same online
+        # state instead
+        c = prefix_k.shape[1]
+        blk = min(c, 2048)
+        nblk = -(-c // blk)
+        c_pad = nblk * blk
+        if c_pad != c:
+            pad = ((0, 0), (0, c_pad - c), (0, 0), (0, 0))
+            prefix_k = jnp.pad(prefix_k, pad)
+            prefix_v = jnp.pad(prefix_v, pad)
+        pl_len = prefix_len.astype(jnp.int32)[:, None]
+
+        def prefix_body(i, carry):
+            m, l, acc = carry
+            pk = jax.lax.dynamic_slice_in_dim(prefix_k, i * blk, blk, 1)
+            pv = jax.lax.dynamic_slice_in_dim(prefix_v, i * blk, blk, 1)
+            kp = i * blk + jnp.arange(blk, dtype=jnp.int32)
+            valid = kp[None, :] < pl_len  # [B, blk]
+            return _block_attend(
+                q, pk, pv, q_pos, kp, m, l, acc, scale, k_valid=valid
+            )
+
+        m, l, acc = jax.lax.fori_loop(0, nblk, prefix_body, (m, l, acc))
 
     # ring: at step s this shard holds the KV block originally on shard
     # (idx - s) mod sp; rotate towards the next rank each step
@@ -100,7 +151,17 @@ def ring_self_attention(
         k_blk, v_blk, m, l, acc = carry
         src = (idx - s) % sp
         k_pos = src * tl + jnp.arange(tl, dtype=jnp.int32)
-        m, l, acc = _block_attend(q, k_blk, v_blk, q_pos, k_pos, m, l, acc, scale)
+        if pos0 is not None:
+            # ring blocks hold CHUNK positions; shift into absolute ones
+            # per row so causality composes with the prefix offset
+            k_pos = pos0.astype(jnp.int32)[:, None] + k_pos[None, :]
+            m, l, acc = _block_attend(
+                q, k_blk, v_blk, q_pos, k_pos, m, l, acc, scale
+            )
+        else:
+            m, l, acc = _block_attend(
+                q, k_blk, v_blk, q_pos, k_pos, m, l, acc, scale
+            )
         k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
         v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
         return k_blk, v_blk, m, l, acc
@@ -110,16 +171,27 @@ def ring_self_attention(
     return (acc / denom).astype(q.dtype)
 
 
-def ring_attention_sharded(q, k, v, mesh, axis_name: str = "sp"):
+def ring_attention_sharded(q, k, v, mesh, axis_name: str = "sp",
+                           pos0=None, prefix_k=None, prefix_v=None,
+                           prefix_len=None):
     """Convenience wrapper: shard_map over `mesh` with the sequence dim
     sharded on `axis_name` (batch on dp, heads on tp untouched — ring and
-    tensor parallel compose)."""
+    tensor parallel compose). Prefix KV replicates over the ring axis."""
     P = jax.sharding.PartitionSpec
     spec = P("dp", axis_name, "tp", None)
+    if prefix_k is None:
+        return jax.shard_map(
+            functools.partial(ring_self_attention, axis_name=axis_name),
+            mesh=mesh,
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
+            check_vma=False,
+        )(q, k, v)
+    pspec = P("dp", None, "tp", None)
     return jax.shard_map(
         functools.partial(ring_self_attention, axis_name=axis_name),
         mesh=mesh,
-        in_specs=(spec, spec, spec),
+        in_specs=(spec, spec, spec, P("dp"), pspec, pspec, P("dp")),
         out_specs=spec,
         check_vma=False,
-    )(q, k, v)
+    )(q, k, v, pos0, prefix_k, prefix_v, prefix_len)

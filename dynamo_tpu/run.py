@@ -62,6 +62,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--quantization", default=None, choices=["int8"],
                    help="W8A8 int8 serving (the TPU match for the "
                         "reference's FP8 baselines)")
+    p.add_argument("--kv-quantization", default=None, choices=["int8"],
+                   help="int8 KV cache pages (halves decode HBM traffic; "
+                        "use --page-size 128 to keep the pallas kernels)")
     p.add_argument("--host-kv-pages", type=int, default=0,
                    help="HBM->host KV offload pool size (0 disables)")
     p.add_argument("--dtype", default="bfloat16", choices=["bfloat16", "float32"])
@@ -109,6 +112,7 @@ def build_engine_config_kwargs(args) -> dict:
         decode_steps=args.decode_steps,
         attn_backend=args.attn_backend,
         quantization=args.quantization,
+        kv_quantization=args.kv_quantization,
         host_kv_pages=args.host_kv_pages,
     )
     if args.extra_engine_args:

@@ -1,0 +1,198 @@
+"""Child process for the cross-process device-path disagg test/dryrun.
+
+Two OS processes — rank 0 a PREFILL worker, rank 1 a DECODE worker —
+join one jax.distributed group (virtual CPU devices stand in for chips,
+as everywhere in this repo's multi-chip testing). The prefill worker
+computes a prompt's KV on its engine; the bulk KV then moves to the
+decode worker over the DEVICE path (engine/xproc_kv.py: one jitted
+host-axis collective over a ("host", "dev") transfer mesh — the
+multi-controller NIXL equivalent, reference: vLLM patch nixl.py), with
+a TP-degree mismatch between the two engines (prefill tp=1, decode
+tp=2) resolved by the decode pool's inject scatter. The decode worker
+ingests the pages into its prefix cache and must reproduce its local
+oracle's greedy output BIT-IDENTICALLY.
+
+Run via tests/test_xproc_disagg.py or __graft_entry__.dryrun_multichip,
+not directly.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import socket
+import subprocess
+import sys
+
+
+def run_pair(kv_quant: bool) -> list[str]:
+    """Spawn the two-worker pair (rank 0 prefill, rank 1 decode) and
+    return both ranks' outputs; raises on nonzero exit. Shared by
+    tests/test_xproc_disagg.py and __graft_entry__.dryrun_multichip
+    (pytest-free on purpose: the dryrun runs outside any test harness).
+    On a hang BOTH ranks are killed and both outputs still collected —
+    the logs are the only diagnostic for a distributed stall."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in [os.path.dirname(here), env.get("PYTHONPATH", "")] if p
+    )
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        coordinator = f"127.0.0.1:{s.getsockname()[1]}"
+    procs = [
+        subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), coordinator,
+             str(rank)] + (["int8"] if kv_quant else []),
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for rank in range(2)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=420)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                if q.poll() is None:
+                    q.kill()
+            out, _ = p.communicate()
+        outs.append(out)
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        if p.returncode != 0:
+            raise RuntimeError(f"xproc rank {rank} failed:\n{out}")
+    return outs
+
+
+def main() -> None:
+    coordinator, rank = sys.argv[1], int(sys.argv[2])
+    kv_quant = len(sys.argv) > 3 and sys.argv[3] == "int8"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from dynamo_tpu.parallel.multihost import MultiHostConfig, initialize
+
+    initialize(MultiHostConfig(
+        num_nodes=2, node_rank=rank, coordinator=coordinator
+    ))
+    assert jax.device_count() == 2 * jax.local_device_count()
+
+    import numpy as np
+
+    from dynamo_tpu.engine import EngineConfig, JaxEngine
+    from dynamo_tpu.engine.xproc_kv import XProcKvBridge, transfer_mesh
+    from dynamo_tpu.llm.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+    from dynamo_tpu.models.config import get_config
+    from dynamo_tpu.parallel.mesh import MeshConfig
+    from dynamo_tpu.runtime.pipeline.context import Context
+
+    cfg = get_config("tiny")
+    prefill_devs = [d for d in jax.devices() if d.process_index == 0]
+    decode_devs = [d for d in jax.devices() if d.process_index == 1]
+    bridge = XProcKvBridge(
+        transfer_mesh(prefill_devs, decode_devs),
+        role="prefill" if rank == 0 else "decode",
+    )
+
+    def make_engine(tp, devices):
+        return JaxEngine(EngineConfig(
+            model=cfg,
+            dtype="float32",
+            mesh=MeshConfig(tp=tp),
+            kv_quantization="int8" if kv_quant else None,
+            page_size=8,
+            num_pages=64,
+            max_batch_size=4,
+            max_model_len=128,
+            prefill_chunk=32,
+            seed=0,  # identical weights on both workers
+        ), devices=devices)
+
+    prompt = list(range(30, 70))  # 40 tokens = 5 full pages
+    pre = PreprocessedRequest(
+        token_ids=prompt,
+        stop_conditions=StopConditions(max_tokens=6, ignore_eos=True),
+        sampling_options=SamplingOptions(greedy=True),
+    )
+    L = cfg.num_layers
+    kwid = cfg.num_kv_heads * cfg.head_dim
+    shape = (len(prompt), L, kwid)  # transfer lanes over the token dim
+    sshape = (len(prompt), L, cfg.num_kv_heads) if kv_quant else None
+    kv_dtype = np.int8 if kv_quant else np.float32
+
+    async def run() -> None:
+        if rank == 0:
+            # PREFILL worker (tp=1): compute KV, ship it device-path
+            engine = make_engine(1, prefill_devs[:1])
+            first, k, v, ks, vs = await engine.prefill_only(
+                pre, device_arrays=True
+            )
+            # [L, T, ...] -> [T, L, ...]: the transfer shards its
+            # leading dim over the lane devices
+            bridge.transfer_kv(
+                k.transpose(1, 0, 2), v.transpose(1, 0, 2), shape, kv_dtype,
+                ks.transpose(1, 0, 2) if ks is not None else None,
+                vs.transpose(1, 0, 2) if vs is not None else None,
+                scale_shape=sshape,
+            )
+            print(f"rank 0: prefill computed + KV sent (first={first})",
+                  flush=True)
+            await engine.close()
+            return
+
+        # DECODE worker (tp=2 — TP-degree mismatch vs the prefiller)
+        engine = make_engine(2, decode_devs[:2])
+        oracle = make_engine(2, decode_devs[:2])
+
+        async def collect(e):
+            toks = []
+            async for f in await e.generate(Context(pre.to_dict())):
+                toks.extend(f.get("token_ids") or [])
+            return toks
+
+        ref = await collect(oracle)
+
+        k, v, ks, vs = bridge.transfer_kv(
+            None, None, shape, kv_dtype, scale_shape=sshape
+        )
+        n = engine.ingest_prefix(
+            prompt,
+            k.transpose(1, 0, 2), v.transpose(1, 0, 2),
+            ks.transpose(1, 0, 2) if ks is not None else None,
+            vs.transpose(1, 0, 2) if vs is not None else None,
+        )
+        assert n == 40, f"ingested {n} tokens, wanted 40"
+
+        got = []
+        frames = []
+        async for f in await engine.generate(Context(pre.to_dict())):
+            frames.append(f)
+            got.extend(f.get("token_ids") or [])
+        meta = frames[0].get("meta") or {}
+        cached = meta.get("prefix_cached_tokens", 0)
+        assert cached >= 32, f"prefix cache hit only {cached} tokens"
+        assert got == ref, f"xproc continuation diverged: {got} vs {ref}"
+        print(
+            f"rank 1: xproc disagg ok — {cached} tokens rode the "
+            f"device-path KV (tp 1->2{', int8 wire' if kv_quant else ''}), "
+            f"greedy bit-identical {got}",
+            flush=True,
+        )
+        await engine.close()
+        await oracle.close()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()
