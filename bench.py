@@ -37,6 +37,9 @@ QUANT = os.environ.get("BENCH_QUANT", "int8")
 QUANT = None if QUANT in ("", "none") else QUANT
 KV_QUANT = os.environ.get("BENCH_KV_QUANT", "int8")
 KV_QUANT = None if KV_QUANT in ("", "none") else KV_QUANT
+# BENCH_FAST=1: headline wave + prefix probe only (the concurrency sweep
+# runs one engine init per point — skip the paced/offload/phase extras)
+FAST = os.environ.get("BENCH_FAST", "") not in ("", "0")
 
 
 def main() -> None:
@@ -144,8 +147,9 @@ def main() -> None:
         # buckets) the full-concurrency waves never hit — compile every
         # power-of-two family (rows 1..32) now or the paced phase
         # measures compiler stalls as TTFT (measured: a 40 s mid-wave
-        # stall from one cold [8, 512] prefill family)
-        for k in (1, 2, 3, 6, 12, 24, 48):
+        # stall from one cold [8, 512] prefill family). FAST mode skips
+        # the paced phase, so it needs none of these
+        for k in (() if FAST else (1, 2, 3, 6, 12, 24, 48)):
             if k >= concurrency:
                 break
             batch = [
@@ -163,6 +167,16 @@ def main() -> None:
         records = [dict() for _ in prompts]
         await asyncio.gather(*(one(p, r) for p, r in zip(prompts, records)))
         wall = time.perf_counter() - t0
+
+        if FAST:
+            probe = rng.randint(1, cfg.vocab_size, size=ISL).tolist()
+            cold, warm = {}, {}
+            await one(probe, cold)
+            await one(probe, warm)
+            return (
+                records, wall, cold["ttft"] / warm["ttft"],
+                None, None, [], 0.0, 0.0, [], 0.0, 0.0, None,
+            )
 
         # ---- phase-resolved: a MEASURED prefill-only wave (OSL=1), not
         # a token-ratio split of the combined wall (VERDICT r3 weak #2)
@@ -311,8 +325,9 @@ def main() -> None:
                     ),
                     # MEASURED phases: prefill from a dedicated OSL=1
                     # wave; decode from the combined wall minus it
-                    "prefill_phase_toks_per_sec_chip": round(
-                        concurrency * ISL / prefill_wall / n_chips, 1
+                    "prefill_phase_toks_per_sec_chip": (
+                        round(concurrency * ISL / prefill_wall / n_chips, 1)
+                        if prefill_wall else None
                     ),
                     "decode_phase_toks_per_sec_chip": (
                         round(total_tokens / decode_wall / n_chips, 1)
@@ -321,20 +336,22 @@ def main() -> None:
                     # Poisson arrivals at two operating points: below
                     # the knee (default 0.35x closed-loop) and at the
                     # queue-dominated 0.5x point
-                    "paced_rate_req_s": round(paced_rate, 2),
-                    "paced_p50_ttft_s": round(float(np.percentile(
-                        [r["ttft"] for r in paced_records], 50)), 4),
-                    "paced_p95_ttft_s": round(float(np.percentile(
-                        [r["ttft"] for r in paced_records], 95)), 4),
-                    "paced_toks_per_sec_chip": round(
-                        sum(r["tokens"] for r in paced_records)
-                        / paced_wall / n_chips, 1
-                    ),
-                    "paced_hi_rate_req_s": round(hi_rate, 2),
-                    "paced_hi_p50_ttft_s": round(float(np.percentile(
-                        [r["ttft"] for r in hi_records], 50)), 4),
-                    "paced_hi_p95_ttft_s": round(float(np.percentile(
-                        [r["ttft"] for r in hi_records], 95)), 4),
+                    **({} if not paced_records else {
+                        "paced_rate_req_s": round(paced_rate, 2),
+                        "paced_p50_ttft_s": round(float(np.percentile(
+                            [r["ttft"] for r in paced_records], 50)), 4),
+                        "paced_p95_ttft_s": round(float(np.percentile(
+                            [r["ttft"] for r in paced_records], 95)), 4),
+                        "paced_toks_per_sec_chip": round(
+                            sum(r["tokens"] for r in paced_records)
+                            / paced_wall / n_chips, 1
+                        ),
+                        "paced_hi_rate_req_s": round(hi_rate, 2),
+                        "paced_hi_p50_ttft_s": round(float(np.percentile(
+                            [r["ttft"] for r in hi_records], 50)), 4),
+                        "paced_hi_p95_ttft_s": round(float(np.percentile(
+                            [r["ttft"] for r in hi_records], 95)), 4),
+                    }),
                     # cold/warm TTFT on an identical prompt (prefix cache)
                     "prefix_hit_ttft_speedup": round(prefix_speedup, 2),
                     # restore-from-host-tier TTFT vs full recompute

@@ -3,7 +3,7 @@
 concurrency 1..256) against the local chip. Reuses bench.py's engine
 setup per point; writes SWEEP.json at the repo root and prints a table.
 
-Run: python scripts/sweep.py [conc ...]   (default 1 4 16 64 256)
+Run: python scripts/sweep.py [conc ...]   (default 1 4 16 64 128)
 """
 
 from __future__ import annotations
@@ -23,11 +23,12 @@ def run_point(conc: int) -> dict:
     env = dict(
         os.environ,
         BENCH_CONCURRENCY=str(conc),
+        BENCH_FAST="1",  # headline + prefix probe per point
         PYTHONPATH=f"{REPO}:{pp}" if pp else REPO,
     )
     out = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py")],
-        env=env, capture_output=True, text=True, timeout=900,
+        env=env, capture_output=True, text=True, timeout=2400,
     )
     lines = [ln for ln in out.stdout.strip().splitlines() if ln.startswith("{")]
     if not lines:
@@ -39,7 +40,7 @@ def run_point(conc: int) -> dict:
 
 
 def main() -> None:
-    concs = [int(a) for a in sys.argv[1:]] or [1, 4, 16, 64, 256]
+    concs = [int(a) for a in sys.argv[1:]] or [1, 4, 16, 64, 128]
     points = []
     print(f"{'conc':>5} {'decode tok/s':>13} {'total tok/s':>12} "
           f"{'p50 TTFT s':>11} {'p50 ITL ms':>11}")
@@ -57,7 +58,19 @@ def main() -> None:
         print(f"{conc:>5} {r['value']:>13.1f} "
               f"{e['total_toks_per_sec_chip']:>12.1f} "
               f"{e['p50_ttft_s']:>11.3f} {e['p50_itl_s'] * 1e3:>11.2f}")
+    extra = {}
+    sweep_path = os.path.join(REPO, "SWEEP.json")
+    if os.path.exists(sweep_path):
+        try:
+            prev = json.load(open(sweep_path))
+            extra = {
+                k: v for k, v in prev.items()
+                if k not in ("metric", "protocol", "points")
+            }
+        except Exception:
+            pass
     record = {
+        **extra,
         "metric": points and points[-1] or {},
         "protocol": {
             "isl": int(os.environ.get("BENCH_ISL", "512")),
