@@ -1323,7 +1323,16 @@ class JaxEngine:
         for bucket, seqs in groups.items():
             progressed = True
             try:
-                toks = self._prefill_group_dispatch(seqs, bucket)
+                # worker thread: a jit dispatch through the device tunnel
+                # BLOCKS until prior queued work drains — run inline it
+                # would freeze the event loop for the whole admission
+                # wave, parking every pending first-token emission (and
+                # the stream consumers) until the LAST group dispatched.
+                # _kv_lock serializes the donated cache underneath.
+                toks = await asyncio.to_thread(
+                    self._prefill_group_dispatch, seqs, bucket
+                )
+                self._note_prefilled(seqs, bucket)
             except Exception:
                 log.exception(
                     "prefill group of %d seqs failed; retrying singly",
@@ -1333,7 +1342,10 @@ class JaxEngine:
                 # each sequence in its own dispatch
                 for seq in seqs:
                     try:
-                        tok1 = self._prefill_group_dispatch([seq], bucket)
+                        tok1 = await asyncio.to_thread(
+                            self._prefill_group_dispatch, [seq], bucket
+                        )
+                        self._note_prefilled([seq], bucket)
                     except Exception:
                         log.exception("prefill of seq %s failed", seq.seq_id)
                         self._finish(seq, FINISH_REASON_ERROR)
@@ -1564,30 +1576,44 @@ class JaxEngine:
                 )
             else:
                 S, self.kv = self._step_fn(*common, sp_cached=spc)
-        for j, seq in enumerate(seqs):
+        # (toks, lps[, top_ids, top_lps]) -> uniform 4-tuple; callers run
+        # _note_prefilled on the EVENT-LOOP thread — this method may run
+        # in a worker thread, and allocator bookkeeping must not race the
+        # loop's emission/finish callbacks
+        return S if len(S) == 4 else (S[0], S[1], None, None)
+
+    def _note_prefilled(self, seqs: list[Sequence], bucket: int) -> None:
+        """Post-dispatch bookkeeping (loop thread only): advance computed
+        counts and register full pages in the prefix cache."""
+        for seq in seqs:
             chunk = min(seq.total_tokens - seq.num_computed, bucket)
             seq.num_computed += chunk
             self._register_full_pages(seq)
-        # (toks, lps[, top_ids, top_lps]) -> uniform 4-tuple
-        return S if len(S) == 4 else (S[0], S[1], None, None)
 
     def _prefill_chunk_dispatch(self, seq: Sequence):
-        """Single-sequence chunk dispatch (disagg prefill_only path);
-        returns the sampled-token device vector [1] when this was the
-        final chunk, else None."""
-        toks, _lps, _tid, _tlp = self._prefill_group_dispatch([seq], self._bucket_for(
+        """Single-sequence chunk dispatch (disagg prefill_only path;
+        worker thread). Returns (token vector [1], bucket) — the CALLER
+        runs `_note_prefilled` on the event-loop thread (the allocator
+        has no lock; bookkeeping must not race loop-side callbacks)."""
+        bucket = self._bucket_for(
             min(seq.total_tokens - seq.num_computed, self.config.prefill_chunk)
-        ))
-        return toks[:1] if seq.num_computed >= seq.total_tokens else None
+        )
+        toks, _lps, _tid, _tlp = self._prefill_group_dispatch([seq], bucket)
+        return toks[:1], bucket
 
     async def _prefill_forward(self, seq: Sequence) -> int:
         """Blocking chunked prefill (disagg prefill_only path): writes KV,
         returns the token sampled at the final position."""
-        tok = None
-        while tok is None:
+        while True:
             # worker thread: the _kv_lock acquire can wait out a whole
-            # in-flight decode dispatch — never block the event loop on it
-            tok = await asyncio.to_thread(self._prefill_chunk_dispatch, seq)
+            # in-flight decode dispatch — never block the event loop on
+            # it. Bookkeeping stays HERE (event-loop thread).
+            tok, bucket = await asyncio.to_thread(
+                self._prefill_chunk_dispatch, seq
+            )
+            self._note_prefilled([seq], bucket)
+            if seq.num_computed >= seq.total_tokens:
+                break
         out = await asyncio.to_thread(np.asarray, tok)
         return int(out.ravel()[0])
 
