@@ -231,3 +231,45 @@ async def test_ingest_rejects_unknown_request():
         finally:
             await engine.close()
             await drt.shutdown()
+
+
+async def test_concurrent_prefill_only_and_serving():
+    """A disagg prefill worker serves prefill_only calls WHILE normal
+    generate() traffic runs on the same engine — the dispatch threads
+    interleave under _kv_lock and allocator bookkeeping stays on the
+    event loop (threaded-prefill refactor's race surface)."""
+    engine = make_engine(num_pages=96, max_batch_size=4)
+    prompt_a = list(range(30, 62))
+    prompt_b = list(range(70, 90))
+    ref_engine = make_engine()
+    ref_a, _ = await collect(
+        await ref_engine.generate(Context(greedy(prompt_a, 6).to_dict()))
+    )
+    await ref_engine.close()
+
+    async def serve(p):
+        toks, _ = await collect(
+            await engine.generate(Context(greedy(p, 6).to_dict()))
+        )
+        return toks
+
+    results = await asyncio.gather(
+        serve(prompt_a),
+        engine.prefill_only(greedy(prompt_b, 4)),
+        serve([5, 6, 7, 8]),
+        engine.prefill_only(greedy(list(range(100, 140)), 4)),
+        serve(prompt_a),
+    )
+    assert results[0] == ref_a and results[4] == ref_a
+    first_b, k, v, ks, vs = results[1]
+    assert k.shape[1] == len(prompt_b)
+    first_c, kc, vc, _, _ = results[3]
+    assert kc.shape[1] == 40 and isinstance(first_c, int)
+    assert len(results[2]) == 6
+    # prefill_only registered its pages: a follow-up serve rides them
+    toks_b, frames_b = await collect(
+        await engine.generate(Context(greedy(prompt_b, 3).to_dict()))
+    )
+    assert toks_b[0] == first_b
+    assert (frames_b[0].get("meta") or {}).get("prefix_cached_tokens", 0) > 0
+    await engine.close()
