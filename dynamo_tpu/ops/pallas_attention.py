@@ -444,8 +444,34 @@ def _decode_kernel_q(
             ).start()
             wb_pending[slot] = 1
 
+        if ablate in ("nocompute", "noconvert"):
+            # DMA + loop floor: "nocompute" converts the full buffers
+            # (mirrors the bf16 kernel's ablation), "noconvert" touches
+            # 8 rows only — the delta isolates the int8->f32 VPU cost
+            if ablate == "nocompute":
+                touch = (
+                    jnp.sum(kb.astype(jnp.float32))
+                    + jnp.sum(vb.astype(jnp.float32))
+                )
+            else:
+                touch = (
+                    jnp.sum(kb[0:8, :].astype(jnp.float32))
+                    + jnp.sum(vb[0:8, :].astype(jnp.float32))
+                )
+            acc = acc + touch * 0.0
+            nxt = w + nbuf
+
+            @pl.when(nxt < n_work)
+            def _refill_ablate():
+                drain_wb(slot)
+                start_work_dma(nxt, slot)
+
+            return m_prev, l_prev, acc
+
         # int8 values are exact in bf16, so the data dot needs no HIGHEST;
-        # K-scales fold into the score lanes afterwards (one VPU repeat)
+        # K-scales fold into the score lanes afterwards (one VPU repeat).
+        # (probed: casting to bf16 instead of f32 here is ~4% SLOWER —
+        # int8->bf16 goes through f32 plus a truncate on the VPU)
         s = jax.lax.dot_general(
             qb_ref[seq].astype(jnp.float32), kb.astype(jnp.float32),
             dimension_numbers=(((1,), (1,)), ((), ())),

@@ -1,0 +1,93 @@
+"""Focused decode-rate check: 16-layer 1B model scan at one batch size,
+int8 weights + int8 KV (the flagship config). Same methodology as
+scripts/kernel_check_tpu.py (full scan, fetch once) — used to iterate on
+decode-kernel changes without the full check matrix.
+
+Run: python scripts/probe_decode_full.py [B] [reps]
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dynamo_tpu.models import llama
+from dynamo_tpu.models.config import get_config
+from dynamo_tpu.ops.sampling import sample_tokens
+
+B = int(sys.argv[1]) if len(sys.argv) > 1 else 256
+N = int(sys.argv[2]) if len(sys.argv) > 2 else 6
+CFG = get_config("llama-3.2-1b")
+STEPS = 16
+KV_LEN = 480
+
+
+def time_scan(b, quant=True, kv_quant=True):
+    pg = 128
+    w_pages = -(-(KV_LEN + STEPS + pg) // pg)
+    num_slots = (b * w_pages + 17) * pg
+    tables = jnp.asarray(
+        np.stack([np.arange(1 + i * w_pages, 1 + (i + 1) * w_pages)
+                  for i in range(b)]), jnp.int32)
+    temp = jnp.zeros((b,), jnp.float32)
+    topk = jnp.zeros((b,), jnp.int32)
+    topp = jnp.ones((b,), jnp.float32)
+
+    def multi(params, kv, tokens, positions, key):
+        def body(carry, _):
+            tokens, positions, kv, key = carry
+            key, sub = jax.random.split(key)
+            wslots = (
+                jnp.take_along_axis(
+                    tables, (positions // pg)[:, None], axis=1
+                )[:, 0] * pg + positions % pg
+            ).astype(jnp.int32)
+            spec = llama.AttnSpec.pallas_decode(
+                tables, positions + 1, pg, write_pos=positions
+            )
+            hidden, kv = llama.forward(
+                params, CFG, tokens[:, None], positions[:, None],
+                kv, wslots, spec,
+            )
+            lg = llama.logits(params, CFG, hidden[:, 0])
+            toks = sample_tokens(lg, sub, temp, topk, topp, all_greedy=True)
+            return (toks, positions + 1, kv, key), toks
+
+        (_, _, kv, _), out = jax.lax.scan(
+            body, (tokens, positions, kv, key), None, length=STEPS)
+        return out, kv
+
+    params = llama.init_params(CFG, jax.random.PRNGKey(0), dtype=jnp.bfloat16)
+    if quant:
+        from dynamo_tpu.ops.quant import quantize_params
+
+        params = quantize_params(params, CFG)
+    kv = jax.device_put(llama.init_kv_cache(
+        CFG, num_slots, dtype=jnp.bfloat16,
+        kv_quant="int8" if kv_quant else None, page_size=pg,
+    ))
+    tokens = jnp.ones((b,), jnp.int32)
+    positions = jnp.full((b,), KV_LEN, jnp.int32)
+    key = jax.random.PRNGKey(0)
+    f = jax.jit(multi, donate_argnums=(1,))
+    out, kv = f(params, kv, tokens, positions, key)
+    _ = np.asarray(out[-1, :1])
+    t0 = time.perf_counter()
+    for _ in range(N):
+        out, kv = f(params, kv, tokens, positions, key)
+    _ = np.asarray(out[-1, :1])
+    return (time.perf_counter() - t0) / N / STEPS
+
+
+def main():
+    dt = time_scan(B)
+    print(f"B={B} int8+int8kv: {dt * 1e3:.3f} ms/step -> {B / dt:.0f} tok/s")
+
+
+if __name__ == "__main__":
+    main()

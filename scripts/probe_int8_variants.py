@@ -1,0 +1,126 @@
+"""Attention-only decode-kernel variant shootout at serving shapes.
+
+Round-4 left int8-KV decode ~1.8 ms/step short of its byte-count ideal
+at B=256 (KERNEL_TPU.json). Candidate causes: int8 (32,128) VMEM-tile
+DMA penalty vs the scale-tile DMAs doubling the copy count. This times
+the REAL kernel (chained scan, donated pools — axon methodology) per
+variant and ablation to attribute the loss before committing to the
+int32-packing refactor.
+
+Run: python scripts/probe_int8_variants.py [B] [kv_len]
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dynamo_tpu.ops.pallas_attention import fused_paged_decode_attention
+
+B = int(sys.argv[1]) if len(sys.argv) > 1 else 256
+KV_LEN = int(sys.argv[2]) if len(sys.argv) > 2 else 480
+STEPS = 16
+PAGE = 128
+KH, HD, H = 8, 64, 32
+KW = KH * HD
+
+
+def time_variant(name, quant, ablate="", iters=3):
+    w = -(-(KV_LEN + STEPS + PAGE) // PAGE)
+    num_pages = B * w + 17
+    num_slots = num_pages * PAGE
+    rng = np.random.RandomState(0)
+    tables = jnp.asarray(
+        np.stack([np.arange(1 + i * w, 1 + (i + 1) * w) for i in range(B)]),
+        jnp.int32,
+    )
+
+    if quant:
+        from dynamo_tpu.ops.quant import init_kv_scale_pool
+
+        k_cache = jnp.asarray(
+            rng.randint(-127, 128, size=(num_slots, KW)), jnp.int8
+        )
+        v_cache = jnp.asarray(
+            rng.randint(-127, 128, size=(num_slots, KW)), jnp.int8
+        )
+        ks = init_kv_scale_pool(num_pages, PAGE, KH)
+        vs = init_kv_scale_pool(num_pages, PAGE, KH)
+        subl = ks.shape[1]
+    else:
+        k_cache = jnp.asarray(rng.randn(num_slots, KW), jnp.bfloat16)
+        v_cache = jnp.asarray(rng.randn(num_slots, KW), jnp.bfloat16)
+
+    q = jnp.asarray(rng.randn(B, H, HD), jnp.bfloat16)
+
+    def multi(q, k_cache, v_cache, *scales):
+        def body(carry, i):
+            if quant:
+                k_cache, v_cache, ks, vs = carry
+            else:
+                k_cache, v_cache = carry
+            positions = jnp.full((B,), KV_LEN, jnp.int32) + i
+            args = dict(
+                page_size=PAGE, ablate=ablate,
+            )
+            if quant:
+                nk = jnp.ones((B, KW), jnp.int8)
+                nv = jnp.ones((B, KW), jnp.int8)
+                nks = jnp.ones((B, subl), jnp.float32)
+                nvs = jnp.ones((B, subl), jnp.float32)
+                out, k_cache, v_cache, ks, vs = fused_paged_decode_attention(
+                    q, nk, nv, k_cache, v_cache, tables, positions + 1,
+                    positions, ks, vs, nks, nvs, **args,
+                )
+                carry = (k_cache, v_cache, ks, vs)
+            else:
+                nk = jnp.ones((B, KW), jnp.bfloat16)
+                nv = jnp.ones((B, KW), jnp.bfloat16)
+                out, k_cache, v_cache = fused_paged_decode_attention(
+                    q, nk, nv, k_cache, v_cache, tables, positions + 1,
+                    positions, **args,
+                )
+                carry = (k_cache, v_cache)
+            return carry, out[0, 0, 0]
+
+        init = (k_cache, v_cache, *scales) if quant else (k_cache, v_cache)
+        carry, outs = jax.lax.scan(
+            body, init, jnp.arange(STEPS, dtype=jnp.int32)
+        )
+        return outs[-1]
+
+    f = jax.jit(multi, donate_argnums=(1, 2, 3, 4) if quant else (1, 2))
+    args = (q, k_cache, v_cache) + ((ks, vs) if quant else ())
+    _ = np.asarray(f(*args))
+    best = None
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        _ = np.asarray(f(*args))
+        dt = (time.perf_counter() - t0) / STEPS
+        best = dt if best is None else min(best, dt)
+    # streamed bytes per step: every live page's K+V (+scale tiles)
+    live_pages = int(np.sum(-(-(np.full(B, KV_LEN + 1)) // PAGE)))
+    nbytes = live_pages * PAGE * KW * 2 * k_cache.dtype.itemsize
+    if quant:
+        nbytes += live_pages * subl * PAGE * 4 * 2
+    print(
+        f"{name:32s} {best * 1e3:7.2f} ms/step   {nbytes / best / 1e9:6.0f} GB/s"
+    )
+    return best
+
+
+def main():
+    print(f"B={B} kv_len={KV_LEN} page={PAGE} 1B dims (kh=8 hd=64)")
+    time_variant("bf16", quant=False)
+    time_variant("int8+scales", quant=True)
+    time_variant("int8 noscale_dma", quant=True, ablate="noscale_dma")
+    time_variant("int8 noscale_mul", quant=True, ablate="noscale_mul")
+
+
+if __name__ == "__main__":
+    main()
