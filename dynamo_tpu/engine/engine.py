@@ -226,6 +226,15 @@ class JaxEngine:
                 "keep the pallas kernels", config.page_size,
             )
             self._attn_pallas = False
+        # int32-PACKED int8 pools (ops/quant.pack_kv_slots): f32-class DMA
+        # tiling recovers the int8 (32,128)-tile penalty (+12% decode at
+        # B=256, scripts/probe_decode_attrib.py). Serving (pallas) path
+        # only — the gather/sp/pp paths keep dense int8 pools, and the
+        # wire/offload formats stay dense int8 (pack/unpack at the edges)
+        self._kv_packed = bool(
+            self._kv_quant and self._attn_pallas
+            and not self._sp and mc.pp == 1
+        )
 
         # pipeline-parallel serving: pp > 1 runs the GPipe stage executor
         # (parallel/pipeline.py) — layers AND KV pools live stage-local;
@@ -307,7 +316,7 @@ class JaxEngine:
         kv = llama.init_kv_cache(
             self.model_cfg, num_slots, dtype=self._dtype,
             kv_quant=self._kv_quant, page_size=self.page_size,
-            tp=config.mesh.tp,
+            tp=config.mesh.tp, packed=self._kv_packed,
         )
         if self._pp:
             from dynamo_tpu.parallel.pipeline import (
@@ -433,10 +442,85 @@ class JaxEngine:
         # when the source engine runs an int8 KV cache)
         kh = self.model_cfg.num_kv_heads
         kv_tp = config.mesh.tp
-        from dynamo_tpu.ops.quant import gather_kv_scales, scatter_kv_scales
+        from dynamo_tpu.ops.quant import (
+            gather_kv_scales,
+            gather_packed_kv,
+            pack_kv_slots,
+            scales_to_page_tiles,
+            scatter_kv_scales,
+        )
+
+        _eng_ps = self.config.page_size
+        _eng_packed = self._kv_packed
+        _eng_interp = self._attn_interpret
 
         def _inject(kv, slots, nk, nv, nks=None, nvs=None):
-            # nks/nvs: dense wire scales [L, T, K] -> pool-layout scatter
+            # nks/nvs: dense wire scales [L, T, K] -> pool-layout scatter.
+            # Every caller passes page-run slots (whole allocated pages,
+            # or a page-aligned chunk whose tail rows may be garbage —
+            # the paged_kv_write contract), padded with trash slot 0.
+            if _eng_packed:
+                # int32-packed pools: page-granular write through the
+                # pallas page-scatter kernel (a byte-level slot scatter
+                # into packed rows would need collision-safe RMW; whole
+                # pages sidestep it and reuse the prefill path). Under
+                # tp>1 the kernel must run per-shard inside shard_map —
+                # a pallas custom call has no GSPMD partitioning rule
+                # (same reason the model path wraps it, llama.py)
+                from dynamo_tpu.ops.pallas_kv_write import paged_kv_write
+
+                import functools as _ft
+
+                wr = _ft.partial(
+                    paged_kv_write, page_size=_eng_ps, interpret=_eng_interp
+                )
+                if self._attn_mesh is not None:
+                    P = jax.sharding.PartitionSpec
+                    wr = jax.shard_map(
+                        wr,
+                        mesh=self._attn_mesh,
+                        in_specs=(
+                            P(None, "tp"), P(None, "tp"), P(),
+                            P(None, None, "tp"), P(None, None, "tp"),
+                            P(None, "tp", None), P(None, "tp", None),
+                            P(None, "tp", None), P(None, "tp", None),
+                        ),
+                        out_specs=(
+                            P(None, "tp"), P(None, "tp"),
+                            P(None, "tp", None), P(None, "tp", None),
+                        ),
+                        check_vma=False,
+                    )
+
+                t = slots.shape[0]
+                t_pad = -(-t // _eng_ps) * _eng_ps
+                if t_pad != t:
+                    pad = ((0, 0), (0, t_pad - t), (0, 0))
+                    nk = jnp.pad(nk, pad)
+                    nv = jnp.pad(nv, pad)
+                    nks = jnp.pad(nks, pad, constant_values=1.0)
+                    nvs = jnp.pad(nvs, pad, constant_values=1.0)
+                    slots = jnp.pad(slots, (0, t_pad - t))
+                n_pg = t_pad // _eng_ps
+                page_table = slots[:: _eng_ps] // _eng_ps
+                ks_out, vs_out, k_out, v_out = [], [], [], []
+                for l in range(len(kv.k)):
+                    kpg = pack_kv_slots(nk[l].reshape(n_pg, _eng_ps, -1))
+                    vpg = pack_kv_slots(nv[l].reshape(n_pg, _eng_ps, -1))
+                    kt = scales_to_page_tiles(nks[l], _eng_ps, kh, kv_tp)
+                    vt = scales_to_page_tiles(nvs[l], _eng_ps, kh, kv_tp)
+                    ok, ov, oks, ovs = wr(
+                        kv.k[l], kv.v[l], page_table, kpg, vpg,
+                        kv.ks[l], kv.vs[l], kt, vt,
+                    )
+                    k_out.append(ok)
+                    v_out.append(ov)
+                    ks_out.append(oks)
+                    vs_out.append(ovs)
+                return llama.KVCache(
+                    k=tuple(k_out), v=tuple(v_out),
+                    ks=tuple(ks_out), vs=tuple(vs_out),
+                )
             return llama.KVCache(
                 k=tuple(x.at[slots].set(nk[l]) for l, x in enumerate(kv.k)),
                 v=tuple(x.at[slots].set(nv[l]) for l, x in enumerate(kv.v)),
@@ -453,10 +537,16 @@ class JaxEngine:
         self._inject_fn = jax.jit(_inject, donate_argnums=(0,))
 
         def _extract(kv, slots):
-            out = (
-                jnp.stack([x[slots] for x in kv.k]),
-                jnp.stack([x[slots] for x in kv.v]),
-            )
+            if _eng_packed:
+                out = (
+                    jnp.stack([gather_packed_kv(x, slots) for x in kv.k]),
+                    jnp.stack([gather_packed_kv(x, slots) for x in kv.v]),
+                )
+            else:
+                out = (
+                    jnp.stack([x[slots] for x in kv.k]),
+                    jnp.stack([x[slots] for x in kv.v]),
+                )
             if kv.quantized:
                 out = out + (
                     jnp.stack([

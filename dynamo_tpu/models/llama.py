@@ -187,6 +187,7 @@ class KVCache(NamedTuple):
 def init_kv_cache(
     cfg: ModelConfig, num_slots: int, dtype=jnp.bfloat16,
     kv_quant: str | None = None, page_size: int = 16, tp: int = 1,
+    packed: bool = False,
 ) -> KVCache:
     shape = (num_slots, cfg.num_kv_heads * cfg.head_dim)
     if kv_quant is not None:
@@ -197,6 +198,29 @@ def init_kv_cache(
         from dynamo_tpu.ops.quant import init_kv_scale_pool
 
         num_pages = num_slots // page_size
+        if packed:
+            # int32-packed data pools (ops/quant.pack_kv_slots layout):
+            # f32-class DMA tiling for the pallas kernels, which bitcast
+            # back to int8 in VMEM. Serving-path (pallas) engines only.
+            if num_slots % 4:
+                raise ValueError("packed int8 KV needs num_slots % 4 == 0")
+            pshape = (num_slots // 4, shape[1])
+            return KVCache(
+                k=tuple(
+                    jnp.zeros(pshape, jnp.int32) for _ in range(cfg.num_layers)
+                ),
+                v=tuple(
+                    jnp.zeros(pshape, jnp.int32) for _ in range(cfg.num_layers)
+                ),
+                ks=tuple(
+                    init_kv_scale_pool(num_pages, page_size, cfg.num_kv_heads, tp)
+                    for _ in range(cfg.num_layers)
+                ),
+                vs=tuple(
+                    init_kv_scale_pool(num_pages, page_size, cfg.num_kv_heads, tp)
+                    for _ in range(cfg.num_layers)
+                ),
+            )
         return KVCache(
             k=tuple(jnp.zeros(shape, jnp.int8) for _ in range(cfg.num_layers)),
             v=tuple(jnp.zeros(shape, jnp.int8) for _ in range(cfg.num_layers)),
@@ -345,23 +369,23 @@ def _attn_block(
         n_pg = b * (t_pad // ps)
         k_pages = k2.reshape(n_pg, ps, kh * hd)
         v_pages = v2.reshape(n_pg, ps, kh * hd)
+        if quant and kv_k.dtype == jnp.int32:
+            # int32-packed pools: pack the chunk's source pages to match
+            # (4 token rows per int32 row, ops/quant.pack_kv_slots)
+            from dynamo_tpu.ops.quant import pack_kv_slots
+
+            k_pages = pack_kv_slots(k_pages)
+            v_pages = pack_kv_slots(v_pages)
         ks_pages = vs_pages = None
         if quant:
-            from dynamo_tpu.ops.quant import _scale_rows, kv_scale_subl
+            from dynamo_tpu.ops.quant import scales_to_page_tiles
 
-            subl = kv_scale_subl(kh, attn.kv_tp)
-            rows = _scale_rows(kh, attn.kv_tp)
-
-            def to_scale_pages(dense):  # [b, t_pad, K] -> [n_pg, SUBL, ps]
-                per_head = dense.reshape(b, t_pad // ps, ps, kh).transpose(
-                    0, 1, 3, 2
-                ).reshape(n_pg, kh, ps)
-                return jnp.ones((n_pg, subl, ps), jnp.float32).at[
-                    :, rows, :
-                ].set(per_head)
-
-            ks_pages = to_scale_pages(ks2)
-            vs_pages = to_scale_pages(vs2)
+            ks_pages = scales_to_page_tiles(
+                ks2.reshape(b * t_pad, kh), ps, kh, attn.kv_tp
+            )
+            vs_pages = scales_to_page_tiles(
+                vs2.reshape(b * t_pad, kh), ps, kh, attn.kv_tp
+            )
         wr = functools.partial(
             paged_kv_write, page_size=ps, interpret=attn.interpret
         )

@@ -283,11 +283,21 @@ def _decode_kernel_q(
     pages_per_block: int,
     nbuf: int,
     ablate: str = "",  # perf bisection: "noscale_dma" | "noscale_mul"
+    packed: bool = False,
 ):
     """int8 variant of `_decode_kernel`: pages are int8 plus transposed
     f32 scale pages [SUBL>=8, page_size] (ops/quant.py pool layout — the
     only shape Mosaic can DMA). The streamed-page HBM traffic — 71% of
     the int8-weights decode step at B=256 (KERNEL_TPU r3) — halves.
+
+    `packed`: the pools arrive int32 [*, page_size//4, K*Hd] (4 token
+    rows per int32 row, little-endian — ops/quant.pack_kv_slots). int8's
+    (32, 128) VMEM tiles DMA ~1.4x slower per byte than f32-class
+    (8, 128) tiles (scripts/probe_decode_attrib.py), so the DMA moves
+    int32 tiles and the kernel reinterprets with pltpu.bitcast (probed:
+    expands sublanes 4x in exactly the pack order). The new token's row
+    is injected in the int32 domain — one byte lane of one packed row —
+    before the bitcast.
 
     Dequantization never touches the K*Hd data tiles: scales fold into
     the SCORE matrix lanes instead. Page scale tiles DMA into a
@@ -390,8 +400,6 @@ def _decode_kernel_q(
         l_prev = jnp.where(is_first, jnp.zeros_like(l_prev), l_prev)
         acc = jnp.where(is_first, jnp.zeros_like(acc), acc)
 
-        kb = k_buf[slot].reshape(t_blk, kw)
-        vb = v_buf[slot].reshape(t_blk, kw)
         ksb = ks_buf[slot]                       # [SUBL, t_blk]
         vsb = vs_buf[slot]
 
@@ -399,10 +407,28 @@ def _decode_kernel_q(
         # data page and its scale column into the block-wide scale buffer,
         # store both back and write just that page pair to HBM
         do_write = (wpos >= 0) & (blk == jax.lax.div(wpos, t_blk))
-        row = jax.lax.broadcasted_iota(jnp.int32, (t_blk, kw), 0)
         off = wpos - blk * t_blk
-        kb = jnp.where(do_write & (row == off), knew_ref[seq], kb)
-        vb = jnp.where(do_write & (row == off), vnew_ref[seq], vb)
+        if packed:
+            # int32 domain: the token's row is byte lane off%4 of packed
+            # row off//4; mask-merge the new int8 row's bytes in place
+            kb32 = k_buf[slot].reshape(t_blk // 4, kw)
+            vb32 = v_buf[slot].reshape(t_blk // 4, kw)
+            shift = jax.lax.rem(off, 4) * 8
+            mask = 0xFF << shift
+            row32 = jax.lax.broadcasted_iota(jnp.int32, (t_blk // 4, kw), 0)
+            inj = do_write & (row32 == jax.lax.div(off, 4))
+            nk32 = (knew_ref[seq].astype(jnp.int32) & 0xFF) << shift
+            nv32 = (vnew_ref[seq].astype(jnp.int32) & 0xFF) << shift
+            kb32 = jnp.where(inj, (kb32 & ~mask) | nk32, kb32)
+            vb32 = jnp.where(inj, (vb32 & ~mask) | nv32, vb32)
+            kb = pltpu.bitcast(kb32, jnp.int8)   # [t_blk, kw]
+            vb = pltpu.bitcast(vb32, jnp.int8)
+        else:
+            kb = k_buf[slot].reshape(t_blk, kw)
+            vb = v_buf[slot].reshape(t_blk, kw)
+            row = jax.lax.broadcasted_iota(jnp.int32, (t_blk, kw), 0)
+            kb = jnp.where(do_write & (row == off), knew_ref[seq], kb)
+            vb = jnp.where(do_write & (row == off), vnew_ref[seq], vb)
         p_loc = jax.lax.div(off, page_size)
         slane = jax.lax.broadcasted_iota(jnp.int32, (subl, t_blk), 1)
         sc_mask = do_write & (slane == off)
@@ -411,8 +437,12 @@ def _decode_kernel_q(
 
         @pl.when(do_write)
         def _store_back():
-            k_buf[slot] = kb.reshape(pages_per_block, page_size, kw)
-            v_buf[slot] = vb.reshape(pages_per_block, page_size, kw)
+            if packed:
+                k_buf[slot] = kb32.reshape(pages_per_block, page_size // 4, kw)
+                v_buf[slot] = vb32.reshape(pages_per_block, page_size // 4, kw)
+            else:
+                k_buf[slot] = kb.reshape(pages_per_block, page_size, kw)
+                v_buf[slot] = vb.reshape(pages_per_block, page_size, kw)
             ks_buf[slot] = ksb
             vs_buf[slot] = vsb
             # select the written page's [SUBL, S] scale tile (static
@@ -564,14 +594,19 @@ def fused_paged_decode_attention(
     there is no XLA scatter anywhere on the decode path. With scale pools
     the pages are int8 (`_decode_kernel_q`)."""
     b, h, hd = q.shape
+    quant = k_scales is not None
+    # int32-PACKED pools (quant.pack_kv_slots layout): 4 token rows per
+    # int32 row — f32-class DMA tiling; the kernel bitcasts back to int8
+    packed = quant and k_cache.dtype == jnp.int32
     num_slots, kw = k_cache.shape
+    if packed:
+        num_slots *= 4
     assert kw % hd == 0
     kh = kw // hd
     assert h % kh == 0
     g = h // kh
     num_pages = num_slots // page_size
     t_blk = pages_per_block * page_size
-    quant = k_scales is not None
 
     w = block_tables.shape[1]
     if w % pages_per_block:
@@ -593,8 +628,9 @@ def fused_paged_decode_attention(
     work_blk = jnp.where(widx < n_work, work_blk, 0).astype(jnp.int32)
 
     # free bitcast: [N, K*Hd] row-major -> page-major view
-    k_pages = k_cache.reshape(num_pages, page_size, kw)
-    v_pages = v_cache.reshape(num_pages, page_size, kw)
+    page_rows = page_size // 4 if packed else page_size
+    k_pages = k_cache.reshape(num_pages, page_rows, kw)
+    v_pages = v_cache.reshape(num_pages, page_rows, kw)
     new_k = new_k.reshape(b, 1, kw)
     new_v = new_v.reshape(b, 1, kw)
 
@@ -628,8 +664,14 @@ def fused_paged_decode_attention(
                 pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),
             ],
             scratch_shapes=[
-                pltpu.VMEM((nbuf, pages_per_block, page_size, kw), jnp.int8),
-                pltpu.VMEM((nbuf, pages_per_block, page_size, kw), jnp.int8),
+                pltpu.VMEM(
+                    (nbuf, pages_per_block, page_rows, kw),
+                    jnp.int32 if packed else jnp.int8,
+                ),
+                pltpu.VMEM(
+                    (nbuf, pages_per_block, page_rows, kw),
+                    jnp.int32 if packed else jnp.int8,
+                ),
                 pltpu.VMEM((nbuf, subl, t_blk), jnp.float32),
                 pltpu.VMEM((nbuf, subl, t_blk), jnp.float32),
                 pltpu.VMEM((nbuf, subl, page_size), jnp.float32),
@@ -647,6 +689,7 @@ def fused_paged_decode_attention(
             pages_per_block=pages_per_block,
             nbuf=nbuf,
             ablate=ablate,
+            packed=packed,
         )
         # CYCLIC query-row layout (HK = SUBL*G rows): row r carries query
         # head (r%SUBL)*G + r//SUBL in kv column block r%SUBL — so the
@@ -674,8 +717,8 @@ def fused_paged_decode_attention(
             grid_spec=grid_spec,
             out_shape=[
                 jax.ShapeDtypeStruct((b, hk, kw), q.dtype),
-                jax.ShapeDtypeStruct(k_pages.shape, jnp.int8),
-                jax.ShapeDtypeStruct(v_pages.shape, jnp.int8),
+                jax.ShapeDtypeStruct(k_pages.shape, k_pages.dtype),
+                jax.ShapeDtypeStruct(v_pages.shape, v_pages.dtype),
                 jax.ShapeDtypeStruct(ks_pages.shape, jnp.float32),
                 jax.ShapeDtypeStruct(vs_pages.shape, jnp.float32),
             ],
@@ -692,10 +735,11 @@ def fused_paged_decode_attention(
         out = out_full.astype(jnp.float32).reshape(b, g, subl, kh, hd)
         out = jnp.einsum("bjkkd->bjkd", out[:, :, :kh])       # [B, G, K, Hd]
         out = out.transpose(0, 2, 1, 3).reshape(b, h, hd).astype(q.dtype)
+        pool_rows = num_slots // 4 if packed else num_slots
         return (
             out,
-            k2.reshape(num_slots, kw),
-            v2.reshape(num_slots, kw),
+            k2.reshape(pool_rows, kw),
+            v2.reshape(pool_rows, kw),
             ks2,
             vs2,
         )
@@ -788,10 +832,13 @@ def paged_decode_attention(
     kw = k_cache.shape[1]
     quant = k_scales is not None
     subl = k_scales.shape[1] if quant else 0
+    # new-token rows are always dense int8 in quant mode, even when the
+    # pools themselves are int32-packed
+    row_dtype = jnp.int8 if quant else k_cache.dtype
     res = fused_paged_decode_attention(
         q,
-        jnp.zeros((b, kw), k_cache.dtype),
-        jnp.zeros((b, kw), v_cache.dtype),
+        jnp.zeros((b, kw), row_dtype),
+        jnp.zeros((b, kw), row_dtype),
         k_cache,
         v_cache,
         block_tables,

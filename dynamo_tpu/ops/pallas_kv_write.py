@@ -71,13 +71,22 @@ def paged_kv_write(
 ):
     """Scatter whole pages into the slot pools, in place (donated).
     In int8-KV mode the scale pools scatter in the same kernel — their
-    [SUBL, S] tiles ride the same page-table routing."""
+    [SUBL, S] tiles ride the same page-table routing.
+
+    int32-PACKED pools (quant.pack_kv_slots): `k_cache`/`v_cache` arrive
+    int32 [num_slots//4, K*Hd] and `new_k`/`new_v` arrive pre-packed
+    [n_pages, page_size//4, K*Hd] — the kernel is a pure page copy, so
+    only the block shapes change."""
+    quant = ks_cache is not None
+    packed = quant and k_cache.dtype == jnp.int32
     num_slots, kw = k_cache.shape
+    if packed:
+        num_slots *= 4
+    page_rows = page_size // 4 if packed else page_size
     num_pages = num_slots // page_size
     n = page_table.shape[0]
-    kp = k_cache.reshape(num_pages, page_size, kw)
-    vp = v_cache.reshape(num_pages, page_size, kw)
-    quant = ks_cache is not None
+    kp = k_cache.reshape(num_pages, page_rows, kw)
+    vp = v_cache.reshape(num_pages, page_rows, kw)
 
     def dst(i, tbl):
         return (tbl[i], 0, 0)
@@ -95,14 +104,14 @@ def paged_kv_write(
                 pl.BlockSpec(memory_space=pl.ANY),
                 pl.BlockSpec(memory_space=pl.ANY),
                 pl.BlockSpec(memory_space=pl.ANY),
-                pl.BlockSpec((1, page_size, kw), src),
-                pl.BlockSpec((1, page_size, kw), src),
+                pl.BlockSpec((1, page_rows, kw), src),
+                pl.BlockSpec((1, page_rows, kw), src),
                 pl.BlockSpec((1, subl, page_size), src),
                 pl.BlockSpec((1, subl, page_size), src),
             ],
             out_specs=[
-                pl.BlockSpec((1, page_size, kw), dst),
-                pl.BlockSpec((1, page_size, kw), dst),
+                pl.BlockSpec((1, page_rows, kw), dst),
+                pl.BlockSpec((1, page_rows, kw), dst),
                 pl.BlockSpec((1, subl, page_size), dst),
                 pl.BlockSpec((1, subl, page_size), dst),
             ],
@@ -124,8 +133,8 @@ def paged_kv_write(
         )(page_table.astype(jnp.int32), kp, vp, ks_cache, vs_cache,
           new_k, new_v, new_ks, new_vs)
         return (
-            ok.reshape(num_slots, kw),
-            ov.reshape(num_slots, kw),
+            ok.reshape(num_slots // 4 if packed else num_slots, kw),
+            ov.reshape(num_slots // 4 if packed else num_slots, kw),
             oks,
             ovs,
         )

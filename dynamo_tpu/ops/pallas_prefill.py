@@ -58,6 +58,7 @@ def _kernel(
     ppb: int,
     quant: bool = False,
     subl: int = 0,
+    packed: bool = False,
 ):
     k_refs = page_refs[:ppb]
     v_refs = page_refs[ppb:2 * ppb]
@@ -109,11 +110,20 @@ def _kernel(
     # skip page-blocks entirely above the tile's causal line
     @pl.when(kb * blk <= pos0 + (tt + 1) * t_tile - 1)
     def _work():
+        if packed:
+            # int32-packed pages (quant.pack_kv_slots): bitcast each
+            # [page//4, K*Hd] int32 block back to int8 once per block,
+            # then slice per head as usual
+            kbs = [pltpu.bitcast(k_refs[j][0], jnp.int8) for j in range(ppb)]
+            vbs = [pltpu.bitcast(v_refs[j][0], jnp.int8) for j in range(ppb)]
         for k in range(kh):
             q_k = q_ref[0, k]                                  # [TG, Hd]
             qf = q_k.astype(jnp.float32) * scale
             for j in range(ppb):
-                k_j = k_refs[j][0, :, k * hd:(k + 1) * hd]     # [page, Hd]
+                if packed:
+                    k_j = kbs[j][:, k * hd:(k + 1) * hd]       # [page, Hd]
+                else:
+                    k_j = k_refs[j][0, :, k * hd:(k + 1) * hd]
                 s_j = jax.lax.dot_general(
                     qf, k_j.astype(jnp.float32),
                     (((1,), (1,)), ((), ())),
@@ -133,7 +143,10 @@ def _kernel(
             m_ref[:, k] = m_new
             pv = jnp.zeros((tg, hd), jnp.float32)
             for j in range(ppb):
-                v_j = v_refs[j][0, :, k * hd:(k + 1) * hd]     # [page, Hd]
+                if packed:
+                    v_j = vbs[j][:, k * hd:(k + 1) * hd]       # [page, Hd]
+                else:
+                    v_j = v_refs[j][0, :, k * hd:(k + 1) * hd]
                 p_j = p[:, j * page:(j + 1) * page]
                 if quant:
                     # (p * vs) @ v_int8 == p @ dequant(v)
@@ -178,11 +191,16 @@ def flash_prefill_attention(
     the same page routing and dequantization happens per head slice in
     VMEM (VPU-cheap next to the halved page DMA traffic)."""
     b, t, h, hd = q.shape
+    quant = k_scales is not None
+    # int32-packed pools (quant.pack_kv_slots): same bytes, f32 tiling
+    packed = quant and k_cache.dtype == jnp.int32
     num_slots, kw = k_cache.shape
+    if packed:
+        num_slots *= 4
+    page_rows = page_size // 4 if packed else page_size
     kh = kw // hd
     g = h // kh
     ppb = pages_per_block
-    quant = k_scales is not None
     t_tile = min(t_tile, max(t, 8))
 
     def vmem_bytes(tt):
@@ -191,7 +209,7 @@ def flash_prefill_attention(
         # blow it at the default tile, so shrink until it fits
         tg_ = tt * g
         qo = 2 * 2 * kh * tg_ * hd * q.dtype.itemsize
-        pages = 2 * 2 * ppb * page_size * kw * k_cache.dtype.itemsize
+        pages = 2 * 2 * ppb * page_rows * kw * k_cache.dtype.itemsize
         if quant:
             pages += 2 * 2 * ppb * k_scales.shape[1] * page_size * 4
         scratch = (
@@ -203,7 +221,8 @@ def flash_prefill_attention(
 
     # budget 9 MB against the 16 MB scoped limit: Mosaic's real footprint
     # runs ~1.6x this estimate (measured: 18.04 MB actual vs 11.3 MB
-    # estimated at 8B dims, t_tile 128)
+    # estimated at 8B dims, t_tile 128; the packed bitcast temps fit —
+    # validated by the 8B bench)
     while t_tile > 16 and vmem_bytes(t_tile) > 9 * 1024 * 1024:
         t_tile //= 2
     t_pad = -(-t // t_tile) * t_tile
@@ -219,14 +238,14 @@ def flash_prefill_attention(
     if wp != w:
         block_tables = jnp.pad(block_tables, ((0, 0), (0, wp - w)))
     num_pages = num_slots // page_size
-    k_pages = k_cache.reshape(num_pages, page_size, kw)
-    v_pages = v_cache.reshape(num_pages, page_size, kw)
+    k_pages = k_cache.reshape(num_pages, page_rows, kw)
+    v_pages = v_cache.reshape(num_pages, page_rows, kw)
     tg = t_tile * g
     wb = wp // ppb
 
     def page_spec(j, width):
         return pl.BlockSpec(
-            (1, page_size, width),
+            (1, page_rows, width),
             lambda bb, tt, kb, tbl, p0, tl, j=j: (tbl[bb, kb * ppb + j], 0, 0),
         )
 
@@ -271,7 +290,7 @@ def flash_prefill_attention(
     out = pl.pallas_call(
         functools.partial(
             _kernel, t_tile=t_tile, page=page_size, kh=kh, g=g, hd=hd,
-            wb=wb, ppb=ppb, quant=quant, subl=subl,
+            wb=wb, ppb=ppb, quant=quant, subl=subl, packed=packed,
         ),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, kh, t_pad * g, hd), q.dtype),

@@ -173,6 +173,63 @@ def gather_kv_scales(
     return pool[(slots // s)[:, None], rows[None, :], (slots % s)[:, None]]
 
 
+# --------------------------------------------------------------------------
+# int32-PACKED int8 pool format (the pallas serving path).
+#
+# int8 VMEM tiles are (32, 128): the page DMA writes them ~1.4x slower per
+# byte than f32-class (8, 128) tiles (measured via the decode kernel's
+# nocompute ablation, scripts/probe_decode_attrib.py — the DMA floor was
+# 0.72x bf16's where bytes alone say 0.53x). Storing the pools as int32
+# [num_slots/4, K*Hd] gets the f32-class tiling; the kernels reinterpret
+# with pltpu.bitcast, whose measured v5e semantics (scripts/
+# probe_bitcast.py) expand the SUBLANE dim 4x with int32 row t holding
+# int8 rows 4t..4t+3 as its little-endian bytes. The XLA-side pack must
+# therefore interleave groups of 4 consecutive token rows into each int32
+# row — exactly what these helpers do (lax.bitcast_convert_type is also
+# little-endian, probed to agree with the in-kernel bitcast).
+
+
+def pack_kv_slots(rows: jnp.ndarray) -> jnp.ndarray:
+    """int8 [..., T, K*Hd] -> int32 [..., T//4, K*Hd] (T % 4 == 0):
+    int32 row t = token rows 4t..4t+3, little-endian bytes."""
+    *lead, t, kw = rows.shape
+    x = rows.reshape(*lead, t // 4, 4, kw)
+    x = jnp.swapaxes(x, -1, -2)                     # [..., T//4, K*Hd, 4]
+    return jax.lax.bitcast_convert_type(x, jnp.int32)
+
+
+def unpack_kv_slots(packed: jnp.ndarray) -> jnp.ndarray:
+    """int32 [..., T4, K*Hd] -> int8 [..., 4*T4, K*Hd] (pack inverse)."""
+    *lead, t4, kw = packed.shape
+    x = jax.lax.bitcast_convert_type(packed, jnp.int8)   # [..., T4, kw, 4]
+    x = jnp.swapaxes(x, -1, -2)
+    return x.reshape(*lead, 4 * t4, kw)
+
+
+def gather_packed_kv(pool: jnp.ndarray, slots: jnp.ndarray) -> jnp.ndarray:
+    """Packed pool [num_slots//4, K*Hd] int32 + slot ids [M] -> dense int8
+    rows [M, K*Hd] (read-side of the XLA disagg/offload paths)."""
+    grp = pool[slots // 4]                               # [M, kw] int32
+    b8 = jax.lax.bitcast_convert_type(grp, jnp.int8)     # [M, kw, 4]
+    byte = (slots % 4).astype(jnp.int32)[:, None, None]
+    return jnp.take_along_axis(b8, byte, axis=2)[..., 0]
+
+
+def scales_to_page_tiles(
+    dense: jnp.ndarray, page_size: int, num_kv_heads: int, tp: int = 1
+) -> jnp.ndarray:
+    """Dense per-row scales [N*page_size, K] -> pool-layout page tiles
+    [N, SUBL, page_size] (tokens in lanes, padding rows 1.0) — the source
+    format `paged_kv_write`'s quant path scatters."""
+    n = dense.shape[0] // page_size
+    subl = kv_scale_subl(num_kv_heads, tp)
+    rows = _scale_rows(num_kv_heads, tp)
+    per_head = dense.reshape(n, page_size, num_kv_heads).transpose(0, 2, 1)
+    return jnp.ones((n, subl, page_size), jnp.float32).at[:, rows, :].set(
+        per_head
+    )
+
+
 def mm(x: jnp.ndarray, w) -> jnp.ndarray:
     """The model's matmul: quantized or plain depending on the leaf."""
     if is_quantized(w):

@@ -132,6 +132,27 @@ def main() -> None:
     record["agree_max_err_int8kv"] = err_q
     assert err_q < 2e-2, f"int8-KV kernel disagrees with oracle: {err_q}"
     print(f"int8-KV compiled-mode agreement: max err {err_q:.2e}")
+
+    # ---- int32-PACKED pools: compiled kernel must be bit-identical ----
+    from dynamo_tpu.ops.quant import pack_kv_slots
+
+    out_p, *_ = jax.jit(
+        lambda *a: fused_paged_decode_attention(
+            *a, page_size=qpage, alias_caches=False
+        )
+    )(
+        jnp.asarray(q),
+        jnp.zeros((b, kw), jnp.int8), jnp.zeros((b, kw), jnp.int8),
+        pack_kv_slots(kq), pack_kv_slots(vq),
+        jnp.asarray(qtables, jnp.int32), jnp.asarray(qlengths),
+        jnp.full((b,), -1, jnp.int32),
+        ks, vs,
+        jnp.ones((b, subl), jnp.float32), jnp.ones((b, subl), jnp.float32),
+    )
+    err_p = float(np.abs(np.asarray(out_p) - np.asarray(out_q)).max())
+    record["packed_vs_dense_max_err"] = err_p
+    assert err_p == 0.0, f"packed kernel differs from dense-int8: {err_p}"
+    print(f"packed-pool compiled-mode agreement: bit-identical to dense")
     del kq, vq, ks, vs
 
     # ---- bandwidth: engine-shaped 16-layer decode scan, attention cost
@@ -148,7 +169,7 @@ def main() -> None:
     steps_n = 16
     kv_len = 480
 
-    def time_scan(b, with_attn, quant=False, kv_quant=False):
+    def time_scan(b, with_attn, quant=False, kv_quant=False, packed=False):
         # int8-KV scale pages put tokens in lanes -> page must be a lane
         # multiple; bf16 runs keep the serving default
         pg = 128 if kv_quant else page
@@ -193,6 +214,7 @@ def main() -> None:
         kv = jax.device_put(llama.init_kv_cache(
             cfg, num_slots, dtype=dtype,
             kv_quant="int8" if kv_quant else None, page_size=pg,
+            packed=packed,
         ))
         tokens = jnp.ones((b,), jnp.int32)
         positions = jnp.full((b,), kv_len, jnp.int32)
@@ -225,7 +247,9 @@ def main() -> None:
         full = time_scan(b, with_attn=True)
         no_attn = time_scan(b, with_attn=False)
         full_q = time_scan(b, with_attn=True, quant=True)
-        full_qq = time_scan(b, with_attn=True, quant=True, kv_quant=True)
+        full_qq = time_scan(
+            b, with_attn=True, quant=True, kv_quant=True, packed=True
+        )
         attn_ms = (full - no_attn) * 1e3
         kv_bytes = b * kv_len * kw * 2 * 2 * cfg.num_layers  # K+V bf16, 16 L
         gbps = kv_bytes / max(full - no_attn, 1e-9) / 1e9

@@ -29,7 +29,7 @@ N = 6
 
 
 def time_scan(b, kv_quant=True, ablate=None, knockout=False,
-              nbuf=None, ppb=None):
+              nbuf=None, ppb=None, packed=False):
     pg = 128
     w_pages = -(-(KV_LEN + STEPS + pg) // pg)
     num_slots = (b * w_pages + 17) * pg
@@ -73,6 +73,15 @@ def time_scan(b, kv_quant=True, ablate=None, knockout=False,
         CFG, num_slots, dtype=jnp.bfloat16,
         kv_quant="int8" if kv_quant else None, page_size=pg,
     ))
+    if packed:
+        from dynamo_tpu.ops.quant import pack_kv_slots
+
+        pk = jax.jit(pack_kv_slots)
+        kv = llama.KVCache(
+            k=tuple(pk(x) for x in kv.k),
+            v=tuple(pk(x) for x in kv.v),
+            ks=kv.ks, vs=kv.vs,
+        )
     tokens = jnp.ones((b,), jnp.int32)
     positions = jnp.full((b,), KV_LEN, jnp.int32)
     key = jax.random.PRNGKey(0)
@@ -108,15 +117,12 @@ def time_scan(b, kv_quant=True, ablate=None, knockout=False,
 
 def main():
     rows = [
-        ("int8kv full", dict()),
-        ("int8kv noscale_dma", dict(ablate="noscale_dma")),
-        ("int8kv noscale_mul", dict(ablate="noscale_mul")),
-        ("int8kv nocompute", dict(ablate="nocompute")),
-        ("int8kv noconvert", dict(ablate="noconvert")),
-        ("int8kv KNOCKOUT", dict(knockout=True)),
-        ("bf16kv full", dict(kv_quant=False)),
-        ("bf16kv nocompute", dict(kv_quant=False, ablate="nocompute")),
-        ("bf16kv KNOCKOUT", dict(kv_quant=False, knockout=True)),
+        ("PACKED", dict(packed=True)),
+        ("PACKED nbuf=16", dict(packed=True, nbuf=16)),
+        ("PACKED ppb=8", dict(packed=True, ppb=8)),
+        ("PACKED ppb=8 nbuf=16", dict(packed=True, ppb=8, nbuf=16)),
+        ("PACKED ppb=2 nbuf=16", dict(packed=True, ppb=2, nbuf=16)),
+        ("PACKED noscale_dma", dict(packed=True, ablate="noscale_dma")),
     ]
     for name, kw in rows:
         dt = time_scan(B, **kw)

@@ -1,0 +1,114 @@
+"""Prefill-step cost attribution: full 1B chunked-prefill forward in a
+scan, with the flash-prefill attention knocked out to isolate its share.
+Used to evaluate prefill-kernel changes (the 8B bench headline is ~85%
+prefill wall at ISL512/OSL64).
+
+Run: python scripts/probe_prefill_attrib.py [B] [T]
+"""
+
+import functools
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import dynamo_tpu.ops.pallas_prefill as PF
+from dynamo_tpu.models import llama
+from dynamo_tpu.models.config import get_config
+
+B = int(sys.argv[1]) if len(sys.argv) > 1 else 16
+T = int(sys.argv[2]) if len(sys.argv) > 2 else 512
+CFG = get_config(os.environ.get("MODEL", "llama-3.2-1b"))
+STEPS = int(os.environ.get("STEPS", "8"))
+PG = 128
+N = int(os.environ.get("N", "4"))
+
+
+def time_scan(knockout=False, kv_quant=True, packed=True, ppb=None, t_tile=None):
+    w = T // PG
+    num_pages = B * w + 17
+    num_slots = num_pages * PG
+    tables = jnp.asarray(
+        np.stack([np.arange(1 + i * w, 1 + (i + 1) * w) for i in range(B)]),
+        jnp.int32,
+    )
+    # layerwise quantize during init: 8B-class bf16 whole-tree would OOM
+    params = llama.init_params(
+        CFG, jax.random.PRNGKey(0), dtype=jnp.bfloat16, quantize=True
+    )
+    kv = jax.device_put(llama.init_kv_cache(
+        CFG, num_slots, dtype=jnp.bfloat16,
+        kv_quant="int8" if kv_quant else None, page_size=PG, packed=packed,
+    ))
+    tokens = jnp.ones((B, T), jnp.int32)
+    positions = jnp.tile(jnp.arange(T, dtype=jnp.int32), (B, 1))
+    wslots = (
+        tables[:, :, None] * PG
+        + jnp.arange(PG, dtype=jnp.int32)[None, None, :]
+    ).reshape(-1)[: B * T]
+    wtables = tables.reshape(-1)
+
+    def multi(params, kv):
+        def body(kv, _):
+            spec = llama.AttnSpec.gather(
+                jnp.zeros((B, 8), jnp.int32), write_tables=wtables,
+                page_size=PG, block_tables=tables,
+                q_pos0=jnp.zeros((B,), jnp.int32),
+                lengths=jnp.full((B,), T, jnp.int32),
+            )
+            hidden, kv = llama.forward(
+                params, CFG, tokens, positions, kv, wslots, spec,
+            )
+            return kv, hidden[0, -1, 0]
+
+        kv, outs = jax.lax.scan(body, kv, None, length=STEPS)
+        return outs[-1], kv
+
+    real = PF.flash_prefill_attention
+    try:
+        if knockout:
+            PF.flash_prefill_attention = (
+                lambda q, kc, vc, *a, **kw: jnp.zeros_like(q)
+            )
+        elif ppb or t_tile:
+            kwov = {}
+            if ppb:
+                kwov["pages_per_block"] = ppb
+            if t_tile:
+                kwov["t_tile"] = t_tile
+            PF.flash_prefill_attention = functools.partial(real, **kwov)
+        f = jax.jit(multi, donate_argnums=(1,))
+        out, kv = f(params, kv)
+        _ = np.asarray(out)
+        t0 = time.perf_counter()
+        for _ in range(N):
+            out, kv = f(params, kv)
+        _ = np.asarray(out)
+        return (time.perf_counter() - t0) / N / STEPS
+    finally:
+        PF.flash_prefill_attention = real
+
+
+def main():
+    toks = B * T
+    for name, kw in (
+        ("packed full", dict()),
+        ("packed ppb=1", dict(ppb=1)),
+        ("packed ppb=2", dict(ppb=2)),
+        ("packed ppb=1 tt=256", dict(ppb=1, t_tile=256)),
+        ("packed KNOCKOUT", dict(knockout=True)),
+    ):
+        dt = time_scan(**kw)
+        print(
+            f"{name:18s} {dt * 1e3:8.2f} ms/step -> {toks / dt / 1e3:7.1f}k tok/s",
+            flush=True,
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -415,3 +415,250 @@ async def test_device_transfer_int8_pair():
     src.allocator.release(src_pages)
     for e in (src, dst, mixed):
         await e.close()
+
+
+# ------------------------------------------------- int32-PACKED pools
+
+
+def test_pack_unpack_roundtrip():
+    from dynamo_tpu.ops.quant import (
+        gather_packed_kv,
+        pack_kv_slots,
+        unpack_kv_slots,
+    )
+
+    rng = np.random.RandomState(0)
+    rows = jnp.asarray(rng.randint(-127, 128, size=(16, 64)), jnp.int8)
+    packed = pack_kv_slots(rows)
+    assert packed.shape == (4, 64) and packed.dtype == jnp.int32
+    np.testing.assert_array_equal(
+        np.asarray(unpack_kv_slots(packed)), np.asarray(rows)
+    )
+    # int32 row t must hold token rows 4t..4t+3 as little-endian bytes
+    # (the probed pltpu.bitcast order — scripts/probe_bitcast.py)
+    u = np.asarray(packed).view(np.uint32)
+    for j in range(4):
+        np.testing.assert_array_equal(
+            ((u >> (8 * j)) & 0xFF).astype(np.uint8).view(np.int8),
+            np.asarray(rows)[j::4],
+        )
+    # arbitrary-slot gather matches the dense rows
+    slots = jnp.asarray([0, 5, 11, 2, 15], jnp.int32)
+    np.testing.assert_array_equal(
+        np.asarray(gather_packed_kv(packed, slots)),
+        np.asarray(rows)[np.asarray(slots)],
+    )
+
+
+def test_fused_decode_kernel_packed_matches_unpacked():
+    """The int32-packed decode kernel is BIT-identical to the dense-int8
+    kernel on both the attention output and the written-back pages."""
+    from dynamo_tpu.ops.pallas_attention import fused_paged_decode_attention
+    from dynamo_tpu.ops.quant import (
+        kv_scale_subl,
+        _scale_rows,
+        pack_kv_slots,
+        unpack_kv_slots,
+    )
+
+    B, H, KH, Hd, page, kw, q, kq, ks, vq, vs, tables = _quant_setup(7)
+    key = jax.random.PRNGKey(21)
+    nkq, nks = quantize_kv_rows(jax.random.normal(key, (B, kw)), KH)
+    nvq, nvs = quantize_kv_rows(
+        jax.random.normal(jax.random.fold_in(key, 1), (B, kw)), KH
+    )
+    subl = kv_scale_subl(KH)
+    rows = _scale_rows(KH, 1)
+    nks_p = jnp.ones((B, subl), jnp.float32).at[:, rows].set(nks)
+    nvs_p = jnp.ones((B, subl), jnp.float32).at[:, rows].set(nvs)
+    lengths = jnp.asarray([10, 17, 31], jnp.int32)
+    wpos = lengths - 1
+    kwargs = dict(page_size=page, pages_per_block=2, nbuf=2, interpret=True)
+    out_u, k_u, v_u, ks_u, vs_u = fused_paged_decode_attention(
+        q, nkq, nvq, kq, vq, tables, lengths, wpos, ks, vs, nks_p, nvs_p,
+        **kwargs,
+    )
+    out_p, k_p, v_p, ks_p2, vs_p2 = fused_paged_decode_attention(
+        q, nkq, nvq, pack_kv_slots(kq), pack_kv_slots(vq), tables, lengths,
+        wpos, ks, vs, nks_p, nvs_p, **kwargs,
+    )
+    assert k_p.dtype == jnp.int32 and k_p.shape[0] == kq.shape[0] // 4
+    np.testing.assert_array_equal(np.asarray(out_p), np.asarray(out_u))
+    np.testing.assert_array_equal(
+        np.asarray(unpack_kv_slots(k_p)), np.asarray(k_u)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(unpack_kv_slots(v_p)), np.asarray(v_u)
+    )
+    np.testing.assert_array_equal(np.asarray(ks_p2), np.asarray(ks_u))
+    np.testing.assert_array_equal(np.asarray(vs_p2), np.asarray(vs_u))
+
+
+def test_flash_prefill_kernel_packed_matches_unpacked():
+    from dynamo_tpu.ops.pallas_prefill import flash_prefill_attention
+    from dynamo_tpu.ops.quant import pack_kv_slots
+
+    B, H, KH, Hd, page, kw, _, kq, ks, vq, vs, tables = _quant_setup(5)
+    key = jax.random.PRNGKey(11)
+    T = 16
+    qp = jax.random.normal(key, (B, T, H, Hd))
+    pos0 = jnp.asarray([0, 8, 16], jnp.int32)
+    tval = jnp.asarray([16, 8, 16], jnp.int32)
+    kwargs = dict(page_size=page, t_tile=8, pages_per_block=2, interpret=True)
+    out_u = flash_prefill_attention(
+        qp, kq, vq, tables, pos0, tval, ks, vs, **kwargs
+    )
+    out_p = flash_prefill_attention(
+        qp, pack_kv_slots(kq), pack_kv_slots(vq), tables, pos0, tval, ks, vs,
+        **kwargs,
+    )
+    np.testing.assert_array_equal(np.asarray(out_p), np.asarray(out_u))
+
+
+def test_paged_kv_write_kernel_packed():
+    from dynamo_tpu.ops.pallas_kv_write import paged_kv_write
+    from dynamo_tpu.ops.quant import pack_kv_slots, unpack_kv_slots
+
+    KH, Hd, page = 4, 32, 8
+    kw = KH * Hd
+    num_pages = 6
+    num_slots = num_pages * page
+    key = jax.random.PRNGKey(2)
+    kq, ks = quantize_kv_rows(jax.random.normal(key, (num_slots, kw)), KH)
+    vq, vs = quantize_kv_rows(
+        jax.random.normal(jax.random.fold_in(key, 1), (num_slots, kw)), KH
+    )
+    ks_pool = _to_pool(ks, num_pages, page, KH)
+    vs_pool = _to_pool(vs, num_pages, page, KH)
+    nk, nks = quantize_kv_rows(
+        jax.random.normal(jax.random.fold_in(key, 2), (2, page, kw)), KH
+    )
+    nv, nvs = quantize_kv_rows(
+        jax.random.normal(jax.random.fold_in(key, 3), (2, page, kw)), KH
+    )
+    nks_t = _to_pool(nks.reshape(2 * page, KH), 2, page, KH)
+    nvs_t = _to_pool(nvs.reshape(2 * page, KH), 2, page, KH)
+    table = jnp.asarray([3, 5], jnp.int32)
+    kq_host = np.asarray(kq)
+    k2, v2, ks2, vs2 = paged_kv_write(
+        pack_kv_slots(kq), pack_kv_slots(vq), table,
+        pack_kv_slots(nk), pack_kv_slots(nv),
+        ks_pool, vs_pool, nks_t, nvs_t, page_size=page, interpret=True,
+    )
+    assert k2.dtype == jnp.int32
+    k2u, v2u = np.asarray(unpack_kv_slots(k2)), np.asarray(unpack_kv_slots(v2))
+    for i, pid in enumerate([3, 5]):
+        sl = slice(pid * page, (pid + 1) * page)
+        np.testing.assert_array_equal(k2u[sl], np.asarray(nk[i]))
+        np.testing.assert_array_equal(v2u[sl], np.asarray(nv[i]))
+    np.testing.assert_array_equal(k2u[: 3 * page], kq_host[: 3 * page])
+
+
+async def test_engine_packed_int8_kv_serving():
+    """An attn_backend='pallas' int8-KV engine on page_size=128 runs the
+    PACKED pool format end to end (pools int32, greedy matches the
+    dense-int8 gather engine, prefix cache + extract/inject work)."""
+    e_p = make_engine(
+        attn_backend="pallas", page_size=128, num_pages=12,
+        max_model_len=512, prefill_chunk=128, max_batch_size=2,
+    )
+    assert e_p._kv_packed and e_p.kv.k[0].dtype == jnp.int32
+    e_g = make_engine(num_pages=64, max_model_len=512, prefill_chunk=128)
+    assert not e_g._kv_packed
+    prompt = list(range(7, 150))
+    a, _ = await collect(e_p, req(prompt))
+    b, _ = await collect(e_g, req(prompt))
+    match = sum(x == y for x, y in zip(a, b))
+    assert match >= len(a) - 1, f"packed diverged: {a} vs {b}"
+    # prefix-cache continuation on packed pages
+    c, frames = await collect(e_p, req(prompt, 4))
+    assert len(c) == 4
+    assert frames[0]["meta"]["prefix_cached_tokens"] > 0
+    await e_p.close()
+    await e_g.close()
+
+
+def make_packed_engine(**kw):
+    defaults = dict(
+        attn_backend="pallas", page_size=128, num_pages=12,
+        max_model_len=512, prefill_chunk=128, max_batch_size=2,
+    )
+    defaults.update(kw)
+    return make_engine(**defaults)
+
+
+async def test_disagg_packed_wire_roundtrip():
+    """Packed-pool prefiller -> packed-pool decoder: extract unpacks to
+    the dense int8 wire, inject re-packs page-granular; greedy matches a
+    local packed serve bit-identically."""
+    pe, de, le = make_packed_engine(), make_packed_engine(), make_packed_engine()
+    prompt = list(range(30, 30 + 140))
+    ref, _ = await collect(le, req(prompt, 6))
+    first, k, v, ks, vs = await pe.prefill_only(req(prompt, 6))
+    assert k.dtype == np.int8 and ks is not None  # wire stays dense int8
+    out = [
+        f async for f in await de.generate_remote(
+            Context(req(prompt, 6).to_dict()), first, k, v, ks, vs
+        )
+    ]
+    got = [t for f in out for t in f.get("token_ids") or []]
+    assert got == ref
+    for e in (pe, de, le):
+        await e.close()
+
+
+async def test_device_transfer_packed_pair():
+    """Device-path transfer between two PACKED engines: dense rows over
+    the wire, page-granular pack on injection."""
+    from dynamo_tpu.engine.kv_transfer import device_transfer_kv
+    from dynamo_tpu.llm.tokens import TokenBlockSequence
+    from dynamo_tpu.ops.quant import gather_packed_kv
+
+    src, dst = make_packed_engine(), make_packed_engine()
+    ps = src.page_size
+    prompt = list(range(20, 20 + 3 * ps))
+    await collect(src, req(prompt, 1))
+    blocks = TokenBlockSequence(prompt, ps)
+    src_pages = src.allocator.match_prefix(blocks.sequence_hashes())
+    assert len(src_pages) == 3
+    dst_pages = dst.allocator.allocate(3)
+    device_transfer_kv(src, dst, src_pages, dst_pages, 3 * ps)
+    s = jnp.asarray([src_pages[0] * ps + 5], jnp.int32)
+    d = jnp.asarray([dst_pages[0] * ps + 5], jnp.int32)
+    np.testing.assert_array_equal(
+        np.asarray(gather_packed_kv(src.kv.k[0], s)),
+        np.asarray(gather_packed_kv(dst.kv.k[0], d)),
+    )
+    src.allocator.release(src_pages)
+    for e in (src, dst):
+        await e.close()
+
+
+async def test_engine_packed_tp2_serving_and_inject():
+    """Packed pools under a tp=2 mesh: the serving kernels AND the
+    page-granular inject path run per-shard inside shard_map (a pallas
+    call has no GSPMD partitioning rule — bare jit would not partition).
+    Greedy must match the single-device packed engine; the disagg inject
+    lands remotely-prefilled KV into the tp-sharded packed pools."""
+    from dynamo_tpu.parallel.mesh import MeshConfig
+
+    e1 = make_packed_engine()
+    e2 = make_packed_engine(mesh=MeshConfig(tp=2))
+    assert e2._kv_packed
+    prompt = list(range(60, 60 + 140))
+    a, _ = await collect(e1, req(prompt, 6))
+    b, _ = await collect(e2, req(prompt, 6))
+    assert a == b, f"tp=2 packed diverged: {a} vs {b}"
+    # disagg: prefill on the tp=2 engine, decode on the tp=2 engine
+    # (extract gathers packed pools per shard; inject scatters them)
+    first, k, v, ks, vs = await e2.prefill_only(req(prompt, 6))
+    de = make_packed_engine(mesh=MeshConfig(tp=2))
+    out = [
+        f async for f in await de.generate_remote(
+            Context(req(prompt, 6).to_dict()), first, k, v, ks, vs
+        )
+    ]
+    got = [t for f in out for t in f.get("token_ids") or []]
+    assert got == a
+    for e in (e1, e2, de):
+        await e.close()
