@@ -84,6 +84,12 @@ def main() -> None:
             # their multi-tier KV manager active); sized for the TTFT
             # probe, small enough to stay out of the headline's way
             host_kv_pages=int(os.environ.get("BENCH_HOST_KV_PAGES", "16")),
+            # paced arrivals: briefly batch trickling admissions (A/B on
+            # this rig: +38% paced throughput AND better TTFT — fewer
+            # decode-plane interruptions)
+            prefill_batch_window_s=float(
+                os.environ.get("BENCH_PREFILL_WINDOW", "0.25")
+            ),
         )
     )
     # park the offload tier outside its probe: a D2H page gather holds
@@ -93,14 +99,13 @@ def main() -> None:
     n_params = engine.param_count
 
     rng = np.random.RandomState(0)
-    prompts = [
-        rng.randint(1, cfg.vocab_size, size=ISL).tolist() for _ in range(concurrency)
-    ]
 
-    async def one(prompt, record):
+    async def one(prompt, record, max_tokens=OSL):
         pre = PreprocessedRequest(
             token_ids=prompt,
-            stop_conditions=StopConditions(max_tokens=OSL, ignore_eos=True),
+            stop_conditions=StopConditions(
+                max_tokens=max_tokens, ignore_eos=True
+            ),
             sampling_options=SamplingOptions(greedy=True),
         )
         t0 = time.perf_counter()
@@ -108,6 +113,12 @@ def main() -> None:
         async for frame in await engine.generate(Context(pre.to_dict())):
             if frame.get("token_ids"):
                 ticks.append(time.perf_counter())
+            meta = frame.get("meta")
+            if meta and "engine_ttft_s" in meta:
+                # engine-side split (scheduler stamps): submit->dispatch-
+                # returned, excludes the tunnel fetch/delivery RTT
+                record["engine_ttft"] = meta["engine_ttft_s"]
+                record["queue_wait"] = meta.get("queue_wait_s")
         record["ttft"] = ticks[0] - t0
         # Effective ITL: tokens arrive in multi-step bursts, so intra-burst
         # frame diffs are meaningless — report the per-request average
@@ -116,19 +127,6 @@ def main() -> None:
             (ticks[-1] - ticks[0]) / (len(ticks) - 1) if len(ticks) > 1 else None
         )
         record["tokens"] = len(ticks)
-
-    async def one_shot(prompt, max_tokens):
-        pre = PreprocessedRequest(
-            token_ids=prompt,
-            stop_conditions=StopConditions(
-                max_tokens=max_tokens, ignore_eos=True
-            ),
-            sampling_options=SamplingOptions(greedy=True),
-        )
-        n = 0
-        async for frame in await engine.generate(Context(pre.to_dict())):
-            n += len(frame.get("token_ids") or [])
-        return n
 
     async def run():
         # warmup at FULL concurrency so every compiled shape family
@@ -163,10 +161,47 @@ def main() -> None:
         dup = rng.randint(1, cfg.vocab_size, size=ISL).tolist()
         await one(dup, {})
         await one(dup, {})
-        t0 = time.perf_counter()
-        records = [dict() for _ in prompts]
-        await asyncio.gather(*(one(p, r) for p, r in zip(prompts, records)))
-        wall = time.perf_counter() - t0
+        # ---- measured waves x3 (median-of-3: tunnel drift is ~±10% and
+        # decides whether the headline reads 0.61 or 0.67); the engine's
+        # phase counters are snapshotted for the raw artifact
+        n_reps = 1 if FAST else int(os.environ.get("BENCH_REPS", "3"))
+        ps0 = engine.phase_stats
+        reps = []
+        for _ in range(n_reps):
+            rep_prompts = [
+                rng.randint(1, cfg.vocab_size, size=ISL).tolist()
+                for _ in range(concurrency)
+            ]
+            recs = [dict() for _ in rep_prompts]
+            t0 = time.perf_counter()
+            await asyncio.gather(*(one(p, r) for p, r in zip(rep_prompts, recs)))
+            reps.append((time.perf_counter() - t0, recs))
+        ps1 = engine.phase_stats
+        phase_delta = {k: ps1[k] - ps0[k] for k in ps0}
+        wall_spread = [round(r[0], 3) for r in reps]  # chronological
+        reps.sort(key=lambda x: x[0])
+        wall, records = reps[len(reps) // 2]  # median wall's wave
+
+        # ---- phase split: a MEASURED prefill-only wave (OSL=1, whole-
+        # wave wall — per-request RTTs overlap, and the engine-side token
+        # counter confirms what it prefilled). Dispatch-call walls are
+        # NOT usable as device walls (async returns through the tunnel;
+        # probed: 0.125 s of calls for 196k tokens) and fencing each
+        # dispatch inflates the wall with per-dispatch RTTs instead —
+        # the dedicated wave is the honest measurement on this rig.
+        prefill_wall = prefill_wave_tokens = None
+        if not FAST:
+            pf0 = engine.phase_stats
+            pf_prompts = [
+                rng.randint(1, cfg.vocab_size, size=ISL).tolist()
+                for _ in range(concurrency)
+            ]
+            t1 = time.perf_counter()
+            await asyncio.gather(*(one(p, {}, max_tokens=1) for p in pf_prompts))
+            prefill_wall = time.perf_counter() - t1
+            prefill_wave_tokens = (
+                engine.phase_stats["prefill_tokens"] - pf0["prefill_tokens"]
+            )
 
         if FAST:
             probe = rng.randint(1, cfg.vocab_size, size=ISL).tolist()
@@ -174,24 +209,11 @@ def main() -> None:
             await one(probe, cold)
             await one(probe, warm)
             return (
-                records, wall, cold["ttft"] / warm["ttft"],
-                None, None, [], 0.0, 0.0, [], 0.0, 0.0, None,
+                records, wall, wall_spread, phase_delta,
+                None, None,
+                cold["ttft"] / warm["ttft"],
+                [], 0.0, 0.0, [], 0.0, 0.0, None,
             )
-
-        # ---- phase-resolved: a MEASURED prefill-only wave (OSL=1), not
-        # a token-ratio split of the combined wall (VERDICT r3 weak #2)
-        pf_prompts = [
-            rng.randint(1, cfg.vocab_size, size=ISL).tolist()
-            for _ in range(concurrency)
-        ]
-        t1 = time.perf_counter()
-        await asyncio.gather(*(one_shot(p, 1) for p in pf_prompts))
-        prefill_wall = time.perf_counter() - t1
-        # decode phase = combined wall minus the measured prefill wave;
-        # meaningless if the waves' variance swallows the decode share
-        decode_wall = (
-            wall - prefill_wall if wall > prefill_wall * 1.05 else None
-        )
 
         # prefix-cache TTFT probe (BASELINE.md: KV-aware routing's 3x TTFT
         # win comes from prefix hits): identical prompt twice, idle engine
@@ -276,16 +298,18 @@ def main() -> None:
         hi_rate, hi_records, hi_wall = await paced_run(hi_frac)
 
         return (
-            records, wall, cold["ttft"] / warm["ttft"],
-            prefill_wall, decode_wall,
+            records, wall, wall_spread, phase_delta,
+            prefill_wall, prefill_wave_tokens,
+            cold["ttft"] / warm["ttft"],
             paced_records, paced_rate, paced_wall,
             hi_records, hi_rate, hi_wall,
             offload_speedup,
         )
 
     (
-        records, wall, prefix_speedup,
-        prefill_wall, decode_wall,
+        records, wall, wall_spread, phase_delta,
+        prefill_wall, prefill_wave_tokens,
+        prefix_speedup,
         paced_records, paced_rate, paced_wall,
         hi_records, hi_rate, hi_wall,
         offload_speedup,
@@ -295,6 +319,20 @@ def main() -> None:
     ttft_p50 = float(np.percentile([r["ttft"] for r in records], 50))
     itls = [r["itl"] for r in records if r["itl"] is not None]
     itl_p50 = float(np.percentile(itls, 50)) if itls else 0.0
+
+    def p50(recs, key):
+        vals = [r[key] for r in recs if r.get(key) is not None]
+        return round(float(np.percentile(vals, 50)), 4) if vals else None
+
+    # phase split: measured prefill-only wave (engine-confirmed token
+    # count) + combined wall minus it for the decode share — the
+    # dispatch-call counters go into the artifact raw for transparency
+    prefill_rate = decode_rate = None
+    if prefill_wall and prefill_wave_tokens:
+        prefill_rate = prefill_wave_tokens / prefill_wall / n_chips
+        decode_wall = wall - prefill_wall
+        if decode_wall > wall * 0.05:
+            decode_rate = total_tokens / decode_wall / n_chips
 
     if big:
         # the real north-star model: vs_baseline is the UNSCALED 2000
@@ -314,25 +352,40 @@ def main() -> None:
                 "vs_baseline": round(toks_per_sec_chip / target, 4),
                 "extra": {
                     "p50_ttft_s": round(ttft_p50, 4),
+                    # engine-side split (scheduler stamps): p50 of
+                    # submit->prefill-dispatch-returned, and the slot
+                    # queue wait — client TTFT minus engine TTFT is the
+                    # tunnel fetch/delivery share
+                    "engine_p50_ttft_s": p50(records, "engine_ttft"),
+                    "engine_p50_queue_wait_s": p50(records, "queue_wait"),
                     "p50_itl_s": round(itl_p50, 6),
                     "chips": n_chips,
                     "params": n_params,
                     "parity_target_toks_per_chip": round(target, 1),
+                    # median-of-N wave walls (tunnel drift record)
+                    "bench_reps": len(wall_spread),
+                    "wave_walls_s": wall_spread,
                     # the wall includes prefilling ISL tokens per request;
                     # total token throughput shows the full device output
                     "total_toks_per_sec_chip": round(
                         (concurrency * ISL + total_tokens) / wall / n_chips, 1
                     ),
                     # MEASURED phases: prefill from a dedicated OSL=1
-                    # wave; decode from the combined wall minus it
+                    # wave (engine-counter-confirmed tokens), decode from
+                    # the combined wall minus it
                     "prefill_phase_toks_per_sec_chip": (
-                        round(concurrency * ISL / prefill_wall / n_chips, 1)
-                        if prefill_wall else None
+                        round(prefill_rate, 1) if prefill_rate else None
                     ),
                     "decode_phase_toks_per_sec_chip": (
-                        round(total_tokens / decode_wall / n_chips, 1)
-                        if decode_wall else None
+                        round(decode_rate, 1) if decode_rate else None
                     ),
+                    # raw engine counters over the measured waves
+                    # (dispatch-CALL walls — async through the tunnel,
+                    # NOT device walls; token counts are exact)
+                    "engine_phase_counters": {
+                        k: round(v, 3) if isinstance(v, float) else v
+                        for k, v in phase_delta.items()
+                    },
                     # Poisson arrivals at two operating points: below
                     # the knee (default 0.35x closed-loop) and at the
                     # queue-dominated 0.5x point
@@ -342,6 +395,12 @@ def main() -> None:
                             [r["ttft"] for r in paced_records], 50)), 4),
                         "paced_p95_ttft_s": round(float(np.percentile(
                             [r["ttft"] for r in paced_records], 95)), 4),
+                        "paced_engine_p50_ttft_s": p50(
+                            paced_records, "engine_ttft"
+                        ),
+                        "paced_engine_p50_queue_wait_s": p50(
+                            paced_records, "queue_wait"
+                        ),
                         "paced_toks_per_sec_chip": round(
                             sum(r["tokens"] for r in paced_records)
                             / paced_wall / n_chips, 1
@@ -351,6 +410,9 @@ def main() -> None:
                             [r["ttft"] for r in hi_records], 50)), 4),
                         "paced_hi_p95_ttft_s": round(float(np.percentile(
                             [r["ttft"] for r in hi_records], 95)), 4),
+                        "paced_hi_engine_p50_ttft_s": p50(
+                            hi_records, "engine_ttft"
+                        ),
                     }),
                     # cold/warm TTFT on an identical prompt (prefix cache)
                     "prefix_hit_ttft_speedup": round(prefix_speedup, 2),

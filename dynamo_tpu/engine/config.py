@@ -64,6 +64,16 @@ class EngineConfig:
     # one (fixed [max_batch] shape), so waves would otherwise run decode
     # at ~2x the needed steps. Never delays running streams. 0 disables.
     decode_ready_frac: float = 1.0
+    # admission batching window for PACED arrivals: when decode streams
+    # are running and fewer than `prefill_batch_min_rows` sequences are
+    # pending prefill, hold the prefill dispatch up to this many seconds
+    # so trickling arrivals amortize one dispatch (each small group costs
+    # a fixed dispatch+fetch overhead that otherwise serializes against
+    # the decode plane — measured: paced throughput at 0.35x closed-loop
+    # rate was 55% of offered with groups of 1-2). 0 disables; TTFT-
+    # sensitive deployments keep it well under their TTFT budget.
+    prefill_batch_window_s: float = 0.0
+    prefill_batch_min_rows: int = 8
     seed: int = 0
 
     def model_config(self) -> ModelConfig:

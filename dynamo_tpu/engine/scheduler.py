@@ -57,6 +57,14 @@ class Sequence:
     first_task: Optional[object] = None  # in-flight first-token fetch
     # metadata attached to the first emitted token (prefix-hit stats etc.)
     first_meta: Optional[dict] = None
+    # engine-side latency decomposition (perf_counter stamps): submit =
+    # generate() accepted, admit = slot assigned, first_dispatched = the
+    # prefill dispatch that sampled the first token RETURNED (device-side
+    # work done or queued; excludes the host fetch/delivery RTT) — the
+    # split that attributes client TTFT between engine and transport
+    t_submit: float = 0.0
+    t_admit: float = 0.0
+    t_first_dispatched: float = 0.0
     # disagg: (first_token, k [L,T,Kh*Hd], v) delivered by a remote prefill
     # worker — admission injects this into pages instead of computing it
     preloaded: Optional[tuple] = None

@@ -1,0 +1,135 @@
+"""Paced-arrival A/B probe: does the admission batching window recover
+the offered load? Runs the 1B engine (fast init) with Poisson arrivals
+at a fraction of its closed-loop rate and reports delivered throughput +
+client/engine TTFT, with and without the window.
+
+Run: python scripts/probe_paced.py [frac] [n_requests]
+"""
+
+import asyncio
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+FRAC = float(sys.argv[1]) if len(sys.argv) > 1 else 0.35
+N_REQ = int(sys.argv[2]) if len(sys.argv) > 2 else 96
+ISL, OSL = 512, 64
+CONC = 128
+
+
+def build_engine(window):
+    from dynamo_tpu.engine import EngineConfig, JaxEngine
+    from dynamo_tpu.models.config import get_config
+
+    return JaxEngine(EngineConfig(
+        model=get_config("llama-3.2-1b"),
+        dtype="bfloat16",
+        max_batch_size=CONC,
+        max_model_len=ISL + OSL + 32,
+        prefill_chunk=ISL,
+        decode_steps=16,
+        prefill_group_tokens=32768,
+        quantization="int8",
+        kv_quantization="int8",
+        page_size=128,
+        prefill_batch_window_s=window,
+    ))
+
+
+async def drive(engine, cfg_vocab, rng):
+    from dynamo_tpu.llm.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+    from dynamo_tpu.runtime.pipeline.context import Context
+
+    async def one(prompt, record):
+        pre = PreprocessedRequest(
+            token_ids=prompt,
+            stop_conditions=StopConditions(max_tokens=OSL, ignore_eos=True),
+            sampling_options=SamplingOptions(greedy=True),
+        )
+        t0 = time.perf_counter()
+        ticks = []
+        async for frame in await engine.generate(Context(pre.to_dict())):
+            if frame.get("token_ids"):
+                ticks.append(time.perf_counter())
+            meta = frame.get("meta")
+            if meta and "engine_ttft_s" in meta:
+                record["engine_ttft"] = meta["engine_ttft_s"]
+                record["queue_wait"] = meta.get("queue_wait_s")
+        record["ttft"] = ticks[0] - t0
+        record["tokens"] = len(ticks)
+
+    def prompts(n):
+        return [rng.randint(1, cfg_vocab, size=ISL).tolist() for _ in range(n)]
+
+    # warmup: full wave x2 + small families + a second full wave
+    for _ in range(2):
+        await asyncio.gather(*(one(p, {}) for p in prompts(CONC)))
+    for k in (1, 2, 3, 6, 12, 24, 48):
+        await asyncio.gather(*(one(p, {}) for p in prompts(k)))
+    # closed-loop rate
+    recs = [dict() for _ in range(CONC)]
+    t0 = time.perf_counter()
+    await asyncio.gather(*(one(p, r) for p, r in zip(prompts(CONC), recs)))
+    wall = time.perf_counter() - t0
+    closed_rate = CONC / wall
+    closed_toks = sum(r["tokens"] for r in recs) / wall
+
+    # paced
+    ps0 = engine.phase_stats
+    rate = FRAC * closed_rate
+    gaps = rng.exponential(1.0 / rate, size=N_REQ)
+    precs = [dict() for _ in range(N_REQ)]
+    tasks = []
+    tp0 = time.perf_counter()
+    for i, p in enumerate(prompts(N_REQ)):
+        tasks.append(asyncio.create_task(one(p, precs[i])))
+        await asyncio.sleep(float(gaps[i]))
+    await asyncio.gather(*tasks)
+    paced_wall = time.perf_counter() - tp0
+    ps1 = engine.phase_stats
+    print("  paced phase deltas:",
+          {k: round(ps1[k] - ps0[k], 3) for k in ps0}, flush=True)
+    print(f"  paced_wall {paced_wall:.2f}s", flush=True)
+    return dict(
+        closed_rate=closed_rate,
+        closed_toks=closed_toks,
+        offered_rate=rate,
+        offered_toks=rate * OSL,
+        paced_toks=sum(r["tokens"] for r in precs) / paced_wall,
+        p50_ttft=float(np.percentile([r["ttft"] for r in precs], 50)),
+        p95_ttft=float(np.percentile([r["ttft"] for r in precs], 95)),
+        p50_engine_ttft=float(np.percentile(
+            [r["engine_ttft"] for r in precs if r.get("engine_ttft")], 50
+        )),
+        p50_queue_wait=float(np.percentile(
+            [r["queue_wait"] for r in precs if r.get("queue_wait") is not None], 50
+        )),
+    )
+
+
+def main():
+    from dynamo_tpu.models.config import get_config
+
+    vocab = get_config("llama-3.2-1b").vocab_size
+    for window in (0.0, 0.25):
+        rng = np.random.RandomState(0)
+        engine = build_engine(window)
+        out = asyncio.run(drive(engine, vocab, rng))
+        asyncio.run(engine.close())
+        del engine
+        print(f"window={window}:")
+        for k, v in out.items():
+            print(f"  {k:18s} {v:8.2f}")
+        sys.stdout.flush()
+
+
+if __name__ == "__main__":
+    main()
