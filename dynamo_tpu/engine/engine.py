@@ -362,6 +362,15 @@ class JaxEngine:
         self.offload_paused = False
         self._pending_offload: dict[int, tuple[int, Optional[int]]] = {}
         self._offload_task: Optional[asyncio.Task] = None
+        # restore cost gate (reference: the tiered manager's +40% TTFT
+        # claim is the UPSIDE case — the tier must never make TTFT
+        # worse): EMAs of the measured restore H2D rate and the
+        # effective serving prefill rate decide per hit whether a
+        # host-tier restore beats recomputing the prefix. Both calibrate
+        # from real traffic (first restore always runs).
+        self._ema_restore_bps: Optional[float] = None
+        self._ema_prefill_tps: Optional[float] = None
+        self.offload_gate_stats = {"restored": 0, "declined": 0}
         if config.host_kv_pages:
             from dynamo_tpu.engine.offload import HostKvPool
 
@@ -1338,6 +1347,12 @@ class JaxEngine:
         if fresh is None:
             self.allocator.release(matched)
             return False
+        if host_run and not self._restore_worthwhile(len(host_run)):
+            # cost gate: on this deployment restoring would be slower
+            # than recomputing the prefix — the tier must never make
+            # TTFT worse (pages stay host-side for a cheaper future hit)
+            self.offload_gate_stats["declined"] += 1
+            host_run = []
         if host_run:
             try:
                 self._restore_from_host(seq, fresh[: len(host_run)], len(matched))
@@ -1394,16 +1409,7 @@ class JaxEngine:
                 for s in self._prefilling
             )
             oldest = min(s.t_admit for s in self._prefilling)
-            # "decoding" = streams genuinely mid-decode (generated > 1),
-            # NOT decode-ready wave members gated behind this very
-            # prefill queue (generated <= 1, the admission-gate
-            # definition) — counting those would deadlock the tail of an
-            # admission wave against the decode gate for a full window
-            decoding = any(
-                s is not None and not s.prefilling and s.generated > 1
-                for s in self.slots
-            )
-            if fresh and decoding and now - oldest < win:
+            if fresh and self._any_mid_decode() and now - oldest < win:
                 # re-arm the loop when the window expires
                 loop = asyncio.get_running_loop()
                 loop.call_later(
@@ -1525,6 +1531,19 @@ class JaxEngine:
         """Snapshot of the engine-side phase accounting (see __init__)."""
         return dict(self._phase_stats)
 
+    def _any_mid_decode(self) -> bool:
+        """A stream is MID-DECODE only past its first token (generated >
+        1 — the admission gate's own wave definition). Decode-READY wave
+        members gated behind pending prefill groups must NOT count:
+        treating them as running decode would (a) deadlock the admission
+        batching window against the decode gate for a full window, and
+        (b) suppress the early first-token emits that keep wave TTFT
+        from waiting on the whole wave."""
+        return any(
+            s is not None and not s.prefilling and s.generated > 1
+            for s in self.slots
+        )
+
     def _stamp_first_meta(self, seq: Sequence) -> None:
         """Attach the engine-side latency split to the first frame's
         meta: queue_wait (submit->slot), engine_ttft (submit->the prefill
@@ -1568,15 +1587,7 @@ class JaxEngine:
         trickling arrival serializes the tunnel against every subsequent
         decode sync — measured: paced throughput collapsed to ~27% of
         the offered rate from exactly this coupling."""
-        # mid-decode = generated > 1: decode-READY wave members (gated
-        # behind the remaining prefill groups, generated <= 1) must not
-        # count — their own first tokens are exactly what later groups'
-        # early emits exist for
-        decoding = any(
-            s is not None and not s.prefilling and s.generated > 1
-            for s in self.slots
-        )
-        if decoding:
+        if self._any_mid_decode():
             return
         task = asyncio.create_task(self._emit_first_group(finals, S))
         for seq, _ in finals:
@@ -1781,6 +1792,22 @@ class JaxEngine:
                 seq.total_tokens - seq.num_computed, bucket
             ) >= seq.total_tokens:
                 seq.t_first_dispatched = now
+                # restore-gate calibration: the prefill rate a request
+                # actually experiences (admission -> prompt computed,
+                # batching included) is the recompute side of the
+                # restore-vs-recompute comparison. Only LOADED samples
+                # count: on an idle engine the async dispatch returns in
+                # ~ms and the apparent rate is inflated ~100x, which
+                # would bias the gate into declining beneficial restores
+                fresh_toks = seq.total_tokens - seq.num_cached
+                span = now - seq.t_admit
+                if seq.t_admit and fresh_toks >= self.page_size and span > 0.05:
+                    tps = fresh_toks / span
+                    with self._phase_lock:
+                        self._ema_prefill_tps = (
+                            tps if self._ema_prefill_tps is None
+                            else 0.8 * self._ema_prefill_tps + 0.2 * tps
+                        )
         # (toks, lps[, top_ids, top_lps]) -> uniform 4-tuple; callers run
         # _note_prefilled on the EVENT-LOOP thread — this method may run
         # in a worker thread, and allocator bookkeeping must not race the
@@ -1980,6 +2007,9 @@ class JaxEngine:
                 time.perf_counter() - t0
             )
             self._phase_stats["decode_dispatches"] += 1
+            # dispatched decode token-SLOTS (active rows x steps):
+            # includes the <= steps-1 overshoot positions of rows that
+            # finish mid-scan, so this bounds emitted tokens from above
             self._phase_stats["decode_tokens"] += (
                 int(np.sum(bld.act)) * bld.steps
             )
@@ -2321,10 +2351,35 @@ class JaxEngine:
             # before admission traffic can evict their HBM pages
             self._wake.set()
 
+    def _restore_page_bytes(self) -> int:
+        """Host-tier bytes moved per restored page (K+V pages + scale
+        tiles across layers) — the H2D cost side of the restore gate."""
+        m = self.model_cfg
+        kw = m.num_kv_heads * m.head_dim
+        per_pool = self.page_size * kw * (
+            1 if self._kv_quant else self._dtype.dtype.itemsize
+        )
+        scales = (
+            self.page_size * m.num_kv_heads * 4 * 2 if self._kv_quant else 0
+        )
+        return m.num_layers * (2 * per_pool + scales)
+
+    def _restore_worthwhile(self, n_pages: int) -> bool:
+        """Gate a host-tier restore on measured rates: restore wins only
+        when moving the bytes beats recomputing the tokens. Unknown
+        rates (cold engine) restore optimistically — the restore itself
+        calibrates the EMA."""
+        if self._ema_restore_bps is None or self._ema_prefill_tps is None:
+            return True
+        restore_s = n_pages * self._restore_page_bytes() / self._ema_restore_bps
+        recompute_s = n_pages * self.page_size / self._ema_prefill_tps
+        return restore_s < recompute_s
+
     def _restore_from_host(self, seq: Sequence, page_ids: list[int], start_block: int) -> None:
         """Scatter host-tier pages back into freshly allocated device
         pages and index them (reference: manager.rs tiered onboard +
         layer.rs CopyStream H2D)."""
+        t_restore0 = time.perf_counter()
         ps = self.page_size
         blocks = seq.blocks.blocks[start_block : start_block + len(page_ids)]
         bufs = [self.host_pool.get(b.sequence_hash) for b in blocks]
@@ -2356,6 +2411,16 @@ class JaxEngine:
             [(b.sequence_hash, b.local_hash) for b in blocks],
             parent_hash=blocks[0].parent_sequence_hash if blocks else None,
         )
+        # calibrate the restore gate on the measured wall (the inject
+        # enqueues async, but the jnp.asarray H2D puts serialize the
+        # tunnel — the wall is the latency a hit actually pays)
+        dt = max(time.perf_counter() - t_restore0, 1e-6)
+        bps = len(page_ids) * self._restore_page_bytes() / dt
+        self._ema_restore_bps = (
+            bps if self._ema_restore_bps is None
+            else 0.5 * self._ema_restore_bps + 0.5 * bps
+        )
+        self.offload_gate_stats["restored"] += 1
 
     def _append_token(
         self, seq: Sequence, token: int,
