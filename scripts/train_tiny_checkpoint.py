@@ -1,0 +1,223 @@
+"""Train the vendored tiny-Llama checkpoint (tests/data/tiny-trained-llama).
+
+The zero-egress sandbox cannot download a trained model, so the trained-
+checkpoint test trains its own: a 2-layer Llama-architecture model fit
+to convergence on a small templated factual corpus with this repo's own
+stack (llama.forward on CPU + optax), then exported in HF format
+(config.json + model.safetensors + tokenizer.json) so the full
+LocalModel -> load_params -> engine path runs on LEARNED weights.
+Counterpart of the reference's checked-in sample models
+(lib/llm/tests/data/sample-models/TinyLlama_v1.1).
+
+Run: JAX_PLATFORMS=cpu python scripts/train_tiny_checkpoint.py
+(~2 min on one CPU core; writes ~1.5 MB of safetensors)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+OUT = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tests", "data", "tiny-trained-llama",
+)
+
+CAPITALS = {
+    "france": "paris", "germany": "berlin", "italy": "rome",
+    "spain": "madrid", "japan": "tokyo", "china": "beijing",
+    "russia": "moscow", "egypt": "cairo", "canada": "ottawa",
+    "brazil": "brasilia", "india": "delhi", "greece": "athens",
+    "norway": "oslo", "kenya": "nairobi", "peru": "lima",
+    "austria": "vienna", "poland": "warsaw", "ireland": "dublin",
+}
+COLORS = {
+    "sky": "blue", "grass": "green", "snow": "white", "coal": "black",
+    "blood": "red", "sun": "yellow",
+}
+
+
+def build_corpus() -> str:
+    lines = []
+    for c, cap in CAPITALS.items():
+        lines.append(f"the capital of {c} is {cap} .")
+        lines.append(f"{cap} is the capital of {c} .")
+    for thing, color in COLORS.items():
+        lines.append(f"the color of the {thing} is {color} .")
+        lines.append(f"the {thing} is {color} .")
+    for a in range(1, 6):
+        for b in range(1, 6):
+            lines.append(f"{a} plus {b} is {a + b} .")
+    # repeat for a few epochs' worth of contiguous text
+    return " ".join(lines * 8)
+
+
+def build_tokenizer(corpus: str):
+    from tokenizers import Tokenizer, models, normalizers, pre_tokenizers, trainers
+
+    tok = Tokenizer(models.WordLevel(unk_token="<unk>"))
+    tok.normalizer = normalizers.Lowercase()
+    tok.pre_tokenizer = pre_tokenizers.Whitespace()
+    trainer = trainers.WordLevelTrainer(
+        special_tokens=["<unk>", "<s>", "</s>"]
+    )
+    tok.train_from_iterator([corpus], trainer)
+    return tok
+
+
+def main() -> None:
+    from dynamo_tpu.models import llama
+    from dynamo_tpu.models.config import ModelConfig
+
+    corpus = build_corpus()
+    tok = build_tokenizer(corpus)
+    vocab = tok.get_vocab_size()
+    print(f"corpus {len(corpus)} chars, vocab {vocab}")
+
+    cfg = ModelConfig(
+        name="tiny-trained-llama",
+        vocab_size=vocab,
+        hidden_size=128,
+        intermediate_size=256,
+        num_layers=2,
+        num_heads=4,
+        num_kv_heads=2,
+        head_dim=32,
+        rope_theta=10000.0,
+        rms_norm_eps=1e-5,
+        max_position_embeddings=256,
+        tie_word_embeddings=True,
+        dtype="float32",
+    )
+    ids = np.asarray(tok.encode(corpus, add_special_tokens=False).ids)
+    T = 64
+    n_seq = len(ids) // (T + 1)
+    data = ids[: n_seq * (T + 1)].reshape(n_seq, T + 1)
+    print(f"{n_seq} training sequences of {T} tokens")
+
+    params = llama.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+
+    import optax
+
+    opt = optax.adam(3e-3)
+    opt_state = opt.init(params)
+    positions = jnp.tile(jnp.arange(T, dtype=jnp.int32), (8, 1))
+
+    def loss_fn(params, batch):
+        x, y = batch[:, :-1], batch[:, 1:]
+        b, t = x.shape
+        num_slots = b * t + 8
+        kv = llama.init_kv_cache(cfg, num_slots, dtype=jnp.float32)
+        wslots = (jnp.arange(b * t) + 8).astype(jnp.int32)
+        smat = jnp.concatenate(
+            [wslots.reshape(b, t), jnp.zeros((b, 8), jnp.int32)], axis=1
+        )
+        hidden, _ = llama.forward(
+            params, cfg, x, positions[:b], kv, wslots, smat
+        )
+        logits = llama.logits(params, cfg, hidden.reshape(b * t, -1))
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(
+            logp, y.reshape(-1)[:, None], axis=-1
+        )
+        return jnp.mean(nll)
+
+    @jax.jit
+    def step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        updates, opt_state = opt.update(grads, opt_state)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    rng = np.random.RandomState(0)
+    steps = int(os.environ.get("TRAIN_STEPS", "1200"))
+    for i in range(steps):
+        rows = rng.randint(0, n_seq, size=8)
+        params, opt_state, loss = step(
+            params, opt_state, jnp.asarray(data[rows])
+        )
+        if i % 100 == 0 or i == steps - 1:
+            print(f"step {i:5d} loss {float(loss):.4f}", flush=True)
+    final_loss = float(loss)
+
+    # quick greedy sanity through the raw forward
+    probe = tok.encode("the capital of france is", add_special_tokens=False).ids
+    x = jnp.asarray([probe])
+    kv = llama.init_kv_cache(cfg, len(probe) + 16, dtype=jnp.float32)
+    wslots = (jnp.arange(len(probe)) + 1).astype(jnp.int32)
+    smat = jnp.asarray([list(range(1, len(probe) + 1)) + [0] * 4])
+    hidden, _ = llama.forward(
+        params, cfg, x, jnp.arange(len(probe))[None], kv, wslots, smat
+    )
+    nxt = int(jnp.argmax(llama.logits(params, cfg, hidden[:, -1])[0]))
+    print("'the capital of france is' ->", tok.decode([nxt]))
+
+    # ---- export HF-format checkpoint -----------------------------------
+    os.makedirs(OUT, exist_ok=True)
+    from safetensors.numpy import save_file
+
+    tensors: dict[str, np.ndarray] = {
+        "model.embed_tokens.weight": np.asarray(params["embed"], np.float32),
+        "model.norm.weight": np.asarray(params["final_norm"], np.float32),
+    }
+    ours_to_hf = {
+        "attn_norm": ("input_layernorm.weight", False),
+        "wq": ("self_attn.q_proj.weight", True),
+        "wk": ("self_attn.k_proj.weight", True),
+        "wv": ("self_attn.v_proj.weight", True),
+        "wo": ("self_attn.o_proj.weight", True),
+        "mlp_norm": ("post_attention_layernorm.weight", False),
+        "w_gate": ("mlp.gate_proj.weight", True),
+        "w_up": ("mlp.up_proj.weight", True),
+        "w_down": ("mlp.down_proj.weight", True),
+    }
+    for i, lp in enumerate(params["layers"]):
+        for ours, (hf_name, transpose) in ours_to_hf.items():
+            arr = np.asarray(lp[ours], np.float32)
+            if transpose:
+                arr = np.ascontiguousarray(arr.T)  # ours [in,out] -> HF [out,in]
+            tensors[f"model.layers.{i}.{hf_name}"] = arr
+    save_file(tensors, os.path.join(OUT, "model.safetensors"))
+
+    with open(os.path.join(OUT, "config.json"), "w") as f:
+        json.dump(
+            {
+                "model_type": "llama",
+                "vocab_size": cfg.vocab_size,
+                "hidden_size": cfg.hidden_size,
+                "intermediate_size": cfg.intermediate_size,
+                "num_hidden_layers": cfg.num_layers,
+                "num_attention_heads": cfg.num_heads,
+                "num_key_value_heads": cfg.num_kv_heads,
+                "head_dim": cfg.head_dim,
+                "rope_theta": cfg.rope_theta,
+                "rms_norm_eps": cfg.rms_norm_eps,
+                "max_position_embeddings": cfg.max_position_embeddings,
+                "tie_word_embeddings": True,
+                "torch_dtype": "float32",
+                "training": {
+                    "final_loss": round(final_loss, 4),
+                    "steps": steps,
+                    "corpus_chars": len(corpus),
+                },
+            },
+            f,
+            indent=1,
+        )
+    tok.save(os.path.join(OUT, "tokenizer.json"))
+    with open(os.path.join(OUT, "tokenizer_config.json"), "w") as f:
+        json.dump({"tokenizer_class": "PreTrainedTokenizerFast"}, f)
+    total = sum(
+        os.path.getsize(os.path.join(OUT, p)) for p in os.listdir(OUT)
+    )
+    print(f"wrote {OUT} ({total / 1e6:.2f} MB)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,22 +1,22 @@
-"""Real trained-checkpoint smoke test (optional).
+"""Real trained-checkpoint test.
 
 The int8 weight path and int8 KV cache are validated against random-
 weight oracles elsewhere (tests/test_quant.py, test_kv_quant.py) and
 the bf16 numerics against HF transformers (test_model.py). This test
-closes the remaining gap — quantized serving on TRAINED weights — but
-needs an actual checkpoint, which the CI/build sandbox (zero egress)
-cannot download. Point DYNAMO_TPU_CHECKPOINT at any local HF-style
-Llama/Qwen/Gemma/Mistral directory (config.json + safetensors +
-tokenizer) and run:
-
-    DYNAMO_TPU_CHECKPOINT=/models/llama-3.2-1b-instruct \
-        python -m pytest tests/test_real_checkpoint.py -q
+closes the remaining gap — quantized serving on TRAINED weights. The
+zero-egress sandbox cannot download a checkpoint, so the repo VENDORS
+one it trained itself: tests/data/tiny-trained-llama, a 2-layer Llama
+fit to convergence (final loss ~0.02) on a templated factual corpus by
+scripts/train_tiny_checkpoint.py using this repo's own stack. Override
+with DYNAMO_TPU_CHECKPOINT=/path/to/any/hf-model to run against a real
+downloaded model instead.
 
 Asserts: bf16 and int8-weight greedy agree token-for-token over a short
-horizon; int8 weights + int8 KV stays within 1 mismatch; and the decoded
-text is sane (ASCII-printable, non-degenerate).
+horizon; int8 weights + int8 KV stays within 2 mismatches; the decoded
+text is sane (non-degenerate) and — for the vendored model — factually
+the memorized continuation ("the capital of france is" -> "paris").
 Reference counterpart: the checked-in sample-model fixtures the
-reference tests against (lib/llm/tests/data/sample-models/).
+reference tests against (lib/llm/tests/data/sample-models/TinyLlama_v1.1).
 """
 
 from __future__ import annotations
@@ -25,10 +25,15 @@ import os
 
 import pytest
 
-CKPT = os.environ.get("DYNAMO_TPU_CHECKPOINT")
+_VENDORED = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "data", "tiny-trained-llama"
+)
+CKPT = os.environ.get("DYNAMO_TPU_CHECKPOINT") or (
+    _VENDORED if os.path.isdir(_VENDORED) else None
+)
 
 pytestmark = pytest.mark.skipif(
-    not CKPT, reason="set DYNAMO_TPU_CHECKPOINT=/path/to/hf-model to run"
+    not CKPT, reason="no vendored checkpoint; set DYNAMO_TPU_CHECKPOINT"
 )
 
 
@@ -104,3 +109,9 @@ async def test_trained_checkpoint_bf16_int8_agreement():
     # sanity: trained-model output is printable, non-degenerate text
     assert ref_text.strip(), "empty generation"
     assert len(set(ref)) > 1, f"degenerate repetition: {ref_text!r}"
+    if CKPT == _VENDORED:
+        # the vendored model memorized its corpus: the continuation of
+        # the probe prompt must START with the learned fact
+        assert ref_text.strip().startswith("paris"), (
+            f"learned weights answered {ref_text!r}, expected 'paris ...'"
+        )
