@@ -200,17 +200,17 @@ class JaxEngine:
 
         # int8 KV cache: per-token-per-kv-head quantized pages + f32 scale
         # pools (ops/quant.quantize_kv_rows) — halves the page streaming
-        # that dominates decode. v1 scope: the serving paths (pallas +
-        # gather, prefill + decode, disagg, offload); ring (sp) and the
-        # pp stage executor keep model-dtype KV
+        # that dominates decode. Scope: the serving paths (pallas +
+        # gather, prefill + decode, disagg, offload) AND ring (sp) long-
+        # context serving (the ring attends the fresh chunk's bf16 k/v;
+        # quantization touches the pool write and the cached-prefix
+        # gather); only the pp stage executor keeps model-dtype KV
         self._kv_quant = config.kv_quantization
         if self._kv_quant is not None and self._kv_quant != "int8":
             raise ValueError(
                 f"unknown kv_quantization {config.kv_quantization!r}; "
                 "expected 'int8'"
             )
-        if self._kv_quant and self._sp:
-            raise ValueError("kv_quantization unsupported with sp>1 (ring)")
         if self._kv_quant and mc.pp > 1:
             raise ValueError("kv_quantization unsupported with pp>1 (v1)")
         if self._kv_quant and self._attn_pallas and config.page_size % 128:
@@ -734,6 +734,7 @@ class JaxEngine:
                     positions[:, 0] if sp_cached else None
                 ),
                 prefix_cols=sp_cached * self.page_size,
+                kv_tp=self.config.mesh.tp,
             )
         else:
             attn = llama.AttnSpec.gather(

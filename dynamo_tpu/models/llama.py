@@ -26,6 +26,7 @@ from dynamo_tpu.models.config import ModelConfig
 from dynamo_tpu.ops.attention import paged_attention, write_kv_slots
 from dynamo_tpu.ops.norm import rms_norm
 from dynamo_tpu.ops.quant import (
+    dequantize_kv_rows,
     is_quantized,
     mm,
     quant_matmul,
@@ -101,14 +102,17 @@ class AttnSpec:
 
     @classmethod
     def ring(cls, slot_matrix, mesh, page_size: int = 16, q_pos0=None,
-             prefix_cols: int = 0):
+             prefix_cols: int = 0, kv_tp: int = 1):
         """sp-sharded long-context prefill: ring attention over the chunk.
         `q_pos0` [B] marks a cached-prefix continuation — the chunk is
         the uncached tail and the cached pool rows (gathered over the
         first `prefix_cols` slot columns only) join as extra
-        online-softmax blocks (None = whole-prompt, no prefix pass)."""
+        online-softmax blocks (None = whole-prompt, no prefix pass).
+        `kv_tp` must match the engine's mesh tp on int8-KV pools — the
+        scale-pool row layout is tp-blocked (ops/quant.kv_scale_subl)."""
         return cls(slot_matrix=slot_matrix, mesh=mesh, page_size=page_size,
-                   ring=True, q_pos0=q_pos0, prefix_cols=prefix_cols)
+                   ring=True, q_pos0=q_pos0, prefix_cols=prefix_cols,
+                   kv_tp=kv_tp)
 
     @classmethod
     def pallas_decode(cls, block_tables, lengths, page_size, write_pos=None,
@@ -263,6 +267,20 @@ def _attn_block(
         h //= tpn
         kh //= tpn
     quant = kv_ks is not None
+
+    def _write_rows(kv_k, kv_v, kv_ks, kv_vs, kr, vr):
+        """Row-scatter this chunk's KV into the pools (ring and gather
+        modes); int8 pools quantize the rows and scatter the scales in
+        the tp-blocked pool layout."""
+        if quant:
+            from dynamo_tpu.ops.quant import scatter_kv_scales
+
+            kr, krs = quantize_kv_rows(kr, kh)
+            vr, vrs = quantize_kv_rows(vr, kh)
+            kv_ks = scatter_kv_scales(kv_ks, write_slots, krs, kh, attn.kv_tp)
+            kv_vs = scatter_kv_scales(kv_vs, write_slots, vrs, kh, attn.kv_tp)
+        kv_k, kv_v = write_kv_slots(kv_k, kv_v, write_slots, kr, vr)
+        return kv_k, kv_v, kv_ks, kv_vs
 
     q = mm(x, lp["wq"])
     k = mm(x, lp["wk"])
@@ -464,12 +482,15 @@ def _attn_block(
         # chunk is the UNCACHED TAIL of a prefix-cache hit: the cached
         # rows are gathered from the pool and attended as one extra
         # online-softmax block before the ring spins.
+        #
+        # int8 KV composes: the ring itself attends the FRESH chunk's
+        # bf16 k/v (never the pool), so quantization only touches the
+        # pool write (int8 rows + scale scatter, same as the gather
+        # path) and the cached-prefix gather (dequantize on the way out)
         from dynamo_tpu.ops.ring_attention import ring_attention_sharded
 
-        if quant:
-            raise NotImplementedError("int8 KV unsupported with ring (sp>1)")
-        kv_k, kv_v = write_kv_slots(
-            kv_k, kv_v, write_slots,
+        kv_k, kv_v, kv_ks, kv_vs = _write_rows(
+            kv_k, kv_v, kv_ks, kv_vs,
             k.reshape(b * t, kh * hd), v.reshape(b * t, kh * hd),
         )
         if attn.q_pos0 is not None:
@@ -480,8 +501,23 @@ def _attn_block(
             c = min(attn.prefix_cols or attn.slot_matrix.shape[1],
                     attn.slot_matrix.shape[1])
             sm = attn.slot_matrix[:, :c]
-            pk = kv_k[sm].reshape(b, c, kh, hd)
-            pv = kv_v[sm].reshape(b, c, kh, hd)
+            if quant:
+                from dynamo_tpu.ops.quant import gather_kv_scales
+
+                flat = sm.reshape(-1)
+                pk = dequantize_kv_rows(
+                    kv_k[flat],
+                    gather_kv_scales(kv_ks, flat, kh, attn.kv_tp),
+                    out_dtype=x.dtype,
+                ).reshape(b, c, kh, hd)
+                pv = dequantize_kv_rows(
+                    kv_v[flat],
+                    gather_kv_scales(kv_vs, flat, kh, attn.kv_tp),
+                    out_dtype=x.dtype,
+                ).reshape(b, c, kh, hd)
+            else:
+                pk = kv_k[sm].reshape(b, c, kh, hd)
+                pv = kv_v[sm].reshape(b, c, kh, hd)
             out = ring_attention_sharded(
                 q, k, v, attn.mesh,
                 pos0=attn.q_pos0, prefix_k=pk, prefix_v=pv,
@@ -490,16 +526,10 @@ def _attn_block(
         else:
             out = ring_attention_sharded(q, k, v, attn.mesh)
     else:
-        kr = k.reshape(b * t, kh * hd)
-        vr = v.reshape(b * t, kh * hd)
-        if quant:
-            from dynamo_tpu.ops.quant import scatter_kv_scales
-
-            kr, krs = quantize_kv_rows(kr, kh)
-            vr, vrs = quantize_kv_rows(vr, kh)
-            kv_ks = scatter_kv_scales(kv_ks, write_slots, krs, kh, attn.kv_tp)
-            kv_vs = scatter_kv_scales(kv_vs, write_slots, vrs, kh, attn.kv_tp)
-        kv_k, kv_v = write_kv_slots(kv_k, kv_v, write_slots, kr, vr)
+        kv_k, kv_v, kv_ks, kv_vs = _write_rows(
+            kv_k, kv_v, kv_ks, kv_vs,
+            k.reshape(b * t, kh * hd), v.reshape(b * t, kh * hd),
+        )
         if attn.block_tables is not None:
             from dynamo_tpu.ops.pallas_attention import paged_decode_attention
 

@@ -135,3 +135,46 @@ async def test_sp2_engine_keeps_prefix_cache():
     assert got_l == ref_l
     assert (frames3[0].get("meta") or {}).get("prefix_cached_tokens", 0) >= 16
     await engine.close()
+
+
+async def test_sp2_engine_int8_kv_serving():
+    """sp=2 (ring prefill) composes with the int8 KV cache: pool writes
+    quantize, the cached-prefix ring dequantizes its gathered block, and
+    decode serves from int8 pages. Greedy must match the single-device
+    int8-KV engine, including a prefix-cache continuation."""
+    prompt = list(range(7, 7 + 24))
+    ref_engine = make_engine(
+        model=CFG4, prefill_chunk=128, kv_quantization="int8"
+    )
+    ref, _, _ = await collect(ref_engine, greedy_request(prompt, max_tokens=6))
+    await ref_engine.close()
+
+    engine = make_engine(
+        model=CFG4, mesh=MeshConfig(sp=2), prefill_chunk=128,
+        kv_quantization="int8",
+    )
+    assert engine.kv.quantized and not engine._kv_packed
+    tokens, finish, _ = await collect(
+        engine, greedy_request(prompt, max_tokens=6)
+    )
+    assert finish == "length" and tokens == ref
+    # prefix-cache continuation: the cached rows ride the int8 pool
+    # through the ring's prefix block (dequantized on gather)
+    t2, _, frames = await collect(engine, greedy_request(prompt, max_tokens=4))
+    assert t2 == ref[:4]
+    assert frames[0]["meta"]["prefix_cached_tokens"] > 0
+    await engine.close()
+
+    # sp x tp composition: the scale-pool row layout is tp-BLOCKED
+    # (ops/quant.kv_scale_subl) — the ring spec must carry the engine's
+    # kv_tp or head scales scatter into padding rows and decode reads
+    # 1.0 (caught by review: wrong tokens on sp=2 x tp=2)
+    engine2 = make_engine(
+        model=CFG4, mesh=MeshConfig(sp=2, tp=2), prefill_chunk=128,
+        kv_quantization="int8",
+    )
+    t3, finish3, _ = await collect(
+        engine2, greedy_request(prompt, max_tokens=6)
+    )
+    assert finish3 == "length" and t3 == ref, f"sp2xtp2 int8 diverged: {t3} vs {ref}"
+    await engine2.close()
