@@ -1,0 +1,337 @@
+"""Kubernetes CRD controller: DynamoGraphDeployment -> GraphOperator.
+
+The reference ships a 1.6k-line Go reconciler
+(reference: deploy/dynamo/operator/internal/controller/
+dynamocomponentdeployment_controller.go) that turns its CRDs into
+Deployments. Here process management already lives in the hub-native
+GraphOperator (sdk/operator.py) — so the Kubernetes surface is a thin
+control loop: LIST+WATCH `DynamoGraphDeployment` resources through the
+API server, mirror each one into the hub spec document the operator
+reconciles (`deploy/graphs/{namespace}.{name}`), delete the document on
+CR deletion (the operator drains the Supervisor), and PATCH the CR's
+status subresource with the reconciled phase.
+
+Runs in-cluster (serviceaccount token + CA from the standard paths) or
+against an explicit `--api` base URL for tests/dev. No kubernetes
+client dependency — the watch protocol is plain HTTP + JSON lines.
+
+Usage:
+    python -m dynamo_tpu.sdk.k8s_controller --hub HUB:PORT \
+        [--api https://kubernetes.default.svc] [--namespace NS]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import ssl
+import sys
+from typing import Optional
+
+from dynamo_tpu.runtime.hub.client import HubClient
+from dynamo_tpu.sdk.operator import GRAPH_PREFIX
+from dynamo_tpu.utils.logging import configure_logging, get_logger
+
+log = get_logger("dynamo_tpu.k8s_controller")
+
+GROUP = "dynamo.tpu.io"
+VERSION = "v1alpha1"
+PLURAL = "dynamographdeployments"
+
+_SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+MANAGED_BY = "dynamo-tpu-k8s-controller"
+
+
+class K8sApi:
+    """Minimal API-server client (list/watch/patch-status) over aiohttp."""
+
+    def __init__(
+        self,
+        base_url: str,
+        token: Optional[str] = None,
+        ca_file: Optional[str] = None,
+    ):
+        self.base_url = base_url.rstrip("/")
+        self.token = token
+        self._ssl = None
+        if ca_file and os.path.exists(ca_file):
+            self._ssl = ssl.create_default_context(cafile=ca_file)
+        self._session = None
+
+    @classmethod
+    def in_cluster(cls) -> "K8sApi":
+        host = os.environ.get("KUBERNETES_SERVICE_HOST", "kubernetes.default.svc")
+        port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+        token = None
+        tok_path = os.path.join(_SA_DIR, "token")
+        if os.path.exists(tok_path):
+            with open(tok_path) as f:
+                token = f.read().strip()
+        return cls(
+            f"https://{host}:{port}",
+            token=token,
+            ca_file=os.path.join(_SA_DIR, "ca.crt"),
+        )
+
+    async def _ensure(self):
+        if self._session is None:
+            import aiohttp
+
+            self._session = aiohttp.ClientSession()
+        return self._session
+
+    def _headers(self, content_type: Optional[str] = None) -> dict:
+        h = {"Accept": "application/json"}
+        if self.token:
+            h["Authorization"] = f"Bearer {self.token}"
+        if content_type:
+            h["Content-Type"] = content_type
+        return h
+
+    def _crd_path(self, namespace: Optional[str]) -> str:
+        ns = f"/namespaces/{namespace}" if namespace else ""
+        return f"/apis/{GROUP}/{VERSION}{ns}/{PLURAL}"
+
+    async def list(self, namespace: Optional[str]) -> dict:
+        s = await self._ensure()
+        async with s.get(
+            self.base_url + self._crd_path(namespace),
+            headers=self._headers(),
+            ssl=self._ssl,
+        ) as resp:
+            resp.raise_for_status()
+            return await resp.json()
+
+    async def watch(self, namespace: Optional[str], resource_version: str):
+        """Yield watch events (dicts with type/object) until the server
+        closes the stream; the caller re-lists and re-watches."""
+        s = await self._ensure()
+        url = (
+            self.base_url + self._crd_path(namespace)
+            + f"?watch=true&resourceVersion={resource_version}"
+        )
+        async with s.get(
+            url, headers=self._headers(), ssl=self._ssl,
+            timeout=None,
+        ) as resp:
+            resp.raise_for_status()
+            buf = b""
+            async for chunk in resp.content.iter_any():
+                buf += chunk
+                while b"\n" in buf:
+                    line, buf = buf.split(b"\n", 1)
+                    if line.strip():
+                        yield json.loads(line)
+
+    async def patch_status(
+        self, namespace: str, name: str, status: dict
+    ) -> None:
+        s = await self._ensure()
+        url = (
+            self.base_url + self._crd_path(namespace) + f"/{name}/status"
+        )
+        async with s.patch(
+            url,
+            headers=self._headers("application/merge-patch+json"),
+            data=json.dumps({"status": status}),
+            ssl=self._ssl,
+        ) as resp:
+            if resp.status >= 400:
+                log.warning(
+                    "status patch %s/%s -> HTTP %s", namespace, name,
+                    resp.status,
+                )
+
+    async def close(self) -> None:
+        if self._session is not None:
+            await self._session.close()
+            self._session = None
+
+
+def spec_doc(cr: dict) -> dict:
+    """Map a DynamoGraphDeployment CR to the GraphOperator spec document
+    (sdk/operator.py: {"entry": ..., "services": {...}}). The
+    managed-by marker lets restart-time pruning distinguish controller-
+    owned documents from specs applied via the operator CLI (which a
+    blanket prefix-prune would destroy)."""
+    spec = cr.get("spec") or {}
+    doc = {"entry": spec.get("entry", ""), "managed_by": MANAGED_BY}
+    services = spec.get("services") or {}
+    if services:
+        doc["services"] = {
+            name: {
+                k: v
+                for k, v in (svc or {}).items()
+                if k in ("workers", "tpu", "env")
+            }
+            for name, svc in services.items()
+        }
+    return doc
+
+
+def doc_key(cr: dict) -> str:
+    meta = cr.get("metadata") or {}
+    ns = meta.get("namespace") or "default"
+    return f"{GRAPH_PREFIX}{ns}.{meta['name']}"
+
+
+class CrdController:
+    """The reconcile loop: CR events -> hub spec documents -> status."""
+
+    def __init__(
+        self, api: K8sApi, hub_addr: str, namespace: Optional[str] = None
+    ):
+        self.api = api
+        self.hub_addr = hub_addr
+        self.namespace = namespace
+        self._hub: Optional[HubClient] = None
+        self._applied: dict[str, dict] = {}  # doc key -> spec doc
+        self._status_gen: dict[str, object] = {}  # doc key -> generation
+        self._stop = asyncio.Event()
+
+    async def _reconcile(self, cr: dict) -> None:
+        key = doc_key(cr)
+        doc = spec_doc(cr)
+        meta = cr.get("metadata") or {}
+        gen = meta.get("generation")
+        if not doc["entry"]:
+            await self._status(cr, "Invalid", "spec.entry is required")
+            self._status_gen[key] = gen
+            return
+        if self._applied.get(key) == doc:
+            # converged — but a generation change (e.g. an invalid edit
+            # reverted to this same spec) must still heal the status
+            if self._status_gen.get(key) != gen:
+                await self._status(
+                    cr, "Reconciled", "graph spec unchanged", generation=gen
+                )
+                self._status_gen[key] = gen
+            return
+        await self._hub.kv_put(key, json.dumps(doc).encode())
+        self._applied[key] = doc
+        self._status_gen[key] = gen
+        log.info("reconciled %s -> %s", key, doc["entry"])
+        await self._status(
+            cr, "Reconciled",
+            f"graph spec applied to hub ({self.hub_addr})",
+            generation=gen,
+        )
+
+    async def _remove(self, cr: dict) -> None:
+        key = doc_key(cr)
+        # the GraphOperator's watcher sees the delete and drains the
+        # Supervisor (graceful teardown — sdk/operator.py _teardown)
+        await self._hub.kv_del(key)
+        self._applied.pop(key, None)
+        log.info("removed %s (operator will drain)", key)
+
+    async def _status(
+        self, cr: dict, phase: str, message: str, generation=None
+    ) -> None:
+        meta = cr.get("metadata") or {}
+        status = {"phase": phase, "message": message}
+        if generation is not None:
+            status["observedGeneration"] = generation
+        try:
+            await self.api.patch_status(
+                meta.get("namespace") or "default", meta["name"], status
+            )
+        except Exception:
+            log.exception("status patch failed for %s", meta.get("name"))
+
+    async def run(self) -> None:
+        """LIST (sync every CR + prune stale docs), then WATCH; on stream
+        end or error, re-list — the standard level-triggered loop."""
+        self._hub = await HubClient.connect(self.hub_addr)
+        try:
+            while not self._stop.is_set():
+                try:
+                    listing = await self.api.list(self.namespace)
+                    live = set()
+                    for cr in listing.get("items", []):
+                        live.add(doc_key(cr))
+                        await self._reconcile(cr)
+                    # prune CONTROLLER-OWNED docs whose CR is gone —
+                    # scans the hub (not just the in-memory cache) so CRs
+                    # deleted while this process was down are cleaned up
+                    # on restart; operator-CLI specs (no managed-by
+                    # marker) are never touched
+                    for ent in await self._hub.kv_get_prefix(GRAPH_PREFIX):
+                        key = ent["key"]
+                        if key in live:
+                            continue
+                        try:
+                            owned = (
+                                json.loads(ent["value"]).get("managed_by")
+                                == MANAGED_BY
+                            )
+                        except Exception:
+                            owned = False
+                        if owned:
+                            await self._hub.kv_del(key)
+                            self._applied.pop(key, None)
+                            log.info("pruned orphaned %s", key)
+                    rv = (listing.get("metadata") or {}).get(
+                        "resourceVersion", "0"
+                    )
+                    async for event in self.api.watch(self.namespace, rv):
+                        kind = event.get("type")
+                        obj = event.get("object") or {}
+                        if kind in ("ADDED", "MODIFIED"):
+                            await self._reconcile(obj)
+                        elif kind == "DELETED":
+                            await self._remove(obj)
+                        if self._stop.is_set():
+                            break
+                except asyncio.CancelledError:
+                    raise
+                except Exception:
+                    log.exception("watch loop error; re-listing in 2s")
+                    await asyncio.sleep(2.0)
+        finally:
+            await self._hub.close()
+
+    def stop(self) -> None:
+        """Request shutdown. The loop may be blocked inside an idle
+        watch stream — `astop` (or cancelling `run`) closes the HTTP
+        session to break it; bare `stop` only takes effect at the next
+        event."""
+        self._stop.set()
+
+    async def astop(self) -> None:
+        self._stop.set()
+        await self.api.close()  # breaks a blocked watch read
+
+
+async def _amain(args) -> int:
+    api = (
+        K8sApi(args.api, token=args.token) if args.api else K8sApi.in_cluster()
+    )
+    ctl = CrdController(api, args.hub, namespace=args.namespace)
+    try:
+        await ctl.run()
+    finally:
+        await api.close()
+    return 0
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    configure_logging()
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--hub", required=True, help="hub address host:port")
+    ap.add_argument(
+        "--api", default=None,
+        help="API server base URL (default: in-cluster config)",
+    )
+    ap.add_argument("--token", default=None, help="bearer token (dev)")
+    ap.add_argument(
+        "--namespace", default=None,
+        help="watch one namespace (default: all)",
+    )
+    return asyncio.run(_amain(ap.parse_args(argv)))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

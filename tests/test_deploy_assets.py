@@ -1,0 +1,261 @@
+"""Deploy-asset validation: the Helm chart and raw manifests cannot rot.
+
+No helm binary exists in this image, so `helm template` is replaced by a
+mini renderer covering exactly the constructs the chart uses
+({{ .Values.* }} / {{ .Release.* }} substitution, `| quote`/`| nindent`,
+{{- if }}/{{- if eq }}/{{- range }}/{{- end }}, {{- define }}/include).
+Every rendered document and every raw manifest must parse as YAML and
+carry the basic Kubernetes shape; every `.Values.x.y` reference must
+resolve in values.yaml (the rot this test exists to catch).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+import pytest
+
+yaml = pytest.importorskip("yaml")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CHART = os.path.join(REPO, "deploy", "helm", "dynamo-tpu")
+K8S = os.path.join(REPO, "deploy", "kubernetes")
+
+
+def _values():
+    with open(os.path.join(CHART, "values.yaml")) as f:
+        return yaml.safe_load(f)
+
+
+def _lookup(values, path):
+    cur = {"Values": values, "Release": {"Name": "rel", "Namespace": "ns"},
+           "Chart": {"Name": "dynamo-tpu", "Version": "0"}}
+    for part in path.lstrip(".").split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            raise KeyError(path)
+        cur = cur[part]
+    return cur
+
+
+_EXPR = re.compile(r"\{\{-?\s*(.*?)\s*-?\}\}")
+
+
+def _render_expr(expr, values, dot=None):
+    """Evaluate one {{ ... }} expression; returns its substitution."""
+    parts = [p.strip() for p in expr.split("|")]
+    head = parts[0]
+    if head == ".":
+        val = dot
+    else:
+        val = _lookup(values, head)
+    for pipe in parts[1:]:
+        if pipe == "quote":
+            val = f'"{val}"'
+        elif pipe.startswith("nindent"):
+            n = int(pipe.split()[1])
+            pad = "\n" + " " * n
+            val = pad + str(val).strip("\n").replace("\n", pad)
+        elif pipe.startswith("indent"):
+            n = int(pipe.split()[1])
+            pad = " " * n
+            val = pad + str(val).replace("\n", "\n" + pad)
+        elif pipe.startswith("default"):
+            arg = pipe.split(None, 1)[1].strip('"')
+            if val in (None, "", 0, False):
+                val = arg
+        else:
+            raise AssertionError(f"unsupported pipe {pipe!r} in {expr!r}")
+    return str(val)
+
+
+def _truthy(expr, values):
+    expr = expr.strip()
+    if expr.startswith("eq "):
+        _, a, b = expr.split(None, 2)
+        av = _lookup(values, a) if a.startswith(".") else a.strip('"')
+        bv = _lookup(values, b) if b.startswith(".") else b.strip('"')
+        return str(av) == str(bv)
+    if expr.startswith("not "):
+        return not _truthy(expr[4:], values)
+    val = _lookup(values, expr)
+    return bool(val)
+
+
+def render_template(text, values, defines=None):
+    """Render the subset of Go templating the chart uses; raises on any
+    construct outside it (which is the signal to extend this renderer,
+    not to let the chart rot unvalidated)."""
+    defines = defines if defines is not None else {}
+    out_lines = []
+    stack = [True]  # emit-state per nesting level
+    lines = text.split("\n")
+    i = 0
+    while i < len(lines):
+        line = lines[i]
+        stripped = line.strip()
+        m = _EXPR.fullmatch(stripped) if stripped.startswith("{{") else None
+        ctrl = m.group(1) if m else None
+        if ctrl is not None and (
+            ctrl.startswith(("if ", "range ", "define ", "end"))
+            or ctrl == "end"
+        ):
+            if ctrl.startswith("define "):
+                name = ctrl.split(None, 1)[1].strip('"')
+                body = []
+                i += 1
+                depth = 1
+                while i < len(lines):
+                    s2 = lines[i].strip()
+                    m2 = _EXPR.fullmatch(s2) if s2.startswith("{{") else None
+                    c2 = m2.group(1) if m2 else None
+                    if c2 is not None and c2.startswith(("if ", "range ", "define ")):
+                        depth += 1
+                    if c2 is not None and (c2 == "end" or c2.startswith("end")):
+                        depth -= 1
+                        if depth == 0:
+                            break
+                    body.append(lines[i])
+                    i += 1
+                defines[name] = "\n".join(body)
+            elif ctrl.startswith("if "):
+                stack.append(stack[-1] and _truthy(ctrl[3:], values))
+            elif ctrl.startswith("range "):
+                seq = _lookup(values, ctrl.split(None, 1)[1]) or []
+                # collect the range body
+                body = []
+                i += 1
+                depth = 1
+                while i < len(lines):
+                    s2 = lines[i].strip()
+                    m2 = _EXPR.fullmatch(s2) if s2.startswith("{{") else None
+                    c2 = m2.group(1) if m2 else None
+                    if c2 is not None and c2.startswith(("if ", "range ")):
+                        depth += 1
+                    if c2 is not None and (c2 == "end" or c2.startswith("end")):
+                        depth -= 1
+                        if depth == 0:
+                            break
+                    body.append(lines[i])
+                    i += 1
+                if stack[-1]:
+                    for item in seq:
+                        for bl in body:
+                            out_lines.append(
+                                _EXPR.sub(
+                                    lambda mm: _render_expr(
+                                        mm.group(1), values, dot=item
+                                    ),
+                                    bl,
+                                )
+                            )
+            else:  # end
+                stack.pop()
+            i += 1
+            continue
+        if stack[-1]:
+            def sub(mm):
+                expr = mm.group(1)
+                if expr.startswith("include "):
+                    rest = expr[len("include "):]
+                    name = rest.split('"')[1]
+                    pipe = rest.split("|")[1].strip() if "|" in rest else None
+                    body = render_template(defines[name], values, defines)
+                    if pipe and pipe.startswith("nindent"):
+                        n = int(pipe.split()[1])
+                        pad = "\n" + " " * n
+                        body = pad + body.strip("\n").replace("\n", pad)
+                    return body
+                return _render_expr(expr, values)
+
+            out_lines.append(_EXPR.sub(sub, line))
+        i += 1
+    return "\n".join(out_lines)
+
+
+def _k8s_sanity(doc, where):
+    assert doc.get("apiVersion"), f"{where}: missing apiVersion"
+    assert doc.get("kind"), f"{where}: missing kind"
+    assert (doc.get("metadata") or {}).get("name"), f"{where}: missing name"
+    if doc["kind"] in ("Deployment", "StatefulSet"):
+        tpl = doc["spec"]["template"]["spec"]
+        assert tpl["containers"], f"{where}: no containers"
+        for c in tpl["containers"]:
+            assert c.get("image"), f"{where}: container without image"
+
+
+def test_helm_chart_renders_and_validates():
+    values = _values()
+    with open(os.path.join(CHART, "Chart.yaml")) as f:
+        chart = yaml.safe_load(f)
+    assert chart["name"] == "dynamo-tpu" and chart["version"]
+
+    tpl_dir = os.path.join(CHART, "templates")
+    defines: dict = {}
+    rendered_kinds = []
+    for fname in sorted(os.listdir(tpl_dir)):
+        with open(os.path.join(tpl_dir, fname)) as f:
+            text = f.read()
+        out = render_template(text, values, defines)
+        for doc in yaml.safe_load_all(out):
+            if doc is None:
+                continue
+            _k8s_sanity(doc, f"{fname} (rendered)")
+            rendered_kinds.append(doc["kind"])
+    # the chart must produce the serving trio
+    for kind in ("Deployment", "Service"):
+        assert kind in rendered_kinds, f"chart renders no {kind}"
+
+
+def test_helm_values_references_resolve():
+    """Every .Values.x.y mentioned anywhere in the templates must exist
+    in values.yaml — the classic chart-rot failure."""
+    values = _values()
+    tpl_dir = os.path.join(CHART, "templates")
+    missing = []
+    for fname in sorted(os.listdir(tpl_dir)):
+        with open(os.path.join(tpl_dir, fname)) as f:
+            text = f.read()
+        for ref in re.findall(r"\.Values(?:\.\w+)+", text):
+            try:
+                _lookup(values, ref)
+            except KeyError:
+                missing.append(f"{fname}: {ref}")
+    assert not missing, f"unresolved values references: {missing}"
+
+
+def test_raw_manifests_parse_and_shape():
+    for fname in sorted(os.listdir(K8S)):
+        if not fname.endswith(".yaml") or fname == "kustomization.yaml":
+            continue
+        with open(os.path.join(K8S, fname)) as f:
+            docs = [d for d in yaml.safe_load_all(f) if d]
+        assert docs, f"{fname}: empty"
+        for doc in docs:
+            _k8s_sanity(doc, fname)
+
+
+def test_crd_schema_structure():
+    """The DynamoGraphDeployment CRD must stay structurally valid: one
+    served+storage version, a status subresource, and an openAPIV3Schema
+    that requires spec.entry (what the controller assumes)."""
+    with open(os.path.join(K8S, "crd.yaml")) as f:
+        crd = yaml.safe_load(f)
+    assert crd["kind"] == "CustomResourceDefinition"
+    assert crd["spec"]["group"] == "dynamo.tpu.io"
+    names = crd["spec"]["names"]
+    assert names["plural"] == "dynamographdeployments"
+    assert (
+        crd["metadata"]["name"]
+        == f"{names['plural']}.{crd['spec']['group']}"
+    )
+    versions = [v for v in crd["spec"]["versions"] if v["served"]]
+    assert len(versions) == 1 and versions[0]["storage"]
+    v = versions[0]
+    assert "status" in v["subresources"]
+    schema = v["schema"]["openAPIV3Schema"]
+    assert "spec" in schema["required"]
+    spec_schema = schema["properties"]["spec"]
+    assert "entry" in spec_schema["required"]
+    svc = spec_schema["properties"]["services"]["additionalProperties"]
+    assert set(svc["properties"]) >= {"workers", "tpu", "env"}

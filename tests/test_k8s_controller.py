@@ -1,0 +1,298 @@
+"""K8s CRD controller: DynamoGraphDeployment -> hub GraphOperator specs.
+
+Drives dynamo_tpu/sdk/k8s_controller.py against a FAKE Kubernetes API
+server (aiohttp, list+watch+status endpoints — the envtest analogue) and
+a real in-process hub: CR create/update/delete must appear as spec-
+document create/update/delete under deploy/graphs/, with the CR status
+patched. Reference counterpart: the Go controller suite under
+deploy/dynamo/operator/internal/controller/.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+import pytest
+
+aiohttp = pytest.importorskip("aiohttp")
+from aiohttp import web
+
+from dynamo_tpu.runtime.hub.server import HubServer
+from dynamo_tpu.runtime.hub.client import HubClient
+from dynamo_tpu.sdk.k8s_controller import (
+    CrdController,
+    K8sApi,
+    doc_key,
+    spec_doc,
+)
+from dynamo_tpu.sdk.operator import GRAPH_PREFIX
+
+
+def _cr(name, entry, services=None, namespace="prod", generation=1):
+    return {
+        "apiVersion": "dynamo.tpu.io/v1alpha1",
+        "kind": "DynamoGraphDeployment",
+        "metadata": {
+            "name": name, "namespace": namespace, "generation": generation,
+            "resourceVersion": "1",
+        },
+        "spec": {"entry": entry, **({"services": services} if services else {})},
+    }
+
+
+class FakeApiServer:
+    """The two endpoints the controller uses: list+watch and /status."""
+
+    def __init__(self):
+        self.items: dict[str, dict] = {}
+        self.status_patches: list[tuple[str, dict]] = []
+        self._watchers: list[asyncio.Queue] = []
+        self._rv = 1
+
+    async def handle(self, request: web.Request):
+        if request.query.get("watch") == "true":
+            q: asyncio.Queue = asyncio.Queue()
+            self._watchers.append(q)
+            resp = web.StreamResponse()
+            resp.content_type = "application/json"
+            await resp.prepare(request)
+            try:
+                while True:
+                    ev = await q.get()
+                    if ev is None:
+                        break
+                    await resp.write(json.dumps(ev).encode() + b"\n")
+            finally:
+                self._watchers.remove(q)
+            return resp
+        return web.json_response(
+            {
+                "kind": "DynamoGraphDeploymentList",
+                "metadata": {"resourceVersion": str(self._rv)},
+                "items": list(self.items.values()),
+            }
+        )
+
+    async def handle_status(self, request: web.Request):
+        name = request.match_info["name"]
+        body = await request.json()
+        self.status_patches.append((name, body.get("status") or {}))
+        return web.json_response({"status": "ok"})
+
+    def emit(self, kind: str, obj: dict) -> None:
+        self._rv += 1
+        if kind in ("ADDED", "MODIFIED"):
+            self.items[obj["metadata"]["name"]] = obj
+        elif kind == "DELETED":
+            self.items.pop(obj["metadata"]["name"], None)
+        for q in self._watchers:
+            q.put_nowait({"type": kind, "object": obj})
+
+    async def wait_watcher(self, timeout=5.0):
+        for _ in range(int(timeout / 0.02)):
+            if self._watchers:
+                return
+            await asyncio.sleep(0.02)
+        raise TimeoutError("controller never opened a watch")
+
+
+async def _wait(pred, timeout=5.0):
+    for _ in range(int(timeout / 0.02)):
+        if await pred():
+            return True
+        await asyncio.sleep(0.02)
+    return False
+
+
+async def test_crd_reconcile_lifecycle(unused_tcp_port_factory=None):
+    # real hub
+    hub = HubServer()
+    await hub.start()
+    hub_addr = f"127.0.0.1:{hub.port}"
+
+    # fake API server
+    fake = FakeApiServer()
+    app = web.Application()
+    app.router.add_get(
+        "/apis/dynamo.tpu.io/v1alpha1/dynamographdeployments", fake.handle
+    )
+    app.router.add_patch(
+        "/apis/dynamo.tpu.io/v1alpha1/namespaces/{ns}/"
+        "dynamographdeployments/{name}/status",
+        fake.handle_status,
+    )
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+
+    # a CR existing BEFORE the controller starts (list path)
+    pre = _cr("agg", "examples/llm/graphs/agg.py:Frontend",
+              services={"Worker": {"workers": 2, "tpu": 1}})
+    fake.emit("ADDED", pre)
+
+    api = K8sApi(f"http://127.0.0.1:{port}")
+    ctl = CrdController(api, hub_addr)
+    task = asyncio.create_task(ctl.run())
+    reader = await HubClient.connect(hub_addr)
+    try:
+        async def doc(name):
+            got = await reader.kv_get(f"{GRAPH_PREFIX}prod.{name}")
+            return json.loads(got["value"]) if got else None
+
+        # initial LIST reconciled the pre-existing CR
+        assert await _wait(lambda: _truthy(doc("agg")))
+        d = await doc("agg")
+        assert d["entry"].endswith(":Frontend")
+        assert d["services"]["Worker"]["workers"] == 2
+        assert any(
+            n == "agg" and s.get("phase") == "Reconciled"
+            for n, s in fake.status_patches
+        )
+
+        await fake.wait_watcher()
+        # ADDED via watch
+        fake.emit("ADDED", _cr("disagg", "graphs/disagg.py:Frontend"))
+        assert await _wait(lambda: _truthy(doc("disagg")))
+
+        # MODIFIED: replica bump flows through
+        mod = _cr("agg", "examples/llm/graphs/agg.py:Frontend",
+                  services={"Worker": {"workers": 5, "tpu": 1}}, generation=2)
+        fake.emit("MODIFIED", mod)
+        assert await _wait(
+            lambda: _eq(doc("agg"), lambda d: d and
+                        d["services"]["Worker"]["workers"] == 5)
+        )
+
+        # DELETED: spec doc removed -> operator would drain
+        fake.emit("DELETED", mod)
+        assert await _wait(lambda: _none(doc("agg")))
+        assert await _wait(lambda: _truthy(doc("disagg")))  # untouched
+
+        # invalid CR: status Invalid, no doc
+        fake.emit("ADDED", _cr("broken", ""))
+        assert await _wait(
+            lambda: _has_status(fake, "broken", "Invalid")
+        )
+        assert (await doc("broken")) is None
+        # heal: the same CR edited back to a valid spec (gen bump) must
+        # reconcile and report Reconciled even if the spec doc matches a
+        # previously applied one
+        fake.emit("MODIFIED", _cr("broken", "graphs/ok.py:Frontend",
+                                  generation=2))
+        assert await _wait(lambda: _truthy(doc("broken")))
+        assert await _wait(
+            lambda: _has_status(fake, "broken", "Reconciled")
+        )
+    finally:
+        await ctl.astop()  # breaks the blocked watch read
+        task.cancel()
+        try:
+            await task
+        except (asyncio.CancelledError, Exception):
+            pass
+        await reader.close()
+        await api.close()
+        await runner.cleanup()
+        await hub.stop()
+
+
+def _truthy(coro):
+    async def _inner():
+        return bool(await coro)
+    return _inner()
+
+
+def _none(coro):
+    async def _inner():
+        return (await coro) is None
+    return _inner()
+
+
+def _eq(coro, fn):
+    async def _inner():
+        return fn(await coro)
+    return _inner()
+
+
+async def _has_status(fake, name, phase):
+    return any(
+        n == name and s.get("phase") == phase for n, s in fake.status_patches
+    )
+
+
+def test_spec_doc_mapping():
+    cr = _cr("x", "m.py:Svc", services={
+        "A": {"workers": 3, "tpu": 2, "env": {"K": "v"}, "junk": 1}
+    })
+    doc = spec_doc(cr)
+    from dynamo_tpu.sdk.k8s_controller import MANAGED_BY
+
+    assert doc == {
+        "entry": "m.py:Svc",
+        "managed_by": MANAGED_BY,
+        "services": {"A": {"workers": 3, "tpu": 2, "env": {"K": "v"}}},
+    }
+    assert doc_key(cr) == f"{GRAPH_PREFIX}prod.x"
+
+
+
+async def test_restart_prunes_orphans_but_not_cli_specs():
+    """A CR deleted while the controller was DOWN must be pruned on the
+    next start (hub scan by managed-by marker); specs applied via the
+    operator CLI (no marker) are never touched."""
+    from dynamo_tpu.sdk.k8s_controller import MANAGED_BY
+
+    hub = HubServer()
+    await hub.start()
+    hub_addr = f"127.0.0.1:{hub.port}"
+    seed = await HubClient.connect(hub_addr)
+    # orphan: controller-owned doc whose CR no longer exists
+    await seed.kv_put(
+        f"{GRAPH_PREFIX}prod.gone",
+        json.dumps({"entry": "x.py:F", "managed_by": MANAGED_BY}).encode(),
+    )
+    # CLI-applied doc: no marker
+    await seed.kv_put(
+        f"{GRAPH_PREFIX}manual",
+        json.dumps({"entry": "y.py:F"}).encode(),
+    )
+
+    fake = FakeApiServer()
+    app = web.Application()
+    app.router.add_get(
+        "/apis/dynamo.tpu.io/v1alpha1/dynamographdeployments", fake.handle
+    )
+    app.router.add_patch(
+        "/apis/dynamo.tpu.io/v1alpha1/namespaces/{ns}/"
+        "dynamographdeployments/{name}/status",
+        fake.handle_status,
+    )
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+
+    api = K8sApi(f"http://127.0.0.1:{port}")
+    ctl = CrdController(api, hub_addr)
+    task = asyncio.create_task(ctl.run())
+    try:
+        async def gone():
+            return await seed.kv_get(f"{GRAPH_PREFIX}prod.gone")
+
+        assert await _wait(lambda: _none(gone()))
+        assert (await seed.kv_get(f"{GRAPH_PREFIX}manual")) is not None
+    finally:
+        await ctl.astop()
+        task.cancel()
+        try:
+            await task
+        except (asyncio.CancelledError, Exception):
+            pass
+        await seed.close()
+        await api.close()
+        await runner.cleanup()
+        await hub.stop()
