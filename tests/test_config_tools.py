@@ -132,8 +132,10 @@ def test_deploy_manifests_parse():
             for doc in yaml.safe_load_all(f):
                 assert doc and "kind" in doc, name
                 kinds.append(doc["kind"])
-    assert kinds.count("Deployment") == 3
+    # hub + frontend + worker + CRD controller
+    assert kinds.count("Deployment") == 4
     assert kinds.count("Service") == 2
+    assert "CustomResourceDefinition" in kinds
     assert "Kustomization" in kinds
     with open(os.path.join(root, "deploy", "docker-compose.yml")) as f:
         compose = yaml.safe_load(f)
