@@ -40,6 +40,35 @@ KV_QUANT = None if KV_QUANT in ("", "none") else KV_QUANT
 # BENCH_FAST=1: headline wave + prefix probe only (the concurrency sweep
 # runs one engine init per point — skip the paced/offload/phase extras)
 FAST = os.environ.get("BENCH_FAST", "") not in ("", "0")
+# BENCH_SPEC=1: self-speculative decoding A/B — a repetitive-text wave
+# served with spec off then on (same engine, runtime toggle), recording
+# acceptance rate, effective tokens-per-verify-step and the tok/s delta.
+# NOTE: spec_decode is incompatible with the packed pallas+int8 KV pools
+# (the engine refuses at init) — on TPU run it with BENCH_KV_QUANT=none.
+SPEC = os.environ.get("BENCH_SPEC", "") not in ("", "0")
+SPEC_K = int(os.environ.get("BENCH_SPEC_K", "4"))
+SPEC_NGRAM = int(os.environ.get("BENCH_SPEC_NGRAM", "3"))
+SPEC_OSL = int(os.environ.get("BENCH_SPEC_OSL", str(max(OSL, 128))))
+
+ENV_HELP = """bench.py — serving benchmark; configuration via env vars:
+  BENCH_MODEL                  preset override (auto-picked from HBM)
+  BENCH_ISL / BENCH_OSL        input/output sequence lengths (512 / 64)
+  BENCH_DECODE_STEPS           decode steps per jit dispatch (16)
+  BENCH_QUANT / BENCH_KV_QUANT weights / KV cache quant: int8|none (int8)
+  BENCH_FAST=1                 headline wave + prefix probe only
+  BENCH_CONCURRENCY            concurrent requests (128 big / 256 small)
+  BENCH_PREFILL_GROUP          prefill group token budget
+  BENCH_HOST_KV_PAGES          host offload tier pages (16)
+  BENCH_PREFILL_WINDOW         admission batching window seconds (0.25)
+  BENCH_REPS                   measured-wave repetitions (3)
+  BENCH_PACED_FRAC(_HI)        paced-arrival operating points (0.35/0.5)
+  BENCH_SPEC=1                 speculative-decode A/B (off by default)
+  BENCH_SPEC_K                 drafted tokens per verify step (4)
+  BENCH_SPEC_NGRAM             longest proposer n-gram (3)
+  BENCH_SPEC_OSL               output length of the spec A/B waves
+                               (max(BENCH_OSL, 128))
+  BENCH_SPEC_CONC              concurrency of the spec A/B waves (32)
+"""
 
 
 def main() -> None:
@@ -56,6 +85,11 @@ def main() -> None:
     import __graft_entry__
 
     cfg = __graft_entry__._pick_config(QUANT)
+    if os.environ.get("BENCH_MODEL"):
+        # explicit preset override (CI smokes run the tiny preset on CPU)
+        from dynamo_tpu.models.config import get_config
+
+        cfg = get_config(os.environ["BENCH_MODEL"])
     n_chips = len(jax.local_devices())
     big = cfg.name == "llama-3.1-8b"
     # 8B on a 16 GB chip: the KV pool budget (~5 GB after int8 weights)
@@ -72,12 +106,19 @@ def main() -> None:
             model=cfg,
             dtype="bfloat16",
             max_batch_size=concurrency,
-            max_model_len=ISL + OSL + 32,
+            max_model_len=ISL + (max(OSL, SPEC_OSL) if SPEC else OSL) + 32,
             prefill_chunk=ISL,
             decode_steps=DECODE_STEPS,
             prefill_group_tokens=prefill_group,
             quantization=QUANT,
             kv_quantization=KV_QUANT,
+            # spec A/B: init validates the combo (packed int8 pools
+            # refuse); the main protocol's random prompts never draft,
+            # so the headline numbers are unaffected — the A/B flips
+            # this flag per wave
+            spec_decode=SPEC,
+            spec_k_max=SPEC_K,
+            spec_ngram_max=SPEC_NGRAM,
             # int8-KV pallas kernels put page tokens in lanes
             page_size=128 if KV_QUANT else 64,
             # HBM->host offload tier ON (the reference baselines run with
@@ -96,6 +137,10 @@ def main() -> None:
     # the KV lock for the whole (tunnel-slow) copy and would serialize
     # the throughput/paced measurements
     engine.offload_paused = True
+    # spec stays parked outside its own A/B too (a runtime host-side
+    # toggle): tiny-vocab/random-prompt runs would otherwise draft on
+    # the HEADLINE wave and muddy the baseline numbers
+    engine.config.spec_decode = False
     n_params = engine.param_count
 
     rng = np.random.RandomState(0)
@@ -206,6 +251,73 @@ def main() -> None:
                 engine.phase_stats["prefill_tokens"] - pf0["prefill_tokens"]
             )
 
+        async def spec_ab():
+            """Speculative-decode A/B on a repetitive-text workload: the
+            same wave greedy-served with spec_decode off, then on (the
+            flag is a per-tick host decision, so a runtime toggle is
+            sound). Distinct 16-token segments tiled to ISL: every
+            suffix n-gram recurs within its own prompt, no cross-request
+            prefix-cache hits."""
+            n_spec = min(
+                concurrency, int(os.environ.get("BENCH_SPEC_CONC", "32"))
+            )
+
+            def rep_prompts():
+                return [
+                    np.tile(
+                        rng.randint(1, cfg.vocab_size, size=16),
+                        SPEC_OSL // 16 + ISL // 16 + 2,
+                    )[:ISL].tolist()
+                    for _ in range(n_spec)
+                ]
+
+            engine.config.spec_decode = False
+            # warm the off-wave compile families (small-row prefill
+            # groups this concurrency may never have hit)
+            await asyncio.gather(
+                *(one(p, {}, max_tokens=SPEC_OSL) for p in rep_prompts()[:2])
+            )
+            off = rep_prompts()
+            t0 = time.perf_counter()
+            await asyncio.gather(
+                *(one(p, {}, max_tokens=SPEC_OSL) for p in off)
+            )
+            wall_off = time.perf_counter() - t0
+            engine.config.spec_decode = True
+            # compile the verify families before measuring
+            await asyncio.gather(
+                *(one(p, {}, max_tokens=SPEC_OSL) for p in rep_prompts()[:2])
+            )
+            ps_a = engine.phase_stats
+            on = rep_prompts()
+            t0 = time.perf_counter()
+            await asyncio.gather(
+                *(one(p, {}, max_tokens=SPEC_OSL) for p in on)
+            )
+            wall_on = time.perf_counter() - t0
+            ps_b = engine.phase_stats
+            engine.config.spec_decode = False
+            d = {k: ps_b[k] - ps_a[k] for k in ps_a}
+            toks = n_spec * SPEC_OSL
+            return {
+                "k_max": SPEC_K,
+                "ngram_max": SPEC_NGRAM,
+                "concurrency": n_spec,
+                "osl": SPEC_OSL,
+                "acceptance_rate": (
+                    round(d["spec_accepted"] / d["spec_drafted"], 4)
+                    if d["spec_drafted"] else None
+                ),
+                "effective_tokens_per_step": (
+                    round(d["spec_emitted"] / d["spec_rows"], 3)
+                    if d["spec_rows"] else None
+                ),
+                "verify_steps": d["spec_dispatches"],
+                "toks_per_sec_chip_off": round(toks / wall_off / n_chips, 1),
+                "toks_per_sec_chip_on": round(toks / wall_on / n_chips, 1),
+                "speedup": round(wall_off / wall_on, 3),
+            }
+
         if FAST:
             probe = rng.randint(1, cfg.vocab_size, size=ISL).tolist()
             cold, warm = {}, {}
@@ -216,6 +328,7 @@ def main() -> None:
                 None, None,
                 {"ttft": _probe_ratio(cold, warm), "wall": None},
                 [], 0.0, 0.0, [], 0.0, 0.0, None,
+                await spec_ab() if SPEC else None,
             )
 
         # prefix-cache TTFT probe, WAVE-based (BASELINE.md: KV-aware
@@ -345,6 +458,7 @@ def main() -> None:
             paced_records, paced_rate, paced_wall,
             hi_records, hi_rate, hi_wall,
             offload_speedup,
+            await spec_ab() if SPEC else None,
         )
 
     (
@@ -354,6 +468,7 @@ def main() -> None:
         paced_records, paced_rate, paced_wall,
         hi_records, hi_rate, hi_wall,
         offload_speedup,
+        spec_result,
     ) = asyncio.run(run())
     total_tokens = sum(r["tokens"] for r in records)
     toks_per_sec_chip = total_tokens / wall / n_chips
@@ -472,6 +587,10 @@ def main() -> None:
                         if offload_speedup is not None else None
                     ),
                     "offload_gate": dict(engine.offload_gate_stats),
+                    # BENCH_SPEC=1: repetitive-text A/B, spec off vs on
+                    **({} if spec_result is None else {
+                        "spec": spec_result,
+                    }),
                 },
             }
         )
@@ -479,4 +598,9 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    import sys
+
+    if any(a in ("-h", "--help") for a in sys.argv[1:]):
+        print(ENV_HELP, end="")
+    else:
+        main()

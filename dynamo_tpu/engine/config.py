@@ -64,6 +64,16 @@ class EngineConfig:
     # one (fixed [max_batch] shape), so waves would otherwise run decode
     # at ~2x the needed steps. Never delays running streams. 0 disables.
     decode_ready_frac: float = 1.0
+    # self-speculative decoding (engine/spec.py): draft the next k tokens
+    # by prompt-lookup over the sequence's own history, verify all of
+    # them in ONE multi-query model step (rejection-sampling acceptance
+    # keeps the sampled distribution exact; greedy acceptance is exact
+    # match).  Decode is memory-bandwidth-bound, so every accepted draft
+    # token is a model step the sequence did not pay for.  Per-sequence
+    # EMA gating drives k -> 0 on unpredictable text (today's behavior).
+    spec_decode: bool = False
+    spec_k_max: int = 4       # max drafted tokens per verify step
+    spec_ngram_max: int = 3   # longest suffix n-gram the proposer matches
     # admission batching window for PACED arrivals: when decode streams
     # are running and fewer than `prefill_batch_min_rows` sequences are
     # pending prefill, hold the prefill dispatch up to this many seconds

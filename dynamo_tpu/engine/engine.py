@@ -61,10 +61,12 @@ from dynamo_tpu.llm.protocols.common import (
     PreprocessedRequest,
 )
 from dynamo_tpu.models import llama
+from dynamo_tpu.engine.spec import NgramProposer
 from dynamo_tpu.ops.sampling import (
     TOP_LOGPROBS_MAX,
     bump_counts,
     sample_tokens,
+    verify_draft_tokens,
 )
 from dynamo_tpu.parallel import mesh as meshmod
 from dynamo_tpu.runtime.pipeline.context import Context
@@ -76,12 +78,20 @@ class _Dispatch:
     """One in-flight decode dispatch: device tokens + the slot snapshot it
     was built from."""
 
-    __slots__ = ("out_dev", "snapshot", "steps")
+    __slots__ = ("out_dev", "snapshot", "steps", "spec", "pos0",
+                 "draft_lens")
 
-    def __init__(self, out_dev, snapshot, steps):
+    def __init__(self, out_dev, snapshot, steps, spec=False, pos0=None,
+                 draft_lens=None):
         self.out_dev = out_dev          # [steps, B] device array
         self.snapshot = snapshot        # list[(slot_index, Sequence)]
         self.steps = steps
+        # speculative verify dispatch: out_dev is (tokens [B, T],
+        # n_emit [B]); pos0/draft_lens are the per-slot positions and
+        # draft lengths the build used (rollback at sync needs them)
+        self.spec = spec
+        self.pos0 = pos0
+        self.draft_lens = draft_lens
 
 
 class _DecodeBuild:
@@ -91,9 +101,10 @@ class _DecodeBuild:
     __slots__ = ("positions", "tables", "act", "temp", "topk", "topp",
                  "fp", "prp", "rp", "seeds", "use_ext", "want_lps",
                  "want_tops", "overrides", "active", "steps", "all_greedy",
-                 "width")
+                 "width", "spec", "tokens", "draft", "dlen", "pos0")
 
     def __init__(self, **kw):
+        self.spec = False  # speculative verify build (host-built tokens)
         for k, v in kw.items():
             setattr(self, k, v)
 
@@ -237,6 +248,25 @@ class JaxEngine:
             and not self._sp and mc.pp == 1
         )
 
+        # self-speculative decoding (engine/spec.py): the verify step is
+        # a multi-query gather step — row-scatter KV write + the oracle
+        # attention over the slot matrix. int32-PACKED pools have no
+        # row-scatter path (a byte-level scatter into packed rows would
+        # corrupt pages) and pp's stage executor has no multi-query
+        # decode, so both gate it off loudly instead of corrupting.
+        if config.spec_decode:
+            if config.spec_k_max < 1:
+                raise ValueError("spec_k_max must be >= 1")
+            if mc.pp > 1:
+                raise ValueError("spec_decode unsupported with pp>1 (v1)")
+            if self._kv_packed:
+                raise ValueError(
+                    "spec_decode unsupported with int32-packed int8 KV "
+                    "pools (the pallas+int8 serving path): the verify "
+                    "step row-scatters KV mid-page. Use "
+                    "attn_backend='gather' or kv_quantization=None."
+                )
+
         # pipeline-parallel serving: pp > 1 runs the GPipe stage executor
         # (parallel/pipeline.py) — layers AND KV pools live stage-local;
         # gather attention (the pallas kernels are not pp-aware), no
@@ -371,6 +401,10 @@ class JaxEngine:
         self._ema_restore_bps: Optional[float] = None
         self._ema_prefill_tps: Optional[float] = None
         self.offload_gate_stats = {"restored": 0, "declined": 0}
+        # strong refs to fire-and-forget calibration tasks (the loop
+        # holds tasks only weakly; an unreferenced one can be GC'd
+        # mid-flight and silently drop its EMA update)
+        self._bg_tasks: set = set()
         if config.host_kv_pages:
             from dynamo_tpu.engine.offload import HostKvPool
 
@@ -426,6 +460,18 @@ class JaxEngine:
             "decode_sync_s": 0.0,
             "decode_tokens": 0,
             "decode_dispatches": 0,
+            # speculative decode: one spec dispatch = ONE model step that
+            # verifies up to spec_k_max drafted tokens per row;
+            # spec_rows = sequence-steps (rows x dispatches), so
+            # spec_emitted / spec_rows is the per-sequence effective
+            # tokens-per-model-step (non-speculative decode is 1.0)
+            "spec_dispatch_s": 0.0,
+            "spec_sync_s": 0.0,
+            "spec_dispatches": 0,
+            "spec_rows": 0,
+            "spec_drafted": 0,
+            "spec_accepted": 0,
+            "spec_emitted": 0,
         }
         # updates run in worker threads outside _kv_lock (serving prefill
         # + concurrent prefill_only dispatches) — guard the RMWs
@@ -457,6 +503,11 @@ class JaxEngine:
         # [B, V] int8 donated through the scan)
         self._decode_ext_fn = jax.jit(
             self._decode_multi, donate_argnums=(1, 13), static_argnums=(11, 12, 21)
+        )
+        # speculative verify: one multi-query step over [carry, drafts]
+        # with rejection-sampling acceptance (all_greedy static)
+        self._spec_fn = jax.jit(
+            self._spec_verify_step, donate_argnums=(1,), static_argnums=(12,)
         )
         # occurrence counts for penalty sampling, allocated on first use
         # (B x V int8; ~33 MB at B=256, V=128k)
@@ -648,6 +699,7 @@ class JaxEngine:
         lib/llm/src/kv_router/protocols.rs:43-54)."""
         active = sum(1 for s in self.slots if s is not None)
         usable = self.num_pages - 1
+        ps = self._phase_stats
         return {
             "request_active_slots": active,
             "request_total_slots": len(self.slots),
@@ -656,6 +708,16 @@ class JaxEngine:
             "num_requests_waiting": len(self.waiting),
             "gpu_cache_usage_perc": self.allocator.usage(),
             "gpu_prefix_cache_hit_rate": self.allocator.hit_rate(),
+            # speculative decode health (ForwardPassMetrics.from_dict
+            # drops unknown keys, so the router wire stays compatible)
+            "spec_acceptance_rate": (
+                ps["spec_accepted"] / ps["spec_drafted"]
+                if ps["spec_drafted"] else 0.0
+            ),
+            "spec_tokens_per_step": (
+                ps["spec_emitted"] / ps["spec_rows"]
+                if ps["spec_rows"] else 0.0
+            ),
         }
 
     # ------------------------------------------------------------------
@@ -901,6 +963,59 @@ class JaxEngine:
         if use_pen:
             return S, kv, counts
         return S, kv
+
+    def _spec_verify_step(self, params, kv, tokens, positions, block_tables,
+                          active, draft, draft_len, temp, topk, topp, key,
+                          all_greedy=False):
+        """One speculative verify step: every row carries `1 + draft_len`
+        candidate tokens — its decode carry plus the n-gram proposer's
+        drafts — through the model in ONE forward (tokens [B, T] with
+        T = spec_k_max + 1, padded per row), then rejection-sampling
+        acceptance (ops/sampling.verify_draft_tokens) emits the accepted
+        prefix plus one corrected/bonus token.
+
+        Attention is the chunked-prefill gather path (ops/attention.py):
+        multi-query positions over the sequence's slot matrix, KV written
+        first so each draft attends its accepted prefix — the same
+        unified-step contract prefill uses. Draft positions that end up
+        REJECTED leave garbage KV in their slots; that is sound because
+        the causal mask hides any slot beyond a query's position and the
+        next dispatches rewrite those slots before any query can reach
+        them (host-side num_computed/device_pos rewind keeps page
+        registration behind the accepted prefix).
+
+        Returns ((out_tokens [B, T], n_emit [B]), kv)."""
+        s = self.page_size
+        b, w = block_tables.shape
+        t = tokens.shape[1]
+        smat = (
+            block_tables[:, :, None] * s + jnp.arange(s, dtype=jnp.int32)
+        ).reshape(b, -1)
+        max_len = self.config.max_model_len
+        page_idx = jnp.minimum(positions // s, w - 1)
+        wslots = (
+            jnp.take_along_axis(block_tables, page_idx, axis=1) * s
+            + positions % s
+        )
+        # rows write [pos0, pos0 + draft_len]; padded columns, inactive
+        # rows and past-budget positions write the trash page
+        col_ok = jnp.arange(t)[None, :] <= draft_len[:, None]
+        wslots = jnp.where(
+            active[:, None] & col_ok & (positions < max_len), wslots, 0
+        ).astype(jnp.int32)
+        attn = llama.AttnSpec.gather(
+            smat, page_size=s, kv_tp=self.config.mesh.tp
+        )
+        hidden, kv = llama.forward(
+            params, self.model_cfg, tokens, positions, kv,
+            wslots.reshape(-1), attn,
+        )
+        lg = llama.logits(params, self.model_cfg, hidden)  # [B, T, V]
+        out, n_emit = verify_draft_tokens(
+            lg, draft, draft_len, key, temp, topk, topp,
+            all_greedy=all_greedy,
+        )
+        return (out, n_emit), kv
 
     # ------------------------------------------------------------------
     # engine protocol
@@ -1278,6 +1393,12 @@ class JaxEngine:
                 "prompt_tokens": seq.prompt_len,
             }
             self.slots[slot] = seq
+            if self.config.spec_decode and seq.spec is None:
+                # seed the n-gram index with the prompt once; the index
+                # survives preemption (the token history it covers does
+                # not change across a re-prefill)
+                seq.spec = NgramProposer(self.config.spec_ngram_max)
+                seq.spec.extend(seq.tokens)
             if seq.has_penalties:
                 self._count_prompt(seq)
             self._prefilling.append(seq)
@@ -1533,13 +1654,21 @@ class JaxEngine:
         return dict(self._phase_stats)
 
     def _any_mid_decode(self) -> bool:
-        """A stream is MID-DECODE only past its first token (generated >
-        1 — the admission gate's own wave definition). Decode-READY wave
-        members gated behind pending prefill groups must NOT count:
-        treating them as running decode would (a) deadlock the admission
-        batching window against the decode gate for a full window, and
-        (b) suppress the early first-token emits that keep wave TTFT
-        from waiting on the whole wave."""
+        """Is decode actually RUNNING? True when a decode dispatch is in
+        flight, or — covering the brief sync-to-build gap between
+        dispatches — when a stream has emitted past its first token.
+
+        generated == 1 wave members (first token from the prefill-group
+        fetch, no decode dispatched yet) deliberately do NOT count on
+        their own: treating them as mid-decode would (a) hold the
+        admission batching window against the decode_ready_frac gate
+        (which still sees a pure admission wave) for a full window, and
+        (b) suppress the sibling prefill groups' early first-token
+        emits. A generated == 1 stream whose decode IS under way is
+        caught by the in-flight test instead — the gap the bare
+        `generated > 1` predicate used to mislabel idle."""
+        if self._inflight is not None:
+            return True
         return any(
             s is not None and not s.prefilling and s.generated > 1
             for s in self.slots
@@ -1923,35 +2052,37 @@ class JaxEngine:
             # prompt cannot stall running streams.
             return None
 
+        if self._inflight is not None and self._inflight.spec:
+            # spec dispatches never pipeline: positions and carries for
+            # the NEXT dispatch are only known after sync. OUTSIDE the
+            # config check — a runtime spec_decode toggle-off must not
+            # let a normal dispatch launch from the stale host state
+            return None
+        if self.config.spec_decode:
+            bld = self._maybe_build_spec(ready)
+            if bld == "wait":
+                # worthwhile drafts exist but a normal dispatch is in
+                # flight: hold this build, let the sync land (advancing
+                # host history), and spec-dispatch next tick
+                return None
+            if bld is not None:
+                return bld
+
         # BUCKETED dispatch width: a fixed [max_batch] decode costs the
         # same device time at 3 live streams as at 256, which wrecks
         # TTFT/ITL under paced (non-burst) arrivals. Active slots are
         # low-packed (admission takes the first free slot), so the
         # power-of-two prefix covering the highest active slot bounds
         # compiled families to ~log2(max_batch/8)
-        b_needed = 1 + max(i for i, _ in ready)
-        b = 8
-        while b < b_needed:
-            b *= 2
-        b = min(b, len(self.slots))
         k_steps = self.config.decode_steps
         # ensure every ready sequence has pages for all positions this
         # dispatch will write: [device_pos, device_pos + k_steps)
-        for _, seq in ready:
-            if seq.slot < 0 or self.slots[seq.slot] is not seq:
-                continue  # preempted by an earlier victim pick this pass
-            upto = min(
-                seq.device_pos + k_steps - 1, self.config.max_model_len - 1
-            )
-            if not self._ensure_pages_through(seq, upto):
-                return None  # seq itself was preempted; retry next tick
-        active = [
-            (i, s)
-            for i, s in ready
-            if self.slots[i] is s and not s.prefilling
-        ]
-        if not active:
+        prep = self._grow_and_collect(
+            ready, lambda seq: seq.device_pos + k_steps - 1
+        )
+        if prep is None:
             return None
+        active, b = prep
 
         w = self.config.max_pages_per_seq
         positions = np.zeros(b, np.int32)
@@ -1996,14 +2127,128 @@ class JaxEngine:
             all_greedy=bool((temp[act] <= 0.0).all()) if act.any() else True,
         )
 
+    def _grow_and_collect(self, ready, upto):
+        """Shared decode-dispatch prep: grow pages through `upto(seq)`
+        (clamped to the last writable position; may preempt victims),
+        re-filter the rows that survived, and bucket the dispatch width
+        to the power-of-two prefix covering the highest active slot.
+        Returns (active, width) or None (a growth preempted its own
+        sequence, or nothing stayed decode-ready — retry next tick)."""
+        max_pos = self.config.max_model_len - 1
+        for _, seq in ready:
+            if seq.slot < 0 or self.slots[seq.slot] is not seq:
+                continue  # preempted by an earlier victim pick this pass
+            if not self._ensure_pages_through(seq, min(upto(seq), max_pos)):
+                return None
+        active = [
+            (i, s)
+            for i, s in ready
+            if self.slots[i] is s and not s.prefilling
+        ]
+        if not active:
+            return None
+        b_needed = 1 + max(i for i, _ in active)
+        b = 8
+        while b < b_needed:
+            b *= 2
+        return active, min(b, len(self.slots))
+
+    def _maybe_build_spec(self, ready):
+        """Host side of a speculative verify dispatch: propose n-gram
+        drafts for every decode-ready row and build the [B, k_max+1]
+        candidate-token window. Returns None (no worthwhile drafts —
+        take the normal path), "wait" (worthwhile drafts, but host state
+        is stale until the in-flight dispatch syncs), or a _DecodeBuild.
+
+        Feature gate: rows whose carry is still on device
+        (carry_pending) or that use penalties / per-request seeds /
+        logprobs keep the whole batch on the scan path — the verify
+        sampler covers plain greedy/temperature/top-k/top-p, which is
+        the serving hot path."""
+        for _, s in ready:
+            if (
+                s.carry_pending or s.has_penalties or s.seed >= 0
+                or s.want_logprobs or s.top_logprobs > 0
+            ):
+                return None
+        k_max = self.config.spec_k_max
+        drafts: dict[int, list[int]] = {}
+        total = 0
+        for i, seq in ready:
+            # never draft past the emit budget (the verify step emits at
+            # most draft_len+1 tokens) or the last writable position
+            remaining = seq.max_new_tokens - seq.generated
+            room = self.config.max_model_len - 1 - seq.device_pos
+            k_i = min(k_max, remaining - 1, room)
+            d = seq.spec.maybe_draft(k_i) if seq.spec is not None else []
+            drafts[i] = d
+            total += len(d)
+        # worthwhile only when the batch averages >= 1 drafted token per
+        # row: a spec dispatch is ONE model step for every row, so rows
+        # without drafts fall from decode_steps to 1 token per dispatch
+        if total < max(1, len(ready)):
+            return None
+        if self._inflight is not None:
+            return "wait"
+        prep = self._grow_and_collect(
+            ready, lambda seq: seq.device_pos + len(drafts.get(seq.slot, ()))
+        )
+        if prep is None:
+            return None
+        active, b = prep
+        t = k_max + 1
+        w = self.config.max_pages_per_seq
+        tokens = np.zeros((b, t), np.int32)
+        positions = np.zeros((b, t), np.int32)
+        tables = np.zeros((b, w), np.int32)
+        draft = np.zeros((b, k_max), np.int32)
+        dlen = np.zeros(b, np.int32)
+        pos0 = np.zeros(b, np.int32)
+        act = np.zeros(b, bool)
+        temp = np.zeros(b, np.float32)
+        topk = np.zeros(b, np.int32)
+        topp = np.ones(b, np.float32)
+        for i, seq in active:
+            d = drafts.get(i) or []
+            act[i] = True
+            pos0[i] = seq.device_pos
+            tokens[i, 0] = seq.last_token  # the host-known decode carry
+            if d:
+                tokens[i, 1:1 + len(d)] = d
+                draft[i, :len(d)] = d
+                dlen[i] = len(d)
+            positions[i] = seq.device_pos + np.arange(t, dtype=np.int32)
+            tables[i, : len(seq.page_ids)] = seq.page_ids
+            temp[i] = seq.temperature
+            topk[i] = seq.top_k
+            topp[i] = seq.top_p
+            # the host token window replaces the device carry; any
+            # stale override for this slot is already in host history
+            self._overrides.pop(i, None)
+        return _DecodeBuild(
+            spec=True, tokens=tokens, positions=positions, tables=tables,
+            draft=draft, dlen=dlen, pos0=pos0, act=act, temp=temp,
+            topk=topk, topp=topp, active=active, steps=1, width=b,
+            all_greedy=bool((temp[act] <= 0.0).all()),
+        )
+
     def _run_decode_dispatch(self, bld: "_DecodeBuild") -> _Dispatch:
         """The jax half of a decode dispatch — runs in a worker thread
         under _kv_lock (the loop awaits it before its own next kv use,
         but the public prefill_only path can dispatch concurrently)."""
         t0 = time.perf_counter()
         with self._kv_lock:
-            out = self._run_decode_dispatch_locked(bld)
+            if bld.spec:
+                out = self._run_spec_dispatch_locked(bld)
+            else:
+                out = self._run_decode_dispatch_locked(bld)
         with self._phase_lock:
+            if bld.spec:
+                self._phase_stats["spec_dispatch_s"] += (
+                    time.perf_counter() - t0
+                )
+                self._phase_stats["spec_dispatches"] += 1
+                return out
             self._phase_stats["decode_dispatch_s"] += (
                 time.perf_counter() - t0
             )
@@ -2015,6 +2260,28 @@ class JaxEngine:
                 int(np.sum(bld.act)) * bld.steps
             )
         return out
+
+    def _run_spec_dispatch_locked(self, bld: "_DecodeBuild") -> _Dispatch:
+        """Jax half of a speculative verify dispatch: one multi-query
+        model step + on-device acceptance. The device carry vector is
+        NOT updated (spec windows are host-built); sync re-arms the
+        carry for a following normal dispatch via an int override."""
+        self._key, sub = jax.random.split(self._key)
+        S, self.kv = self._spec_fn(
+            self.params, self.kv,
+            jnp.asarray(bld.tokens), jnp.asarray(bld.positions),
+            jnp.asarray(bld.tables), jnp.asarray(bld.act),
+            jnp.asarray(bld.draft), jnp.asarray(bld.dlen),
+            jnp.asarray(bld.temp), jnp.asarray(bld.topk),
+            jnp.asarray(bld.topp), sub, bld.all_greedy,
+        )
+        self._step_count += 1
+        for arr in S:
+            arr.copy_to_host_async()
+        return _Dispatch(
+            S, bld.active, bld.steps, spec=True, pos0=bld.pos0,
+            draft_lens=bld.dlen,
+        )
 
     def _run_decode_dispatch_locked(self, bld: "_DecodeBuild") -> _Dispatch:
         w = bld.width  # bucketed dispatch width (power of two >= highest
@@ -2141,9 +2408,15 @@ class JaxEngine:
             lambda: tuple(np.asarray(a) for a in d.out_dev)
         )  # (toks, lps[, top_ids, top_lps]) each [K+1, B(, 8)]
         with self._phase_lock:
-            self._phase_stats["decode_sync_s"] += (
-                time.perf_counter() - t_sync0
-            )
+            # keep the phase families separable: a spec verify step's
+            # fetch wall belongs with its dispatch wall, not in the
+            # scanned-decode sync ratio
+            self._phase_stats[
+                "spec_sync_s" if d.spec else "decode_sync_s"
+            ] += time.perf_counter() - t_sync0
+        if d.spec:
+            self._sync_spec(d, arrs)
+            return
         out, out_lps = arrs[0], arrs[1]
         tops = arrs[2:] if len(arrs) == 4 else None
 
@@ -2179,6 +2452,53 @@ class JaxEngine:
                     seq, int(out[step, i]), logprob=float(out_lps[step, i]),
                     tops=top_list(seq, step, i),
                 )
+
+    def _sync_spec(self, d: _Dispatch, arrs) -> None:
+        """Land a speculative verify dispatch: emit each row's accepted
+        prefix + corrected/bonus token, then REWIND the paged-cache
+        bookkeeping to the accepted length — num_computed, device_pos
+        and prefix-page registration advance only past tokens that were
+        actually emitted, so the garbage KV a rejected tail left in its
+        slots stays unregistered and is rewritten by the very next
+        dispatch before any query can attend it."""
+        toks, n_emit = arrs[0], arrs[1]  # [B, T] i32, [B] i32
+        drafted_total = accepted_total = emitted_total = rows = 0
+        for i, seq in d.snapshot:
+            if self.slots[i] is not seq:
+                continue  # finished/preempted meanwhile
+            rows += 1
+            n = int(n_emit[i])
+            drafted = int(d.draft_lens[i])
+            base = int(d.pos0[i])
+            emitted = 0
+            for j in range(n):
+                if self.slots[i] is not seq:
+                    break  # EOS/length mid-window: the tail is discarded
+                seq.num_computed += 1
+                seq.device_pos = base + j + 1
+                self._register_full_pages(seq)
+                self._append_token(seq, int(toks[i, j]))
+                emitted += 1
+            # counters reflect what actually LANDED: when an emitted
+            # draft finished the stream (EOS) the discarded tail — and
+            # the never-emitted bonus — must not inflate acceptance
+            accepted = n - 1 if emitted == n else emitted
+            if seq.spec is not None and drafted:
+                seq.spec.observe(drafted, accepted)
+            drafted_total += drafted
+            accepted_total += accepted
+            emitted_total += emitted
+            if self.slots[i] is seq:
+                # the last emitted token is the new decode carry; a
+                # following NORMAL dispatch consumes it via the int
+                # override scatter (spec windows are host-built and
+                # never touch the device carry vector)
+                self._overrides[i] = int(toks[i, n - 1])
+        with self._phase_lock:
+            self._phase_stats["spec_rows"] += rows
+            self._phase_stats["spec_drafted"] += drafted_total
+            self._phase_stats["spec_accepted"] += accepted_total
+            self._phase_stats["spec_emitted"] += emitted_total
 
     def _ensure_pages_through(self, seq: Sequence, upto_pos: int) -> bool:
         while upto_pos // self.page_size >= len(seq.page_ids):
@@ -2407,21 +2727,40 @@ class JaxEngine:
                 jnp.asarray(nks) if nks is not None else None,
                 jnp.asarray(nvs) if nvs is not None else None,
             )
+            # read-only probe enqueued right after the inject (still
+            # under the lock, so no donating dispatch can slip between):
+            # fencing IT observes the transfer completing without ever
+            # touching the donated pools after release
+            probe = self.kv.k[0][:1]
         self.allocator.register(
             page_ids,
             [(b.sequence_hash, b.local_hash) for b in blocks],
             parent_hash=blocks[0].parent_sequence_hash if blocks else None,
         )
-        # calibrate the restore gate on the measured wall (the inject
-        # enqueues async, but the jnp.asarray H2D puts serialize the
-        # tunnel — the wall is the latency a hit actually pays)
-        dt = max(time.perf_counter() - t_restore0, 1e-6)
-        bps = len(page_ids) * self._restore_page_bytes() / dt
-        self._ema_restore_bps = (
-            bps if self._ema_restore_bps is None
-            else 0.5 * self._ema_restore_bps + 0.5 * bps
-        )
         self.offload_gate_stats["restored"] += 1
+        n_restored = len(page_ids)
+
+        async def _calibrate() -> None:
+            # fence OFF the event loop: block_until_ready would stall
+            # every stream behind the whole device queue. The EMA only
+            # feeds the restore-vs-recompute gate, so stamping it a few
+            # ms late is free — measuring async ENQUEUE instead of the
+            # completed transfer is what biased the gate before.
+            try:
+                await asyncio.to_thread(jax.block_until_ready, probe)
+            except Exception:
+                log.exception("restore-gate calibration fence failed")
+                return
+            dt = max(time.perf_counter() - t_restore0, 1e-6)
+            bps = n_restored * self._restore_page_bytes() / dt
+            self._ema_restore_bps = (
+                bps if self._ema_restore_bps is None
+                else 0.5 * self._ema_restore_bps + 0.5 * bps
+            )
+
+        task = asyncio.get_running_loop().create_task(_calibrate())
+        self._bg_tasks.add(task)
+        task.add_done_callback(self._bg_tasks.discard)
 
     def _append_token(
         self, seq: Sequence, token: int,
@@ -2429,6 +2768,8 @@ class JaxEngine:
         extra_meta: Optional[dict] = None,
     ) -> None:
         seq.blocks.extend([token])
+        if seq.spec is not None:
+            seq.spec.extend([token])
         seq.generated += 1
         frame = EngineOutput(token_ids=[token])
         if seq.want_logprobs:

@@ -68,6 +68,11 @@ class Sequence:
     # disagg: (first_token, k [L,T,Kh*Hd], v) delivered by a remote prefill
     # worker — admission injects this into pages instead of computing it
     preloaded: Optional[tuple] = None
+    # self-speculative decoding: per-sequence n-gram proposer
+    # (engine/spec.NgramProposer), created at admission when the engine
+    # runs spec_decode; survives preemption (the token history it indexes
+    # does not change across a re-prefill)
+    spec: Optional[object] = None
     # multimodal: [T_img, D] embeddings replacing token lookups starting
     # at embeds_offset; embed sequences skip the prefix cache (block
     # hashes over placeholder ids would alias distinct images)

@@ -1,0 +1,108 @@
+"""Self-speculative decoding: n-gram draft proposal + adaptive gating.
+
+Prompt-lookup drafting (Saxena 2023, as adopted by vLLM's ngram proposer):
+the draft for a sequence's next k tokens is the continuation of the most
+recent PRIOR occurrence of its current n-gram suffix within its own token
+history.  Zero extra model weights — the right speculative-decoding shape
+for a 16 GB v5e chip where a separate draft model does not fit — and the
+verification step amortizes one full (memory-bandwidth-bound) model step
+over up to k+1 accepted tokens (Leviathan et al. 2023).
+
+The proposer is pure host-side bookkeeping, maintained incrementally from
+the engine's append path; the engine consumes `maybe_draft()` when building
+a decode dispatch and feeds acceptance results back through `observe()`.
+
+Adaptive gating: a per-sequence EMA of the acceptance rate turns drafting
+off (k -> 0, exactly today's non-speculative behavior) when the model keeps
+rejecting the lookups — text that LOOKS repetitive to the n-gram index but
+is not predictable to the model must never regress ITL.  A periodic probe
+draft lets a gated-off stream recover when its text becomes predictable
+again.  Text with no n-gram repeats never proposes at all, so the
+adversarial case costs nothing beyond the dict updates.
+"""
+
+from __future__ import annotations
+
+# EMA smoothing for the per-sequence acceptance rate.
+EMA_ALPHA = 0.35
+# Below this EMA acceptance rate drafting is gated off for the stream.
+GATE_THRESHOLD = 0.25
+# While gated off, retry one probe draft every this many decode steps so a
+# stream whose text turns predictable can re-enable itself.
+RETRY_EVERY = 32
+
+
+class NgramProposer:
+    """Incremental prompt-lookup index over one sequence's token history.
+
+    For every n in [1, ngram_max] the index maps the n-gram ENDING at a
+    past position to the index just after it (the continuation start).
+    N-grams ending at position i are registered when token i+1 arrives, so
+    every index entry has at least one continuation token and the lookup
+    of the current suffix always lands strictly before the sequence end.
+    """
+
+    __slots__ = (
+        "ngram_max", "history", "_index", "ema", "_cooldown",
+        "drafted", "accepted",
+    )
+
+    def __init__(self, ngram_max: int = 3):
+        self.ngram_max = max(1, ngram_max)
+        self.history: list[int] = []
+        self._index: dict[tuple, int] = {}
+        self.ema = 1.0          # optimistic start: first drafts calibrate it
+        self._cooldown = 0
+        self.drafted = 0        # lifetime counters (metrics)
+        self.accepted = 0
+
+    def extend(self, tokens) -> None:
+        """Append tokens, registering the n-grams they complete."""
+        h = self.history
+        idx = self._index
+        nmax = self.ngram_max
+        for t in tokens:
+            end = len(h)  # the new token's index
+            # n-grams ending at end-1 gain their first continuation token
+            # (the one being appended) — register them now, newest wins
+            for n in range(1, min(nmax, end) + 1):
+                idx[tuple(h[end - n:end])] = end
+            h.append(int(t))
+
+    def propose(self, k: int) -> list[int]:
+        """Longest-suffix prompt lookup: up to k continuation tokens from
+        the most recent prior occurrence of the current suffix."""
+        h = self.history
+        L = len(h)
+        if k <= 0 or L < 2:
+            return []
+        for n in range(min(self.ngram_max, L - 1), 0, -1):
+            cont = self._index.get(tuple(h[L - n:]))
+            if cont is not None:
+                return h[cont:cont + k]
+        return []
+
+    def maybe_draft(self, k: int) -> list[int]:
+        """Gated proposal: empty while the acceptance EMA is below the
+        gate, except a periodic probe. Once the countdown expires the
+        probe KEEPS proposing until a verify actually lands — only
+        `observe()` re-arms the countdown, so a build the engine
+        discards (e.g. while a dispatch is in flight) cannot eat the
+        probe and strand the stream gated off forever."""
+        if k <= 0:
+            return []
+        if self.ema < GATE_THRESHOLD and self._cooldown > 0:
+            self._cooldown -= 1
+            return []
+        return self.propose(k)
+
+    def observe(self, drafted: int, accepted: int) -> None:
+        """Feed one verification result back into the gate's EMA."""
+        if drafted <= 0:
+            return
+        self._cooldown = RETRY_EVERY
+        self.drafted += drafted
+        self.accepted += accepted
+        self.ema = (1.0 - EMA_ALPHA) * self.ema + EMA_ALPHA * (
+            accepted / drafted
+        )

@@ -272,6 +272,16 @@ def _attn_block(
         """Row-scatter this chunk's KV into the pools (ring and gather
         modes); int8 pools quantize the rows and scatter the scales in
         the tp-blocked pool layout."""
+        if kv_k.dtype == jnp.int32:
+            # int32-PACKED int8 pools (ops/quant.pack_kv_slots) carry 4
+            # quantized bytes per element: a row scatter of unpacked
+            # values here would silently corrupt whole pages. Packed
+            # pools are written only by the pallas page-scatter kernels.
+            raise ValueError(
+                "row-scatter KV write reached an int32-packed pool; "
+                "packed pools (pallas+int8 serving) must go through the "
+                "paged write kernel, not the gather/ring path"
+            )
         if quant:
             from dynamo_tpu.ops.quant import scatter_kv_scales
 

@@ -67,6 +67,41 @@ def _per_row_keys(base_key: jax.Array, seeds: jnp.ndarray, positions: jnp.ndarra
     return jax.vmap(row_key)(seeds, positions, batch_keys)
 
 
+def _shortlist_mask(scaled, top_k, top_p):
+    """THE sampling distribution, shared by `sample_tokens` and
+    `verify_draft_tokens` — speculative verification preserves the
+    sampled distribution only while both consult the exact same
+    shortlist + top-k/top-p mask, so keep this the single copy.
+
+    approx_max_k: TPU-native shortlist (exact top_k sorts the whole
+    vocab on the VPU — measurably slow at 128k). recall_target=0.95 on
+    a 64-wide shortlist is indistinguishable for sampling.
+
+    Takes scaled logits [N, V] with per-row top_k [N] / top_p [N];
+    returns (cand_ids [N, C] i32, masked shortlist logits [N, C] with
+    excluded candidates at -1e30)."""
+    v = scaled.shape[-1]
+    if jax.default_backend() == "tpu" and v > 4096:
+        cand_logits, cand_ids = jax.lax.approx_max_k(
+            scaled, min(CANDIDATES, v), recall_target=0.95
+        )
+    else:
+        cand_logits, cand_ids = jax.lax.top_k(scaled, min(CANDIDATES, v))
+    n = cand_logits.shape[-1]
+    ranks = jnp.arange(n)
+
+    k = jnp.where(top_k <= 0, n, jnp.minimum(top_k, n))
+    keep_k = ranks[None, :] < k[:, None]
+
+    probs = jax.nn.softmax(cand_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # keep tokens whose *preceding* cumulative mass is below p (always >= 1 token)
+    keep_p = (cum - probs) < top_p[:, None]
+
+    masked = jnp.where(keep_k & keep_p, cand_logits, -1e30)
+    return cand_ids.astype(jnp.int32), masked
+
+
 def sample_tokens(
     logits: jnp.ndarray,       # [B, V] float
     key: jax.Array,            # PRNG key
@@ -125,28 +160,7 @@ def sample_tokens(
     temp = jnp.where(is_greedy, 1.0, temperature)
     scaled = logits / temp[:, None]
 
-    # approx_max_k: TPU-native shortlist (exact top_k sorts the whole vocab
-    # on the VPU — measurably slow at 128k). recall_target=0.95 on a 64-wide
-    # shortlist is indistinguishable for sampling; greedy uses exact argmax.
-    if jax.default_backend() == "tpu" and v > 4096:
-        cand_logits, cand_ids = jax.lax.approx_max_k(
-            scaled, min(CANDIDATES, v), recall_target=0.95
-        )
-    else:
-        cand_logits, cand_ids = jax.lax.top_k(scaled, min(CANDIDATES, v))
-    n = cand_logits.shape[-1]
-    ranks = jnp.arange(n)
-
-    k = jnp.where(top_k <= 0, n, jnp.minimum(top_k, n))
-    keep_k = ranks[None, :] < k[:, None]
-
-    probs = jax.nn.softmax(cand_logits, axis=-1)
-    cum = jnp.cumsum(probs, axis=-1)
-    # keep tokens whose *preceding* cumulative mass is below p (always >= 1 token)
-    keep_p = (cum - probs) < top_p[:, None]
-
-    keep = keep_k & keep_p
-    masked = jnp.where(keep, cand_logits, -1e30)
+    cand_ids, masked = _shortlist_mask(scaled, top_k, top_p)
     if seeds is not None:
         keys = _per_row_keys(key, seeds, positions)
         choice = jax.vmap(lambda kk, row: jax.random.categorical(kk, row))(
@@ -162,6 +176,100 @@ def sample_tokens(
     if return_logprobs:
         return ids, picked_logprobs(ids)
     return ids
+
+
+def verify_draft_tokens(
+    logits: jnp.ndarray,       # [B, T, V] float; row j is the model's
+    #                            distribution for position pos0 + j + 1
+    draft: jnp.ndarray,        # [B, T-1] i32 drafted tokens
+    draft_len: jnp.ndarray,    # [B] i32 valid draft count per row (0..T-1)
+    key: jax.Array,
+    temperature: jnp.ndarray,  # [B] f32 (<= 0 treated as greedy)
+    top_k: jnp.ndarray,        # [B] i32 (<= 0 means disabled)
+    top_p: jnp.ndarray,        # [B] f32 (>= 1 means disabled)
+    all_greedy: bool = False,  # static: whole batch greedy
+):
+    """Speculative-decoding verification over a batch of drafted windows.
+
+    The engine ran ONE model step over [carry, d_1, .., d_k] and `logits`
+    holds the target distribution at every window position. Acceptance:
+
+    - greedy rows: exact match — d_j is accepted iff it equals the argmax
+      at position j-1, so the emitted stream is byte-identical to the
+      non-speculative engine;
+    - sampled rows: rejection sampling against the proposer's point-mass
+      draft q — accept d_j with probability p_j(d_j) (the same
+      shortlist/top-k/top-p-masked distribution `sample_tokens` uses),
+      and on rejection resample from p_j with d_j masked out (the exact
+      residual distribution for a point-mass q), so the emitted stream
+      has the same distribution as the non-speculative sampler.
+
+    After the leading accepted run of length a (bounded by draft_len) one
+    extra token is always emitted: the rejection resample at slot a, or —
+    when every draft was accepted — a bonus token from the unmodified
+    distribution at slot a. Returns (out_tokens [B, T] i32, n_emit [B]
+    i32 in [1, T]); out positions >= n_emit are garbage.
+    """
+    b, t, v = logits.shape
+    kd = t - 1
+    raw = logits.astype(jnp.float32)
+    greedy_ids = jnp.argmax(raw, axis=-1).astype(jnp.int32)  # [B, T]
+    valid = jnp.arange(kd)[None, :] < draft_len[:, None]     # [B, K]
+    g_match = (draft == greedy_ids[:, :kd]) & valid
+
+    if all_greedy:
+        # accepted drafts ARE the argmaxes, so the output at every
+        # position is just the argmax; only the emit count varies
+        lead = jnp.cumprod(g_match.astype(jnp.int32), axis=1)
+        return greedy_ids, jnp.sum(lead, axis=1).astype(jnp.int32) + 1
+
+    is_greedy = temperature <= 0.0
+    temp = jnp.where(is_greedy, 1.0, temperature)
+    scaled = raw / temp[:, None, None]
+
+    # the same CANDIDATES-wide shortlist + top-k/top-p mask the engine's
+    # sampler applies (ONE shared implementation — `_shortlist_mask` —
+    # so the preserved target distribution cannot drift from the one
+    # the non-speculative path actually samples from); per-row params
+    # repeat across the t window positions
+    cand_ids, masked = _shortlist_mask(
+        scaled.reshape(b * t, v),
+        jnp.repeat(top_k, t), jnp.repeat(top_p, t),
+    )
+    n = cand_ids.shape[-1]
+    cand_ids = cand_ids.reshape(b, t, n)
+    masked = masked.reshape(b, t, n)
+    p_masked = jax.nn.softmax(masked, axis=-1)  # [B, T, C]
+
+    key_u, key_r, key_b = jax.random.split(key, 3)
+    # acceptance: p_j(d_j) under the masked distribution (0 when the
+    # draft is outside the shortlist/top-k/top-p mask -> reject)
+    is_draft = cand_ids[:, :kd, :] == draft[:, :, None]      # [B, K, C]
+    p_draft = jnp.sum(jnp.where(is_draft, p_masked[:, :kd], 0.0), axis=-1)
+    u = jax.random.uniform(key_u, (b, kd))
+    accept = jnp.where(is_greedy[:, None], g_match, (u < p_draft) & valid)
+
+    lead = jnp.cumprod(accept.astype(jnp.int32), axis=1)     # [B, K]
+    a = jnp.sum(lead, axis=1).astype(jnp.int32)
+
+    # rejection resample at each draft slot: residual of a point-mass q
+    # is p with d_j removed, renormalized
+    masked_r = jnp.where(is_draft, -1e30, masked[:, :kd])
+    r_choice = jax.random.categorical(key_r, masked_r, axis=-1)
+    r_ids = jnp.take_along_axis(
+        cand_ids[:, :kd], r_choice[..., None], axis=-1
+    )[..., 0]
+    # bonus sample at every slot (used at slot a when a == draft_len)
+    b_choice = jax.random.categorical(key_b, masked, axis=-1)
+    b_ids = jnp.take_along_axis(cand_ids, b_choice[..., None], axis=-1)[..., 0]
+    r_ids = jnp.where(is_greedy[:, None], greedy_ids[:, :kd], r_ids)
+    b_ids = jnp.where(is_greedy[:, None], greedy_ids, b_ids)
+
+    head = jnp.where(
+        lead.astype(bool), draft, jnp.where(valid, r_ids, b_ids[:, :kd])
+    )
+    out = jnp.concatenate([head, b_ids[:, kd:]], axis=1).astype(jnp.int32)
+    return out, a + 1
 
 
 def count_tokens(

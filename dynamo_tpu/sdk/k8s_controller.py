@@ -225,6 +225,10 @@ class CrdController:
         # Supervisor (graceful teardown — sdk/operator.py _teardown)
         await self._hub.kv_del(key)
         self._applied.pop(key, None)
+        # drop the generation watermark too: leaving it would both leak
+        # an entry per deleted CR and suppress the Applied status update
+        # if the CR is ever recreated at the same generation
+        self._status_gen.pop(key, None)
         log.info("removed %s (operator will drain)", key)
 
     async def _status(
@@ -272,6 +276,7 @@ class CrdController:
                         if owned:
                             await self._hub.kv_del(key)
                             self._applied.pop(key, None)
+                            self._status_gen.pop(key, None)
                             log.info("pruned orphaned %s", key)
                     rv = (listing.get("metadata") or {}).get(
                         "resourceVersion", "0"

@@ -239,6 +239,41 @@ def test_spec_doc_mapping():
 
 
 
+async def test_remove_clears_generation_watermark():
+    """_remove must pop the per-CR generation watermark alongside the
+    applied-spec cache: leaving it both leaks an entry per deleted CR
+    and suppresses the Reconciled status if the CR is recreated at the
+    same generation."""
+    hub = HubServer()
+    await hub.start()
+    client = await HubClient.connect(f"127.0.0.1:{hub.port}")
+    statuses = []
+
+    ctl = CrdController(api=None, hub_addr=f"127.0.0.1:{hub.port}")
+    ctl._hub = client
+
+    async def record_status(cr, phase, message, generation=None):
+        statuses.append((cr["metadata"]["name"], phase, generation))
+
+    ctl._status = record_status
+    cr = _cr("churn", "graphs/a.py:Frontend", generation=7)
+    try:
+        await ctl._reconcile(cr)
+        key = doc_key(cr)
+        assert key in ctl._applied and key in ctl._status_gen
+        await ctl._remove(cr)
+        assert key not in ctl._applied
+        assert key not in ctl._status_gen  # the leak under test
+        assert (await client.kv_get(key)) is None
+        # recreate at the SAME generation: must re-apply and re-report
+        await ctl._reconcile(cr)
+        assert (await client.kv_get(key)) is not None
+        assert statuses.count(("churn", "Reconciled", 7)) == 2
+    finally:
+        await client.close()
+        await hub.stop()
+
+
 async def test_restart_prunes_orphans_but_not_cli_specs():
     """A CR deleted while the controller was DOWN must be pruned on the
     next start (hub scan by managed-by marker); specs applied via the
