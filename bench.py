@@ -49,6 +49,18 @@ SPEC = os.environ.get("BENCH_SPEC", "") not in ("", "0")
 SPEC_K = int(os.environ.get("BENCH_SPEC_K", "4"))
 SPEC_NGRAM = int(os.environ.get("BENCH_SPEC_NGRAM", "3"))
 SPEC_OSL = int(os.environ.get("BENCH_SPEC_OSL", str(max(OSL, 128))))
+# BENCH_MIXED=1: stall-free mixed batching A/B — hold N streams in
+# steady decode, inject an admission wave of fresh prompts, and record
+# the held streams' decode ITL p50/p99 DURING the wave plus the wave's
+# TTFT, mixed batching off then on (runtime toggle, same engine).
+# NOTE: mixed batching is incompatible with the packed pallas+int8 KV
+# pools (the engine degrades to normal paths and the A/B reads ~1x) —
+# on TPU run it with BENCH_KV_QUANT=none.
+MIXED = os.environ.get("BENCH_MIXED", "") not in ("", "0")
+MIXED_TOKENS = int(os.environ.get("BENCH_MIXED_TOKENS", "1024"))
+MIXED_HELD = int(os.environ.get("BENCH_MIXED_HELD", "8"))
+MIXED_WAVE = int(os.environ.get("BENCH_MIXED_WAVE", "16"))
+MIXED_OSL = int(os.environ.get("BENCH_MIXED_OSL", str(max(OSL, 128))))
 
 ENV_HELP = """bench.py — serving benchmark; configuration via env vars:
   BENCH_MODEL                  preset override (auto-picked from HBM)
@@ -68,6 +80,16 @@ ENV_HELP = """bench.py — serving benchmark; configuration via env vars:
   BENCH_SPEC_OSL               output length of the spec A/B waves
                                (max(BENCH_OSL, 128))
   BENCH_SPEC_CONC              concurrency of the spec A/B waves (32)
+  BENCH_MIXED=1                mixed-batching A/B: held-decode ITL
+                               p50/p99 during an admission wave, mixed
+                               off vs on (off by default; on TPU pair
+                               with BENCH_KV_QUANT=none — packed int8
+                               pools cannot run mixed steps)
+  BENCH_MIXED_TOKENS           mixed step token budget (1024)
+  BENCH_MIXED_HELD             streams held in steady decode (8)
+  BENCH_MIXED_WAVE             admission-wave prompt count (16)
+  BENCH_MIXED_OSL              held streams' output length
+                               (max(BENCH_OSL, 128))
 """
 
 
@@ -106,7 +128,11 @@ def main() -> None:
             model=cfg,
             dtype="bfloat16",
             max_batch_size=concurrency,
-            max_model_len=ISL + (max(OSL, SPEC_OSL) if SPEC else OSL) + 32,
+            max_model_len=ISL + max(
+                OSL,
+                SPEC_OSL if SPEC else 0,
+                MIXED_OSL if MIXED else 0,
+            ) + 32,
             prefill_chunk=ISL,
             decode_steps=DECODE_STEPS,
             prefill_group_tokens=prefill_group,
@@ -119,6 +145,13 @@ def main() -> None:
             spec_decode=SPEC,
             spec_k_max=SPEC_K,
             spec_ngram_max=SPEC_NGRAM,
+            # mixed-batching A/B: the flag itself is a per-tick host
+            # decision toggled per wave below; only the budget is fixed
+            # at init (spec and mixed are mutually exclusive, so the
+            # A/Bs cannot both be armed at init — BENCH_SPEC wins there
+            # and BENCH_MIXED still works via the runtime toggle)
+            mixed_batching=False,
+            mixed_step_tokens=MIXED_TOKENS,
             # int8-KV pallas kernels put page tokens in lanes
             page_size=128 if KV_QUANT else 64,
             # HBM->host offload tier ON (the reference baselines run with
@@ -318,6 +351,126 @@ def main() -> None:
                 "speedup": round(wall_off / wall_on, 3),
             }
 
+        async def mixed_ab():
+            """Stall-free mixed batching A/B: MIXED_HELD streams held in
+            steady decode, then MIXED_WAVE fresh prompts injected as one
+            admission wave. Reports the held streams' inter-token gaps
+            DURING the wave (p50/p99 — the p99 IS the admission stall)
+            and the wave's TTFT, mixed off then on. Fresh random prompts
+            per wave: no prefix-cache hits, no draftable n-grams."""
+
+            async def held_one(prompt, record):
+                pre = PreprocessedRequest(
+                    token_ids=prompt,
+                    stop_conditions=StopConditions(
+                        max_tokens=MIXED_OSL, ignore_eos=True
+                    ),
+                    sampling_options=SamplingOptions(greedy=True),
+                )
+                # bind the LIVE list before streaming: the wave launcher
+                # polls it to detect steady decode
+                ticks = record["ticks"] = []
+                async for frame in await engine.generate(
+                    Context(pre.to_dict())
+                ):
+                    if frame.get("token_ids"):
+                        ticks.append(time.perf_counter())
+
+            def prompts(k):
+                return [
+                    rng.randint(1, cfg.vocab_size, size=ISL).tolist()
+                    for _ in range(k)
+                ]
+
+            async def run_wave(on):
+                engine.config.mixed_batching = on
+                held_recs = [dict() for _ in range(MIXED_HELD)]
+                t_all0 = time.perf_counter()
+                tasks = [
+                    asyncio.create_task(held_one(p, r))
+                    for p, r in zip(prompts(MIXED_HELD), held_recs)
+                ]
+                # wait for steady decode: every held stream past its
+                # first few tokens before the wave lands. A held task
+                # dying here would otherwise spin this poll forever —
+                # surface its error instead.
+                while not all(
+                    len(r.get("ticks", ())) >= 4 for r in held_recs
+                ):
+                    for t in tasks:
+                        if t.done() and t.exception() is not None:
+                            raise t.exception()
+                    await asyncio.sleep(0.02)
+                wave_recs = [dict() for _ in range(MIXED_WAVE)]
+                t_w0 = time.perf_counter()
+                await asyncio.gather(*(
+                    one(p, r) for p, r in zip(prompts(MIXED_WAVE), wave_recs)
+                ))
+                t_w1 = time.perf_counter()
+                await asyncio.gather(*tasks)
+                wall_all = time.perf_counter() - t_all0
+                gaps = []
+                for r in held_recs:
+                    ts = r["ticks"]
+                    for a, b in zip(ts, ts[1:]):
+                        # gaps overlapping the admission-wave window
+                        if b >= t_w0 and a <= t_w1:
+                            gaps.append(b - a)
+                toks = MIXED_HELD * MIXED_OSL + sum(
+                    r["tokens"] for r in wave_recs
+                )
+
+                def pct(vals, q):
+                    # gaps can be empty when the held streams drained
+                    # before the wave landed (MIXED_OSL too short for
+                    # this rig) — report None rather than crash
+                    return (
+                        round(float(np.percentile(vals, q)), 4)
+                        if len(vals) else None
+                    )
+
+                return {
+                    "wave_itl_p50_s": pct(gaps, 50),
+                    "wave_itl_p99_s": pct(gaps, 99),
+                    "wave_ttft_p50_s": pct(
+                        [r["ttft"] for r in wave_recs], 50
+                    ),
+                    "toks_per_sec_chip": round(toks / wall_all / n_chips, 1),
+                }
+
+            # warm both modes with a FULL held+wave cycle: mixed step
+            # families ([pow2 rows, bucket] + the ragged attention path)
+            # only compile when decode rows and prefill chunks actually
+            # coexist — a plain warm wave never builds them, and the
+            # measured ON wave would pay the compiles as fake stalls
+            for on in (False, True):
+                await run_wave(on)
+            ps_a = engine.phase_stats
+            off = await run_wave(False)
+            on = await run_wave(True)
+            ps_b = engine.phase_stats
+            engine.config.mixed_batching = False
+            d = {k: ps_b[k] - ps_a[k] for k in ps_a}
+            return {
+                "step_tokens": MIXED_TOKENS,
+                "held_streams": MIXED_HELD,
+                "wave_prompts": MIXED_WAVE,
+                "held_osl": MIXED_OSL,
+                "off": off,
+                "on": on,
+                "mixed_steps": d["mixed_steps"],
+                "mixed_decode_rows": d["mixed_decode_rows"],
+                "mixed_prefill_tokens": d["mixed_prefill_tokens"],
+                "decode_stall_saved_s": round(
+                    d["mixed_decode_stall_saved_s"], 3
+                ),
+                "itl_p99_speedup": (
+                    round(off["wave_itl_p99_s"] / on["wave_itl_p99_s"], 3)
+                    if off["wave_itl_p99_s"] and on["wave_itl_p99_s"]
+                    else None
+                ),
+            }
+
         if FAST:
             probe = rng.randint(1, cfg.vocab_size, size=ISL).tolist()
             cold, warm = {}, {}
@@ -329,6 +482,7 @@ def main() -> None:
                 {"ttft": _probe_ratio(cold, warm), "wall": None},
                 [], 0.0, 0.0, [], 0.0, 0.0, None,
                 await spec_ab() if SPEC else None,
+                await mixed_ab() if MIXED else None,
             )
 
         # prefix-cache TTFT probe, WAVE-based (BASELINE.md: KV-aware
@@ -459,6 +613,7 @@ def main() -> None:
             hi_records, hi_rate, hi_wall,
             offload_speedup,
             await spec_ab() if SPEC else None,
+            await mixed_ab() if MIXED else None,
         )
 
     (
@@ -469,6 +624,7 @@ def main() -> None:
         hi_records, hi_rate, hi_wall,
         offload_speedup,
         spec_result,
+        mixed_result,
     ) = asyncio.run(run())
     total_tokens = sum(r["tokens"] for r in records)
     toks_per_sec_chip = total_tokens / wall / n_chips
@@ -590,6 +746,11 @@ def main() -> None:
                     # BENCH_SPEC=1: repetitive-text A/B, spec off vs on
                     **({} if spec_result is None else {
                         "spec": spec_result,
+                    }),
+                    # BENCH_MIXED=1: admission-wave A/B, mixed batching
+                    # off vs on (held-decode ITL during the wave)
+                    **({} if mixed_result is None else {
+                        "mixed": mixed_result,
                     }),
                 },
             }

@@ -1,0 +1,90 @@
+"""Version shims over jax API drift.
+
+The library targets the current jax surface (`jax.shard_map`,
+`jax.set_mesh`); older installs (jax 0.4.x) spell those
+`jax.experimental.shard_map.shard_map(..., check_rep=)` and have no
+ambient-mesh setter at all (the `Mesh` object itself is the context
+manager). Routing every call site through this module keeps the library
+importable across that drift without pinning jax — the shim resolves the
+best available spelling ONCE at import.
+
+Only the two attributes the codebase actually uses are shimmed; anything
+else drifting should be added here, not worked around inline.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+
+import jax
+
+__all__ = [
+    "axis_size",
+    "set_mesh",
+    "shard_map",
+    "tpu_compiler_params",
+    "tpu_hbm_memory_space",
+]
+
+
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+else:  # jax < 0.5: experimental namespace, `check_rep` instead of `check_vma`
+    from jax.experimental.shard_map import shard_map as _shard_map_legacy
+
+    @functools.wraps(_shard_map_legacy)
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True, **kw):
+        kw.setdefault("check_rep", check_vma)
+        return _shard_map_legacy(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw
+        )
+
+
+def tpu_hbm_memory_space():
+    """The "operand stays in HBM, the kernel DMAs it manually" memory
+    space across two renames: current jax spells it
+    `pltpu.MemorySpace.HBM`; 0.4.x has `pltpu.TPUMemorySpace` whose
+    closest member is `ANY` (the classic spelling for
+    compiler-placed/HBM operands)."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    ms = getattr(pltpu, "MemorySpace", None) or pltpu.TPUMemorySpace
+    return getattr(ms, "HBM", None) or ms.ANY
+
+
+def tpu_compiler_params(**kw):
+    """Pallas TPU compiler-params across the rename: current jax spells
+    it `pltpu.CompilerParams`, 0.4.x `pltpu.TPUCompilerParams` (same
+    fields)."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+    return cls(**kw)
+
+
+if hasattr(jax.lax, "axis_size"):
+    axis_size = jax.lax.axis_size
+else:
+
+    def axis_size(axis_name):
+        """Size of a mapped axis inside shard_map/pmap (jax < 0.5 has no
+        `jax.lax.axis_size`; `psum(1, axis)` is the classic spelling and
+        folds to a trace-time constant)."""
+        return jax.lax.psum(1, axis_name)
+
+
+if hasattr(jax, "set_mesh"):
+    set_mesh = jax.set_mesh
+else:
+
+    def set_mesh(mesh):
+        """Ambient-mesh context for jax < 0.5.
+
+        There, `Mesh` is itself a context manager (it installs the
+        resource env GSPMD consults); NamedSharding-driven jit does not
+        otherwise need an ambient mesh, so entering the mesh is the
+        faithful equivalent of the modern `jax.set_mesh`."""
+        if mesh is None:
+            return contextlib.nullcontext()
+        return mesh

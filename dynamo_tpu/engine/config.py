@@ -74,6 +74,32 @@ class EngineConfig:
     spec_decode: bool = False
     spec_k_max: int = 4       # max drafted tokens per verify step
     spec_ngram_max: int = 3   # longest suffix n-gram the proposer matches
+    # stall-free mixed batching (Sarathi-style): whenever decode-ready
+    # rows and pending prefill chunks coexist, pack both into ONE
+    # token-budgeted model step — decode rows ride as q_len=1 rows next
+    # to the prefill chunks, so an admission wave never stalls running
+    # decode streams for longer than one budgeted step. Mutually
+    # exclusive with spec_decode (v1); unsupported with pp>1, sp>1 and
+    # the int32-packed pallas+int8 KV pools (the mixed step row-scatters
+    # KV mid-page). Runtime-togglable like spec_decode: incompatible
+    # engines just never build a mixed step (logged once).
+    mixed_batching: bool = False
+    # token budget of one mixed step: decode rows cost 1 each, prefill
+    # chunks shrink to fit the leftover (non-final chunks round down to
+    # a page multiple). Bounds how long one step can stall decode — the
+    # knob that trades ITL (smaller) against prefill throughput (larger).
+    # NOTE the budget counts REAL tokens; the dispatch itself is a dense
+    # [pow2 rows, chunk-bucket] rectangle, so each decode row also pays
+    # bucket-width padded compute (masked in attention, real in the
+    # MLP). The per-step wall is bounded either way — a ragged kernel
+    # that skips padded query tiles is the named follow-up
+    # (ops/pallas_attention.ragged_paged_attention).
+    mixed_step_tokens: int = 1024
+    # True: decode rows always join and prefill shrinks around them
+    # (latency-leaning, the stall-free default). False: prefill chunks
+    # keep their full size and decode rows join only when the budget has
+    # room left (throughput-leaning; decode may wait a step).
+    mixed_decode_priority: bool = True
     # admission batching window for PACED arrivals: when decode streams
     # are running and fewer than `prefill_batch_min_rows` sequences are
     # pending prefill, hold the prefill dispatch up to this many seconds

@@ -50,6 +50,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from dynamo_tpu import compat
 from dynamo_tpu.engine.allocator import PageAllocator
 from dynamo_tpu.engine.config import EngineConfig
 from dynamo_tpu.engine.scheduler import Sequence
@@ -272,6 +273,21 @@ class JaxEngine:
         # gather attention (the pallas kernels are not pp-aware), no
         # disagg extract/inject or host offload in pp mode (v1)
         self._pp = mc.pp > 1
+        # stall-free mixed batching (docs/architecture.md "Stall-free
+        # mixed batching"): decode rows ride chunked-prefill steps as
+        # q_len=1 rows of one token-budgeted dispatch. The flag is
+        # runtime-togglable like spec_decode; explicit misconfiguration
+        # at init fails fast, a runtime toggle on an incompatible engine
+        # just never builds a mixed step (logged once, _mixed_tick).
+        self._mixed_warned = False
+        # tripped (with a loud log) when a mixed dispatch fails: the
+        # engine degrades to the contained normal paths permanently
+        # rather than retrying a broken compiled family every tick
+        self._mixed_disabled = False
+        if config.mixed_batching:
+            why = self._mixed_unsupported_reason()
+            if why:
+                raise ValueError(why)
         if self._pp and self._sp:
             raise ValueError("pp>1 with sp>1 unsupported (v1)")
         if self._pp:
@@ -472,6 +488,23 @@ class JaxEngine:
             "spec_drafted": 0,
             "spec_accepted": 0,
             "spec_emitted": 0,
+            # mixed prefill+decode steps (stall-free batching): one
+            # mixed_step = ONE dispatch carrying mixed_decode_rows
+            # decode rows (1 budget token each) + mixed_prefill_tokens
+            # chunk tokens; tokens_max is the largest per-step budget
+            # use (the scheduler must keep it <= mixed_step_tokens).
+            # decode_stall_saved_s approximates the decode stall the
+            # piggybacked steps avoided: the dispatch+fetch wall of every
+            # mixed step that carried decode rows — exactly the window
+            # those rows would have spent parked behind a separate
+            # prefill dispatch on the donated cache.
+            "mixed_dispatch_s": 0.0,
+            "mixed_sync_s": 0.0,
+            "mixed_steps": 0,
+            "mixed_decode_rows": 0,
+            "mixed_prefill_tokens": 0,
+            "mixed_step_tokens_max": 0,
+            "mixed_decode_stall_saved_s": 0.0,
         }
         # updates run in worker threads outside _kv_lock (serving prefill
         # + concurrent prefill_only dispatches) — guard the RMWs
@@ -508,6 +541,12 @@ class JaxEngine:
         # with rejection-sampling acceptance (all_greedy static)
         self._spec_fn = jax.jit(
             self._spec_verify_step, donate_argnums=(1,), static_argnums=(12,)
+        )
+        # mixed prefill+decode step: decode rows (q_len=1, host-known
+        # carry) + prefill chunk rows in ONE [n, T] ragged dispatch;
+        # every row samples at its last valid column (all_greedy static)
+        self._mixed_fn = jax.jit(
+            self._mixed_model_step, donate_argnums=(1,), static_argnums=(12,)
         )
         # occurrence counts for penalty sampling, allocated on first use
         # (B x V int8; ~33 MB at B=256, V=128k)
@@ -556,7 +595,7 @@ class JaxEngine:
                 )
                 if self._attn_mesh is not None:
                     P = jax.sharding.PartitionSpec
-                    wr = jax.shard_map(
+                    wr = compat.shard_map(
                         wr,
                         mesh=self._attn_mesh,
                         in_specs=(
@@ -718,6 +757,12 @@ class JaxEngine:
                 ps["spec_emitted"] / ps["spec_rows"]
                 if ps["spec_rows"] else 0.0
             ),
+            # stall-free mixed batching health (see _phase_stats):
+            # steps taken, decode rows that rode them instead of
+            # stalling, and prefill tokens computed inside them
+            "mixed_steps": ps["mixed_steps"],
+            "mixed_decode_rows": ps["mixed_decode_rows"],
+            "mixed_prefill_tokens": ps["mixed_prefill_tokens"],
         }
 
     # ------------------------------------------------------------------
@@ -1017,6 +1062,48 @@ class JaxEngine:
         )
         return (out, n_emit), kv
 
+    def _mixed_model_step(self, params, kv, tokens, positions, write_slots,
+                          slot_matrix, last_idx, temp, topk, topp, key,
+                          btables, all_greedy=False):
+        """One MIXED prefill+decode step — the stall-free batching
+        dispatch (Sarathi-style): tokens [n, T] where decode rows carry
+        their host-known last token at q_len=1 and prefill rows carry
+        one chunk, per-row query lengths `last_idx + 1`. KV is written
+        first, each row attends its own slots under the causal mask
+        (the unified-step contract, ops/attention.py), and every row
+        samples at its last valid column — decode rows' sample is their
+        next token, final-chunk rows' sample is their first token,
+        non-final chunk rows' sample is garbage the sync discards.
+
+        Attention backends: the gather oracle with ragged `q_lens`
+        everywhere; on pallas engines a row-scatter KV write + the
+        ragged flash kernel (`btables` set; the page-granular prefill
+        scatter cannot express a decode row's mid-page write, see
+        llama._attn_block). Returns (sampled [n], kv)."""
+        if btables is not None:
+            attn = llama.AttnSpec.gather(
+                None, page_size=self.page_size,
+                interpret=self._attn_interpret, mesh=self._attn_mesh,
+                block_tables=btables, q_pos0=positions[:, 0],
+                lengths=last_idx + 1, kv_tp=self.config.mesh.tp,
+            )
+        else:
+            attn = llama.AttnSpec.gather(
+                slot_matrix, page_size=self.page_size,
+                lengths=last_idx + 1, kv_tp=self.config.mesh.tp,
+            )
+        hidden, kv = llama.forward(
+            params, self.model_cfg, tokens, positions, kv, write_slots, attn
+        )
+        last_h = jnp.take_along_axis(
+            hidden, last_idx[:, None, None].astype(jnp.int32), axis=1
+        )[:, 0]  # [n, D]
+        lg = llama.logits(params, self.model_cfg, last_h)
+        toks = sample_tokens(
+            lg, key, temp, topk, topp, all_greedy=all_greedy
+        )
+        return toks, kv
+
     # ------------------------------------------------------------------
     # engine protocol
 
@@ -1310,6 +1397,17 @@ class JaxEngine:
                 # their pages before this tick's admission can evict them
                 self._maybe_start_offload()
                 progressed = self._admit_new()
+                # stall-free mixed step first: when decode-ready rows
+                # and pending prefill chunks coexist, ONE token-budgeted
+                # dispatch advances both planes and the normal
+                # prefill/decode ticks stand down ("hold" = an in-flight
+                # decode dispatch must sync before the host-built mixed
+                # window is current; it lands below, mixed runs next
+                # tick)
+                mixed = None
+                if self.config.mixed_batching:
+                    mixed = await self._mixed_tick()
+                    progressed |= mixed is True
                 # per tick: prefill chunks enqueue first (they own self.kv
                 # until their dispatch call returns), then decode dispatch
                 # N+1 runs in a worker thread WHILE the loop fetches
@@ -1317,9 +1415,12 @@ class JaxEngine:
                 # call until prior work drains, so dispatch and the
                 # result-fetch RTT must overlap in separate threads or
                 # the loop serializes at ~2x device time per dispatch
-                progressed |= await self._prefill_tick()
+                if mixed is None:
+                    progressed |= await self._prefill_tick()
                 new_task = None
-                snapshot = self._maybe_dispatch_decode()
+                snapshot = (
+                    self._maybe_dispatch_decode() if mixed is None else None
+                )
                 if snapshot is not None:
                     new_task = asyncio.create_task(
                         asyncio.to_thread(self._run_decode_dispatch, snapshot)
@@ -1611,13 +1712,24 @@ class JaxEngine:
                     len(seqs),
                 )
                 # contain the failure to the offending request(s): retry
-                # each sequence in its own dispatch
+                # each sequence in its own dispatch — with ITS OWN
+                # bucket: the failed group's bucket was sized to the
+                # group's largest chunk, and pushing a short chunk
+                # through that oversized compiled family would both
+                # waste the padded compute and (worse) retrace a family
+                # the engine never otherwise builds
                 for seq in seqs:
+                    b1 = self._bucket_for(
+                        min(
+                            seq.total_tokens - seq.num_computed,
+                            self.config.prefill_chunk,
+                        )
+                    )
                     try:
                         tok1 = await asyncio.to_thread(
-                            self._prefill_group_dispatch, [seq], bucket
+                            self._prefill_group_dispatch, [seq], b1
                         )
-                        self._note_prefilled([seq], bucket)
+                        self._note_prefilled([seq], b1)
                     except Exception:
                         log.exception("prefill of seq %s failed", seq.seq_id)
                         self._finish(seq, FINISH_REASON_ERROR)
@@ -2018,7 +2130,355 @@ class JaxEngine:
             return int(first_token)
         return None
 
+    # ---- mixed prefill+decode steps (stall-free batching) -------------
+
+    def _mixed_unsupported_reason(self) -> Optional[str]:
+        """None when mixed steps can run on this engine, else the reason
+        — init raises it for an explicit misconfig, the runtime toggle
+        logs it once and keeps the normal paths."""
+        if self.config.spec_decode:
+            return "mixed_batching and spec_decode are mutually exclusive (v1)"
+        if self._pp:
+            return "mixed_batching unsupported with pp>1 (v1)"
+        if self._sp:
+            return (
+                "mixed_batching unsupported with sp>1: ring attention "
+                "prefills whole prompts in one pass — there is no chunk "
+                "for decode rows to ride"
+            )
+        if self._kv_packed:
+            return (
+                "mixed_batching unsupported with int32-packed int8 KV "
+                "pools (the pallas+int8 serving path): the mixed step "
+                "row-scatters KV mid-page. Use attn_backend='gather' or "
+                "kv_quantization=None."
+            )
+        if self.config.mixed_step_tokens < 1:
+            return "mixed_step_tokens must be >= 1"
+        return None
+
+    def _mixed_eligible_decode(self) -> Optional[list]:
+        """Decode-ready rows a mixed step can carry (with the
+        cancellation sweep _maybe_dispatch_decode would have run), or
+        None when the whole batch must take the normal paths this tick:
+        penalties / per-request seeds / logprobs rows need the extended
+        sampler (same hot-path gate as spec decode), and a pending
+        device-side carry with no fetch in flight can only be emitted by
+        a normal decode sync."""
+        ready = self._decode_ready_rows()
+        rows = []
+        for i, s in ready:
+            if s.needs_ext_sampling:
+                return None
+            if s.carry_pending:
+                if s.first_task is not None and not s.first_task.done():
+                    # first token lands shortly (group fetch in flight);
+                    # the row joins the next mixed step
+                    continue
+                return None
+            rows.append((i, s))
+        return rows
+
+    def _select_mixed_prefill(self, leftover: int) -> list:
+        """Strict FIFO prefix of the prefill queue fitting `leftover`
+        budget tokens: each pick is (seq, chunk); a NON-final chunk
+        rounds DOWN to a page multiple (the following chunk must start
+        page-aligned — the prefill write paths' contract). Scanning
+        stops at the first sequence that cannot join (budget-starved,
+        disagg KV injection, multimodal embeds): skipping it would let
+        later arrivals jump the FIFO order and starve it for as long as
+        decode traffic keeps mixed steps running."""
+        picks = []
+        for seq in self._prefilling:
+            if leftover < 1:
+                break
+            if seq.ctx.is_stopped():
+                break  # the normal tick's sweep owns cancellation
+            if seq.preloaded is not None or seq.prompt_embeds is not None:
+                break
+            if seq.needs_ext_sampling:
+                # a FINAL chunk samples its first token in-step on the
+                # plain path — penalties/seeded/logprobs requests must
+                # prefill through the normal ext dispatch instead (same
+                # gate as the decode side; strict FIFO, so stop here)
+                break
+            need = seq.total_tokens - seq.num_computed
+            chunk = min(need, self.config.prefill_chunk, leftover)
+            if chunk < need:
+                chunk -= chunk % self.page_size
+            if chunk < 1:
+                break
+            picks.append((seq, chunk))
+            leftover -= chunk
+        return picks
+
+    async def _mixed_tick(self):
+        """One stall-free MIXED step when decode-ready rows and pending
+        prefill chunks coexist: both planes advance in a single
+        token-budgeted dispatch, so an admission wave can never park the
+        running decode streams for longer than one budgeted step
+        (Sarathi-Serve's stall-free scheduling; the motivation for the
+        whole family is that prefill and decode serialize on the donated
+        KV cache regardless of how the host interleaves dispatches).
+
+        Returns True (a step ran — the normal prefill/decode ticks stand
+        down), "hold" (worthwhile, but the in-flight decode dispatch must
+        sync first: mixed windows are host-built like spec verify, so
+        token history has to be current — skip both planes this tick and
+        run next tick), or None (not applicable: normal paths run)."""
+        if self._closed or self._mixed_disabled or not self._prefilling:
+            return None
+        why = self._mixed_unsupported_reason()
+        if why is not None:
+            if not self._mixed_warned:
+                self._mixed_warned = True
+                log.warning("mixed_batching disabled: %s", why)
+            return None
+        rows = self._mixed_eligible_decode()
+        if not rows:
+            return None
+        budget = self.config.mixed_step_tokens
+        n_dec = len(rows)
+        if self.config.mixed_decode_priority:
+            # latency-leaning default: every decode row joins (1 budget
+            # token each), prefill shrinks into what is left
+            leftover = budget - n_dec
+            if leftover < 1:
+                return None  # budget cannot fit both planes
+            picks = self._select_mixed_prefill(leftover)
+        else:
+            # throughput-leaning: prefill chunks keep their full size;
+            # decode rows join only when the remainder has room for ALL
+            # of them (a partial decode batch would starve the tail rows
+            # — the normal alternating paths serve this case better)
+            picks = self._select_mixed_prefill(budget)
+            if budget - sum(c for _, c in picks) < n_dec:
+                return None
+        if not picks:
+            return None
+        if self._inflight is not None:
+            return "hold"
+        # grow decode rows' pages through the position this step writes;
+        # growth may preempt (possibly a participant) — refilter both
+        # sides against the post-growth slot state
+        max_pos = self.config.max_model_len - 1
+        for _, seq in rows:
+            if seq.slot < 0 or self.slots[seq.slot] is not seq:
+                continue
+            if not self._ensure_pages_through(
+                seq, min(seq.device_pos, max_pos)
+            ):
+                return None  # growth preempted its own row; retry next tick
+        rows = [
+            (i, s) for i, s in rows
+            if self.slots[i] is s and not s.prefilling
+        ]
+        picks = [
+            (s, c) for s, c in picks
+            if s.slot >= 0 and self.slots[s.slot] is s
+        ]
+        if not rows or not picks:
+            return None
+        bld = self._build_mixed(rows, picks)
+        t0 = time.perf_counter()
+        try:
+            S = await asyncio.to_thread(self._run_mixed_dispatch, bld)
+            t_sync0 = time.perf_counter()
+            toks = await asyncio.to_thread(np.asarray, S)
+        except Exception:
+            # contain the failure like _prefill_tick does: nothing was
+            # advanced (bookkeeping happens at sync), so the normal
+            # paths can retry everything — re-arm the decode rows' carry
+            # overrides the build consumed (their last_token IS the
+            # host truth; the device carry vector may predate earlier
+            # mixed steps), then disable mixed steps on this engine —
+            # retrying a failing dispatch family every tick would wedge
+            # the loop instead of degrading to the contained paths
+            log.exception(
+                "mixed step of %d rows failed; disabling mixed batching "
+                "(normal prefill/decode paths take over)", len(bld["entries"])
+            )
+            for kind, slot, seq, _ in bld["entries"]:
+                if kind == "dec" and slot >= 0 and self.slots[slot] is seq:
+                    self._overrides[slot] = int(seq.last_token)
+            self._mixed_disabled = True
+            return None
+        now = time.perf_counter()
+        with self._phase_lock:
+            self._phase_stats["mixed_sync_s"] += now - t_sync0
+            # the whole dispatch+fetch wall is time the decode rows did
+            # NOT spend parked behind a separate prefill dispatch
+            self._phase_stats["mixed_decode_stall_saved_s"] += now - t0
+        self._sync_mixed(bld, toks)
+        return True
+
+    def _build_mixed(self, rows: list, picks: list) -> dict:
+        """Host-side input build for one mixed step: decode rows first
+        (q_len=1, their host-known carry token), then one chunk per
+        prefill pick. Row count pads to a power of two and T to the
+        chunk's prefill bucket, so the compiled families stay the
+        [pow2, bucket] grid group prefill already uses."""
+        ps = self.page_size
+        n_rows = len(rows) + len(picks)
+        n = 1 << (n_rows - 1).bit_length()
+        t_b = self._bucket_for(max(c for _, c in picks))
+        tok_arr = np.zeros((n, t_b), np.int32)
+        pos_arr = np.zeros((n, t_b), np.int32)
+        wslots = np.zeros((n, t_b), np.int32)
+        last_idx = np.zeros(n, np.int32)
+        temp = np.zeros(n, np.float32)
+        topk = np.zeros(n, np.int32)
+        topp = np.ones(n, np.float32)
+        smat = (
+            None if self._attn_pallas
+            else np.zeros((n, self._smat_width), np.int32)
+        )
+        entries = []  # (kind, slot, seq, chunk) per built row
+        w_need = 1
+        j = 0
+        for slot, seq in rows:
+            tok_arr[j, 0] = seq.last_token
+            pos_arr[j, 0] = seq.device_pos
+            wslots[j, 0] = self._write_slot(seq, seq.device_pos)
+            if smat is not None:
+                smat[j] = self._slot_matrix_row(seq)
+            temp[j] = seq.temperature
+            topk[j] = seq.top_k
+            topp[j] = seq.top_p
+            w_need = max(w_need, seq.device_pos // ps + 1)
+            # the host-built window replaces any carry override for this
+            # slot (its token is already in host history)
+            self._overrides.pop(slot, None)
+            entries.append(("dec", slot, seq, 1))
+            j += 1
+        for seq, chunk in picks:
+            tokens = seq.tokens
+            start = seq.num_computed
+            idx = np.arange(start, start + chunk)
+            tok_arr[j, :chunk] = tokens[start:start + chunk]
+            pos_arr[j, :chunk] = idx
+            pages = np.asarray(seq.page_ids, np.int32)
+            wslots[j, :chunk] = pages[idx // ps] * ps + idx % ps
+            if smat is not None:
+                smat[j] = self._slot_matrix_row(seq)
+            last_idx[j] = chunk - 1
+            temp[j] = seq.temperature
+            topk[j] = seq.top_k
+            topp[j] = seq.top_p
+            w_need = max(w_need, -(-(start + chunk) // ps))
+            entries.append(("pf", seq.slot, seq, chunk))
+            j += 1
+        btables = None
+        if self._attn_pallas:
+            # attended-page width buckets to a power of two like group
+            # prefill (full width would DMA every trash page per tile)
+            w_b = min(
+                1 << (w_need - 1).bit_length(), self.config.max_pages_per_seq
+            )
+            btables = np.zeros((n, w_b), np.int32)
+            for jj, (_, _, seq, _) in enumerate(entries):
+                npg = min(len(seq.page_ids), w_b)
+                btables[jj, :npg] = seq.page_ids[:npg]
+        return dict(
+            tok=tok_arr, pos=pos_arr, wslots=wslots, smat=smat,
+            last_idx=last_idx, temp=temp, topk=topk, topp=topp,
+            btables=btables, entries=entries,
+            all_greedy=bool((temp[:n_rows] <= 0.0).all()),
+        )
+
+    def _run_mixed_dispatch(self, bld: dict):
+        """Jax half of a mixed step (worker thread, _kv_lock): returns
+        the device sampled-token vector [n]."""
+        t0 = time.perf_counter()
+        with self._kv_lock:
+            self._key, sub = jax.random.split(self._key)
+            S, self.kv = self._mixed_fn(
+                self.params, self.kv,
+                jnp.asarray(bld["tok"]), jnp.asarray(bld["pos"]),
+                jnp.asarray(bld["wslots"].reshape(-1)),
+                jnp.asarray(bld["smat"]) if bld["smat"] is not None else None,
+                jnp.asarray(bld["last_idx"]),
+                jnp.asarray(bld["temp"]), jnp.asarray(bld["topk"]),
+                jnp.asarray(bld["topp"]), sub,
+                jnp.asarray(bld["btables"])
+                if bld["btables"] is not None else None,
+                bld["all_greedy"],
+            )
+        self._step_count += 1
+        S.copy_to_host_async()
+        with self._phase_lock:
+            self._phase_stats["mixed_dispatch_s"] += time.perf_counter() - t0
+        return S
+
+    def _sync_mixed(self, bld: dict, toks: np.ndarray) -> None:
+        """Land a mixed step (event-loop thread): emit decode rows' next
+        tokens and final chunks' first tokens, advance prefill
+        bookkeeping, and re-arm each surviving row's carry override so a
+        following NORMAL decode dispatch consumes the right token (mixed
+        windows are host-built and never touch the device carry
+        vector — the same contract as spec verify)."""
+        n_dec = n_pf_tokens = 0
+        now = time.perf_counter()
+        for j, (kind, slot, seq, chunk) in enumerate(bld["entries"]):
+            if kind == "dec":
+                n_dec += 1
+            else:
+                n_pf_tokens += chunk
+            if slot < 0 or seq.slot != slot or self.slots[slot] is not seq:
+                continue  # finished/preempted while the step ran
+            tok = int(toks[j])
+            if kind == "dec":
+                seq.device_pos += 1
+                seq.num_computed += 1
+                self._register_full_pages(seq)
+                self._append_token(seq, tok)
+                if self.slots[slot] is seq:
+                    self._overrides[slot] = tok
+                continue
+            seq.num_computed += chunk
+            self._register_full_pages(seq)
+            try:
+                self._prefilling.remove(seq)
+            except ValueError:
+                pass
+            if seq.num_computed >= seq.total_tokens:
+                # final chunk: the in-step sample IS the first token —
+                # emitted right here (no carry_pending round trip; the
+                # sync already holds the host copy)
+                seq.prefilling = False
+                seq.device_pos = seq.num_computed
+                seq.t_first_dispatched = now
+                self._stamp_first_meta(seq)
+                self._append_token(seq, tok, extra_meta=seq.first_meta)
+                seq.first_meta = None
+                if self.slots[slot] is seq:
+                    self._overrides[slot] = tok
+            else:
+                self._prefilling.append(seq)
+        with self._phase_lock:
+            st = self._phase_stats
+            st["mixed_steps"] += 1
+            st["mixed_decode_rows"] += n_dec
+            st["mixed_prefill_tokens"] += n_pf_tokens
+            st["mixed_step_tokens_max"] = max(
+                st["mixed_step_tokens_max"], n_dec + n_pf_tokens
+            )
+
     # ---- decode -------------------------------------------------------
+
+    def _decode_ready_rows(self) -> list:
+        """Decode-ready (slot, seq) rows after the cancellation sweep —
+        ONE collection shared by the normal decode build and the mixed
+        tick so the two paths cannot drift."""
+        ready = [
+            (i, s)
+            for i, s in enumerate(self.slots)
+            if s is not None and not s.prefilling
+        ]
+        for i, s in ready:
+            if s.ctx.is_stopped():
+                self._finish(s, FINISH_REASON_CANCELLED)
+        return [(i, s) for i, s in ready if self.slots[i] is s]
 
     def _maybe_dispatch_decode(self) -> Optional["_DecodeBuild"]:
         """Host-side build of the next decode dispatch (cancellation
@@ -2029,15 +2489,7 @@ class JaxEngine:
         previous dispatch's result fetch."""
         if self._closed:
             return None
-        ready = [
-            (i, s)
-            for i, s in enumerate(self.slots)
-            if s is not None and not s.prefilling
-        ]
-        for i, s in ready:
-            if s.ctx.is_stopped():
-                self._finish(s, FINISH_REASON_CANCELLED)
-        ready = [(i, s) for i, s in ready if self.slots[i] is s]
+        ready = self._decode_ready_rows()
         if not ready:
             return None
         if (
@@ -2166,10 +2618,7 @@ class JaxEngine:
         sampler covers plain greedy/temperature/top-k/top-p, which is
         the serving hot path."""
         for _, s in ready:
-            if (
-                s.carry_pending or s.has_penalties or s.seed >= 0
-                or s.want_logprobs or s.top_logprobs > 0
-            ):
+            if s.carry_pending or s.needs_ext_sampling:
                 return None
         k_max = self.config.spec_k_max
         drafts: dict[int, list[int]] = {}

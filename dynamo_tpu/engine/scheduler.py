@@ -102,6 +102,22 @@ class Sequence:
             or self.repetition_penalty != 1.0
         )
 
+    @property
+    def needs_ext_sampling(self) -> bool:
+        """True when the plain greedy/temperature/top-k/top-p sampler is
+        not enough for this request: penalties and per-request seeds
+        need the extended (counts/seeded) sampler, logprobs need the
+        logsumexp outputs. The host-built step families (spec verify,
+        mixed prefill+decode) cover only the plain hot path and must
+        route these requests through the normal dispatches — ONE
+        predicate so the three gates cannot drift apart."""
+        return (
+            self.has_penalties
+            or self.seed >= 0
+            or self.want_logprobs
+            or self.top_logprobs > 0
+        )
+
     @classmethod
     def from_request(
         cls, ctx: Context, pre: PreprocessedRequest, page_size: int, max_model_len: int

@@ -39,6 +39,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from dynamo_tpu import compat
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 
@@ -86,7 +88,7 @@ class XProcKvBridge:
             return jax.lax.ppermute(x, "host", [(0, 1)])
 
         self._xfer = jax.jit(
-            jax.shard_map(
+            compat.shard_map(
                 oneway,
                 mesh=mesh,
                 in_specs=P("host", "dev"),

@@ -22,6 +22,7 @@ from typing import Any, NamedTuple
 import jax
 import jax.numpy as jnp
 
+from dynamo_tpu import compat
 from dynamo_tpu.models.config import ModelConfig
 from dynamo_tpu.ops.attention import paged_attention, write_kv_slots
 from dynamo_tpu.ops.norm import rms_norm
@@ -263,7 +264,7 @@ def _attn_block(
     h, kh, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
     if tp_axis is not None:
         # manual tp: this shard holds its local slice of the heads
-        tpn = jax.lax.axis_size(tp_axis)
+        tpn = compat.axis_size(tp_axis)
         h //= tpn
         kh //= tpn
     quant = kv_ks is not None
@@ -344,7 +345,7 @@ def _attn_block(
             scale_out = (
                 (P(None, "tp", None), P(None, "tp", None)) if quant else ()
             )
-            fused = jax.shard_map(
+            fused = compat.shard_map(
                 fused,
                 mesh=attn.mesh,
                 in_specs=(
@@ -427,7 +428,7 @@ def _attn_block(
             scale_out = (
                 (P(None, "tp", None), P(None, "tp", None)) if quant else ()
             )
-            wr = jax.shard_map(
+            wr = compat.shard_map(
                 wr,
                 mesh=attn.mesh,
                 in_specs=(
@@ -460,7 +461,7 @@ def _attn_block(
                 scale_specs = (
                     (P(None, "tp", None), P(None, "tp", None)) if quant else ()
                 )
-                fl = jax.shard_map(
+                fl = compat.shard_map(
                     fl,
                     mesh=attn.mesh,
                     in_specs=(
@@ -540,7 +541,44 @@ def _attn_block(
             kv_k, kv_v, kv_ks, kv_vs,
             k.reshape(b * t, kh * hd), v.reshape(b * t, kh * hd),
         )
-        if attn.block_tables is not None:
+        if attn.block_tables is not None and attn.q_pos0 is not None:
+            # mixed prefill+decode step on the pallas backend: the WRITE
+            # is the row scatter above — decode rows land mid-page, which
+            # the page-granular prefill scatter cannot express — and the
+            # READ is the ragged flash kernel (per-row q_pos0/q_len;
+            # decode rows are q_len=1, chunk rows causal inside the chunk)
+            from dynamo_tpu.ops.pallas_attention import ragged_paged_attention
+
+            rg = functools.partial(
+                ragged_paged_attention,
+                page_size=attn.page_size, interpret=attn.interpret,
+            )
+            if attn.mesh is not None:
+                P = jax.sharding.PartitionSpec
+                scale_specs = (
+                    (P(None, "tp", None), P(None, "tp", None)) if quant else ()
+                )
+                rg = compat.shard_map(
+                    rg,
+                    mesh=attn.mesh,
+                    in_specs=(
+                        P(None, None, "tp", None), P(None, "tp"),
+                        P(None, "tp"), P(), P(), P(), *scale_specs,
+                    ),
+                    out_specs=P(None, None, "tp", None),
+                    check_vma=False,
+                )
+            if quant:
+                out = rg(
+                    q, kv_k, kv_v, attn.block_tables, attn.q_pos0,
+                    attn.lengths, kv_ks, kv_vs,
+                )
+            else:
+                out = rg(
+                    q, kv_k, kv_v, attn.block_tables, attn.q_pos0,
+                    attn.lengths,
+                )
+        elif attn.block_tables is not None:
             from dynamo_tpu.ops.pallas_attention import paged_decode_attention
 
             ro = functools.partial(
@@ -553,7 +591,7 @@ def _attn_block(
                 scale_specs = (
                     (P(None, "tp", None), P(None, "tp", None)) if quant else ()
                 )
-                ro = jax.shard_map(
+                ro = compat.shard_map(
                     ro,
                     mesh=attn.mesh,
                     in_specs=(
@@ -573,9 +611,13 @@ def _attn_block(
                     q[:, 0], kv_k, kv_v, attn.block_tables, attn.lengths,
                 )[:, None]
         else:
+            # `lengths` on a plain gather spec = per-row ragged query
+            # lengths (mixed steps); None for the classic single-shape
+            # dispatches whose callers slice their own valid columns
             out = paged_attention(
                 q, kv_k, kv_v, attn.slot_matrix, positions,
                 k_scales=kv_ks, v_scales=kv_vs, scale_tp=attn.kv_tp,
+                q_lens=attn.lengths,
             )
     proj = mm(out.reshape(b, t, h * hd), lp["wo"])
     if tp_axis is not None:

@@ -25,6 +25,11 @@ mask `slot_position <= query_position`. Prefill (cached_len=0), chunked
 prefill / prefix-cache hits (cached_len>0) and decode (T=1) are the same
 compiled graph family, bucketed by shape.
 
+Query lengths are per-ROW ragged: nothing ties the rows of one dispatch to
+the same chunk size, so a mixed-batching step (engine `_mixed_tick`) packs
+q_len=1 decode rows next to chunked-prefill rows in one [B, T] call —
+`q_lens` masks each row's padded query columns to exact zeros.
+
 Sharding: the `num_kv_heads` axis is the tensor-parallel axis; gathers and
 scatters are shard-local (no collectives on the KV path).
 
@@ -76,11 +81,18 @@ def paged_attention(
     k_scales: jnp.ndarray | None = None,  # [P, SUBL, S] int8-KV scale pools
     v_scales: jnp.ndarray | None = None,  # (ops/quant pool layout)
     scale_tp: int = 1,
+    q_lens: jnp.ndarray | None = None,    # [B] valid query rows per row
 ) -> jnp.ndarray:
     """Gathered-slot attention. Gathered slot j holds absolute position j of
     the sequence, so causality is `j <= positions[b, t]`; padded queries and
     0-padded slot-table tails are masked out by the same comparison (their
     garbage KV rides the trash page).
+
+    `q_lens` makes the per-row RAGGED query contract explicit (mixed
+    prefill+decode steps: decode rows q_len=1 beside chunk rows): query
+    columns >= q_lens[b] are fully masked and emit exact zeros instead of
+    garbage that callers must know to ignore. None keeps the historical
+    behavior (callers gather only their valid columns).
 
     With scale pools the caches hold per-token-per-kv-head symmetric int8
     (ops/quant.quantize_kv_rows; pool layout ops/quant.init_kv_scale_pool);
@@ -109,6 +121,10 @@ def paged_attention(
 
     j = jnp.arange(c)
     mask = j[None, None, :] <= positions[:, :, None]  # [B, T, C]
+    if q_lens is not None:
+        mask = mask & (
+            jnp.arange(t)[None, :, None] < q_lens[:, None, None]
+        )
     mask = mask[:, None, None, :, :]
 
     probs = _masked_softmax(logits, mask)

@@ -47,6 +47,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from dynamo_tpu import compat
+
 _NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
 
 
@@ -651,17 +653,17 @@ def fused_paged_decode_attention(
                 # pools pinned to HBM: under pl.ANY Mosaic may place the
                 # small scale pools in VMEM, where sub-lane-width (K < 128)
                 # memref slices fail to compile
-                pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),  # k_pages
-                pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),  # v_pages
-                pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),  # ks_pages
-                pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),  # vs_pages
+                pl.BlockSpec(memory_space=compat.tpu_hbm_memory_space()),  # k_pages
+                pl.BlockSpec(memory_space=compat.tpu_hbm_memory_space()),  # v_pages
+                pl.BlockSpec(memory_space=compat.tpu_hbm_memory_space()),  # ks_pages
+                pl.BlockSpec(memory_space=compat.tpu_hbm_memory_space()),  # vs_pages
             ],
             out_specs=[
                 pl.BlockSpec(memory_space=pltpu.VMEM),
-                pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),
-                pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),
-                pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),
-                pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),
+                pl.BlockSpec(memory_space=compat.tpu_hbm_memory_space()),
+                pl.BlockSpec(memory_space=compat.tpu_hbm_memory_space()),
+                pl.BlockSpec(memory_space=compat.tpu_hbm_memory_space()),
+                pl.BlockSpec(memory_space=compat.tpu_hbm_memory_space()),
             ],
             scratch_shapes=[
                 pltpu.VMEM(
@@ -854,3 +856,36 @@ def paged_decode_attention(
         alias_caches=False,
     )
     return res[0]
+
+
+def ragged_paged_attention(
+    q: jax.Array,             # [B, T, H, Hd] (rope applied, unscaled)
+    k_cache: jax.Array,       # [num_slots, K*Hd] flat slot pool
+    v_cache: jax.Array,
+    block_tables: jax.Array,  # [B, W] i32 page ids (0 = trash page)
+    q_pos0: jax.Array,        # [B] i32 first query position per row
+    q_lens: jax.Array,        # [B] i32 valid query rows (0 = inactive)
+    k_scales: jax.Array = None,  # [num_pages, SUBL, S] f32 scale pools
+    v_scales: jax.Array = None,
+    *,
+    page_size: int,
+    interpret: bool = False,
+) -> jax.Array:
+    """Read-only paged attention with PER-ROW query lengths — the mixed
+    prefill+decode step's kernel (KV already written, row-scattered by
+    the caller): decode rows are q_len=1 at an arbitrary (mid-page)
+    position, chunked-prefill rows span [q_pos0, q_pos0+q_len) with
+    causal masking inside the chunk, padding rows (q_len=0) emit zeros.
+
+    Delegates to the flash prefill kernel (ops/pallas_prefill.py), whose
+    online-softmax grid already handles per-row ragged lengths; unlike
+    the prefill WRITE path, `q_pos0` here need not be page-aligned (no
+    page-granular scatter is involved). A dedicated kernel that skips
+    the padded query tiles of q_len=1 rows would land behind this
+    signature. Returns [B, T, H, Hd] in q.dtype."""
+    from dynamo_tpu.ops.pallas_prefill import flash_prefill_attention
+
+    return flash_prefill_attention(
+        q, k_cache, v_cache, block_tables, q_pos0, q_lens,
+        k_scales, v_scales, page_size=page_size, interpret=interpret,
+    )

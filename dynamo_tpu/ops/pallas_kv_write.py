@@ -33,6 +33,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from dynamo_tpu import compat
+
 
 def _kernel(tbl_ref, kp_ref, vp_ref, src_k_ref, src_v_ref, ok_ref, ov_ref):
     del kp_ref, vp_ref  # aliased through; only the indexed blocks change
@@ -126,7 +128,7 @@ def paged_kv_write(
                 jax.ShapeDtypeStruct(vs_cache.shape, vs_cache.dtype),
             ],
             input_output_aliases={1: 0, 2: 1, 3: 2, 4: 3},
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=compat.tpu_compiler_params(
                 dimension_semantics=("arbitrary",),
             ),
             interpret=interpret,
@@ -161,7 +163,7 @@ def paged_kv_write(
             jax.ShapeDtypeStruct(vp.shape, vp.dtype),
         ],
         input_output_aliases={1: 0, 2: 1},  # kp -> ok, vp -> ov (in place)
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=compat.tpu_compiler_params(
             dimension_semantics=("arbitrary",),
         ),
         interpret=interpret,

@@ -36,6 +36,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from dynamo_tpu import compat
+
 _NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
 
 
@@ -174,7 +176,10 @@ def flash_prefill_attention(
     k_cache: jax.Array,       # [num_slots, K*Hd] (int8 when scales given)
     v_cache: jax.Array,
     block_tables: jax.Array,  # [B, W] i32 position-ordered page ids
-    pos0: jax.Array,          # [B] i32 chunk start (page-aligned)
+    pos0: jax.Array,          # [B] i32 chunk start (NOT required to be
+    # page-aligned: alignment is a constraint of the page-scatter WRITE
+    # path, never of this read — mixed prefill+decode steps pass decode
+    # rows with pos0 mid-page and t_valid == 1)
     t_valid: jax.Array,       # [B] i32 valid rows in the chunk (<= T)
     k_scales: jax.Array = None,  # [num_pages, SUBL, page_size] f32 scale
     # pools (ops/quant pool layout; SUBL >= 8, tokens in lanes)
@@ -189,7 +194,12 @@ def flash_prefill_attention(
     t_valid produce zeros. Returns [B, T, H, Hd] in q.dtype. With scale
     pools the pages hold per-token-per-kv-head int8; scale blocks ride
     the same page routing and dequantization happens per head slice in
-    VMEM (VPU-cheap next to the halved page DMA traffic)."""
+    VMEM (VPU-cheap next to the halved page DMA traffic).
+
+    Per-row RAGGED query lengths are native: every mask is computed from
+    the row's own (pos0, t_valid), so one dispatch may mix full chunks,
+    short final chunks and q_len=1 decode rows (the mixed-batching step;
+    see ops.pallas_attention.ragged_paged_attention)."""
     b, t, h, hd = q.shape
     quant = k_scales is not None
     # int32-packed pools (quant.pack_kv_slots): same bytes, f32 tiling
@@ -294,7 +304,7 @@ def flash_prefill_attention(
         ),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, kh, t_pad * g, hd), q.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=compat.tpu_compiler_params(
             dimension_semantics=("parallel", "arbitrary", "arbitrary"),
         ),
         interpret=interpret,

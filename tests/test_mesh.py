@@ -5,6 +5,8 @@ single-device forward (GSPMD inserts the collectives)."""
 
 import jax
 import jax.numpy as jnp
+
+from dynamo_tpu import compat
 import numpy as np
 
 from dynamo_tpu.models import config as cfgmod, llama
@@ -52,7 +54,7 @@ def test_tp_forward_matches_single_device():
         k=tuple(jax.device_put(x, meshmod.kv_cache_sharding(m)) for x in kv.k),
         v=tuple(jax.device_put(x, meshmod.kv_cache_sharding(m)) for x in kv.v),
     )
-    with jax.set_mesh(m):
+    with compat.set_mesh(m):
         tp_logits, kv_out = run(sp, kv)
 
     np.testing.assert_allclose(
