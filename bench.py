@@ -61,6 +61,11 @@ MIXED_TOKENS = int(os.environ.get("BENCH_MIXED_TOKENS", "1024"))
 MIXED_HELD = int(os.environ.get("BENCH_MIXED_HELD", "8"))
 MIXED_WAVE = int(os.environ.get("BENCH_MIXED_WAVE", "16"))
 MIXED_OSL = int(os.environ.get("BENCH_MIXED_OSL", str(max(OSL, 128))))
+# BENCH_OUT=path: ALSO write a machine-readable JSON results file with
+# every section keyed separately (headline, spec, mixed, mixed_spec) —
+# the stdout line stays the one-line headline artifact. Downstream
+# trajectory tooling parses the file, not stdout.
+BENCH_OUT = os.environ.get("BENCH_OUT", "")
 
 ENV_HELP = """bench.py — serving benchmark; configuration via env vars:
   BENCH_MODEL                  preset override (auto-picked from HBM)
@@ -90,6 +95,14 @@ ENV_HELP = """bench.py — serving benchmark; configuration via env vars:
   BENCH_MIXED_WAVE             admission-wave prompt count (16)
   BENCH_MIXED_OSL              held streams' output length
                                (max(BENCH_OSL, 128))
+  BENCH_OUT                    path: write a machine-readable JSON file
+                               with every section's numbers keyed as
+                               {headline, spec, mixed, mixed_spec}
+                               (sections not run are null); stdout keeps
+                               the one-line headline artifact
+  (BENCH_MIXED=1 BENCH_SPEC=1 together add the COMPOSED spec x mixed
+  A/B: repetitive held streams + an admission wave, mixed-only vs
+  mixed+spec — ragged verify rows inside the mixed steps)
 """
 
 
@@ -147,9 +160,9 @@ def main() -> None:
             spec_ngram_max=SPEC_NGRAM,
             # mixed-batching A/B: the flag itself is a per-tick host
             # decision toggled per wave below; only the budget is fixed
-            # at init (spec and mixed are mutually exclusive, so the
-            # A/Bs cannot both be armed at init — BENCH_SPEC wins there
-            # and BENCH_MIXED still works via the runtime toggle)
+            # at init. spec COMPOSES with mixed (ragged verify rows) —
+            # with both env flags set the composed A/B below toggles the
+            # two flags together.
             mixed_batching=False,
             mixed_step_tokens=MIXED_TOKENS,
             # int8-KV pallas kernels put page tokens in lanes
@@ -351,105 +364,121 @@ def main() -> None:
                 "speedup": round(wall_off / wall_on, 3),
             }
 
-        async def mixed_ab():
-            """Stall-free mixed batching A/B: MIXED_HELD streams held in
-            steady decode, then MIXED_WAVE fresh prompts injected as one
-            admission wave. Reports the held streams' inter-token gaps
-            DURING the wave (p50/p99 — the p99 IS the admission stall)
-            and the wave's TTFT, mixed off then on. Fresh random prompts
-            per wave: no prefix-cache hits, no draftable n-grams."""
+        async def held_one(prompt, record):
+            pre = PreprocessedRequest(
+                token_ids=prompt,
+                stop_conditions=StopConditions(
+                    max_tokens=MIXED_OSL, ignore_eos=True
+                ),
+                sampling_options=SamplingOptions(greedy=True),
+            )
+            # bind the LIVE list before streaming: the wave launcher
+            # polls it to detect steady decode
+            ticks = record["ticks"] = []
+            async for frame in await engine.generate(
+                Context(pre.to_dict())
+            ):
+                if frame.get("token_ids"):
+                    ticks.append(time.perf_counter())
 
-            async def held_one(prompt, record):
-                pre = PreprocessedRequest(
-                    token_ids=prompt,
-                    stop_conditions=StopConditions(
-                        max_tokens=MIXED_OSL, ignore_eos=True
-                    ),
-                    sampling_options=SamplingOptions(greedy=True),
-                )
-                # bind the LIVE list before streaming: the wave launcher
-                # polls it to detect steady decode
-                ticks = record["ticks"] = []
-                async for frame in await engine.generate(
-                    Context(pre.to_dict())
-                ):
-                    if frame.get("token_ids"):
-                        ticks.append(time.perf_counter())
-
-            def prompts(k):
+        def mixed_prompts(k, repetitive=False):
+            if repetitive:
+                # distinct 16-token segments tiled: every suffix n-gram
+                # recurs within its own prompt (draftable), no
+                # cross-request prefix-cache hits
                 return [
-                    rng.randint(1, cfg.vocab_size, size=ISL).tolist()
+                    np.tile(
+                        rng.randint(1, cfg.vocab_size, size=16),
+                        MIXED_OSL // 16 + ISL // 16 + 2,
+                    )[:ISL].tolist()
                     for _ in range(k)
                 ]
+            return [
+                rng.randint(1, cfg.vocab_size, size=ISL).tolist()
+                for _ in range(k)
+            ]
 
-            async def run_wave(on):
-                engine.config.mixed_batching = on
-                held_recs = [dict() for _ in range(MIXED_HELD)]
-                t_all0 = time.perf_counter()
-                tasks = [
-                    asyncio.create_task(held_one(p, r))
-                    for p, r in zip(prompts(MIXED_HELD), held_recs)
-                ]
-                # wait for steady decode: every held stream past its
-                # first few tokens before the wave lands. A held task
-                # dying here would otherwise spin this poll forever —
-                # surface its error instead.
-                while not all(
-                    len(r.get("ticks", ())) >= 4 for r in held_recs
-                ):
-                    for t in tasks:
-                        if t.done() and t.exception() is not None:
-                            raise t.exception()
-                    await asyncio.sleep(0.02)
-                wave_recs = [dict() for _ in range(MIXED_WAVE)]
-                t_w0 = time.perf_counter()
-                await asyncio.gather(*(
-                    one(p, r) for p, r in zip(prompts(MIXED_WAVE), wave_recs)
-                ))
-                t_w1 = time.perf_counter()
-                await asyncio.gather(*tasks)
-                wall_all = time.perf_counter() - t_all0
-                gaps = []
-                for r in held_recs:
-                    ts = r["ticks"]
-                    for a, b in zip(ts, ts[1:]):
-                        # gaps overlapping the admission-wave window
-                        if b >= t_w0 and a <= t_w1:
-                            gaps.append(b - a)
-                toks = MIXED_HELD * MIXED_OSL + sum(
-                    r["tokens"] for r in wave_recs
+        async def mixed_wave(mixed_on, spec_on=False, repetitive_held=False):
+            """One held+wave cycle: MIXED_HELD streams in steady decode,
+            then MIXED_WAVE fresh prompts as one admission wave; returns
+            the held streams' inter-token gaps DURING the wave (p50/p99
+            — the p99 IS the admission stall) and the wave's TTFT."""
+            engine.config.mixed_batching = mixed_on
+            engine.config.spec_decode = spec_on
+            held_recs = [dict() for _ in range(MIXED_HELD)]
+            t_all0 = time.perf_counter()
+            tasks = [
+                asyncio.create_task(held_one(p, r))
+                for p, r in zip(
+                    mixed_prompts(MIXED_HELD, repetitive_held), held_recs
+                )
+            ]
+            # wait for steady decode: every held stream past its
+            # first few tokens before the wave lands. A held task
+            # dying here would otherwise spin this poll forever —
+            # surface its error instead.
+            while not all(
+                len(r.get("ticks", ())) >= 4 for r in held_recs
+            ):
+                for t in tasks:
+                    if t.done() and t.exception() is not None:
+                        raise t.exception()
+                await asyncio.sleep(0.02)
+            wave_recs = [dict() for _ in range(MIXED_WAVE)]
+            t_w0 = time.perf_counter()
+            await asyncio.gather(*(
+                one(p, r)
+                for p, r in zip(mixed_prompts(MIXED_WAVE), wave_recs)
+            ))
+            t_w1 = time.perf_counter()
+            await asyncio.gather(*tasks)
+            wall_all = time.perf_counter() - t_all0
+            engine.config.mixed_batching = False
+            engine.config.spec_decode = False
+            gaps = []
+            for r in held_recs:
+                ts = r["ticks"]
+                for a, b in zip(ts, ts[1:]):
+                    # gaps overlapping the admission-wave window
+                    if b >= t_w0 and a <= t_w1:
+                        gaps.append(b - a)
+            toks = MIXED_HELD * MIXED_OSL + sum(
+                r["tokens"] for r in wave_recs
+            )
+
+            def pct(vals, q):
+                # gaps can be empty when the held streams drained
+                # before the wave landed (MIXED_OSL too short for
+                # this rig) — report None rather than crash
+                return (
+                    round(float(np.percentile(vals, q)), 4)
+                    if len(vals) else None
                 )
 
-                def pct(vals, q):
-                    # gaps can be empty when the held streams drained
-                    # before the wave landed (MIXED_OSL too short for
-                    # this rig) — report None rather than crash
-                    return (
-                        round(float(np.percentile(vals, q)), 4)
-                        if len(vals) else None
-                    )
+            return {
+                "wave_itl_p50_s": pct(gaps, 50),
+                "wave_itl_p99_s": pct(gaps, 99),
+                "wave_ttft_p50_s": pct(
+                    [r["ttft"] for r in wave_recs], 50
+                ),
+                "toks_per_sec_chip": round(toks / wall_all / n_chips, 1),
+            }
 
-                return {
-                    "wave_itl_p50_s": pct(gaps, 50),
-                    "wave_itl_p99_s": pct(gaps, 99),
-                    "wave_ttft_p50_s": pct(
-                        [r["ttft"] for r in wave_recs], 50
-                    ),
-                    "toks_per_sec_chip": round(toks / wall_all / n_chips, 1),
-                }
-
+        async def mixed_ab():
+            """Stall-free mixed batching A/B: held streams + admission
+            wave, mixed off then on. Fresh random prompts per wave: no
+            prefix-cache hits, no draftable n-grams."""
             # warm both modes with a FULL held+wave cycle: mixed step
             # families ([pow2 rows, bucket] + the ragged attention path)
             # only compile when decode rows and prefill chunks actually
             # coexist — a plain warm wave never builds them, and the
             # measured ON wave would pay the compiles as fake stalls
             for on in (False, True):
-                await run_wave(on)
+                await mixed_wave(on)
             ps_a = engine.phase_stats
-            off = await run_wave(False)
-            on = await run_wave(True)
+            off = await mixed_wave(False)
+            on = await mixed_wave(True)
             ps_b = engine.phase_stats
-            engine.config.mixed_batching = False
             d = {k: ps_b[k] - ps_a[k] for k in ps_a}
             return {
                 "step_tokens": MIXED_TOKENS,
@@ -471,6 +500,48 @@ def main() -> None:
                 ),
             }
 
+        async def mixed_spec_ab():
+            """COMPOSED spec x mixed A/B: repetitive held streams (their
+            n-grams draft, so decode rows ride the mixed steps as ragged
+            1+k verify windows) + an admission wave of fresh prompts,
+            mixed-only vs mixed+spec. The effective tokens per model
+            step of the held rows is the spec win; the wave ITL p99
+            proves composing did not reopen the admission stall."""
+            for spec_on in (False, True):  # compile both families
+                await mixed_wave(True, spec_on=spec_on, repetitive_held=True)
+            base = await mixed_wave(True, repetitive_held=True)
+            ps_m = engine.phase_stats
+            comp = await mixed_wave(True, spec_on=True, repetitive_held=True)
+            ps_b = engine.phase_stats
+            d = {k: ps_b[k] - ps_m[k] for k in ps_b}
+            return {
+                "step_tokens": MIXED_TOKENS,
+                "held_streams": MIXED_HELD,
+                "wave_prompts": MIXED_WAVE,
+                "held_osl": MIXED_OSL,
+                "mixed_only": base,
+                "mixed_spec": comp,
+                # decode rows that rode mixed steps as verify windows
+                "mixed_spec_rows": d["mixed_spec_rows"],
+                "mixed_steps": d["mixed_steps"],
+                "acceptance_rate": (
+                    round(d["spec_accepted"] / d["spec_drafted"], 4)
+                    if d["spec_drafted"] else None
+                ),
+                # >= 1.0; mixed-only decode rows are 1.0 by construction
+                "effective_tokens_per_step": (
+                    round(d["spec_emitted"] / d["spec_rows"], 3)
+                    if d["spec_rows"] else None
+                ),
+                "itl_p99_ratio": (
+                    round(
+                        comp["wave_itl_p99_s"] / base["wave_itl_p99_s"], 3
+                    )
+                    if base["wave_itl_p99_s"] and comp["wave_itl_p99_s"]
+                    else None
+                ),
+            }
+
         if FAST:
             probe = rng.randint(1, cfg.vocab_size, size=ISL).tolist()
             cold, warm = {}, {}
@@ -483,6 +554,7 @@ def main() -> None:
                 [], 0.0, 0.0, [], 0.0, 0.0, None,
                 await spec_ab() if SPEC else None,
                 await mixed_ab() if MIXED else None,
+                await mixed_spec_ab() if (SPEC and MIXED) else None,
             )
 
         # prefix-cache TTFT probe, WAVE-based (BASELINE.md: KV-aware
@@ -614,6 +686,7 @@ def main() -> None:
             offload_speedup,
             await spec_ab() if SPEC else None,
             await mixed_ab() if MIXED else None,
+            await mixed_spec_ab() if (SPEC and MIXED) else None,
         )
 
     (
@@ -625,6 +698,7 @@ def main() -> None:
         offload_speedup,
         spec_result,
         mixed_result,
+        mixed_spec_result,
     ) = asyncio.run(run())
     total_tokens = sum(r["tokens"] for r in records)
     toks_per_sec_chip = total_tokens / wall / n_chips
@@ -654,9 +728,7 @@ def main() -> None:
         target = PARITY_8B_TOKS_PER_CHIP * (_8B_PARAMS / n_params)
     qtag = f" {QUANT}" if QUANT else ""
     qtag += " int8kv" if KV_QUANT else ""
-    print(
-        json.dumps(
-            {
+    headline = {
                 "metric": f"{cfg.name}{qtag} serving "
                 f"decode throughput (ISL={ISL} OSL={OSL} conc={concurrency})",
                 "value": round(toks_per_sec_chip, 2),
@@ -752,10 +824,29 @@ def main() -> None:
                     **({} if mixed_result is None else {
                         "mixed": mixed_result,
                     }),
+                    # BENCH_MIXED=1 BENCH_SPEC=1: composed spec x mixed
+                    # A/B (ragged verify rows riding the mixed steps)
+                    **({} if mixed_spec_result is None else {
+                        "mixed_spec": mixed_spec_result,
+                    }),
                 },
             }
-        )
-    )
+    print(json.dumps(headline))
+    if BENCH_OUT:
+        # machine-readable trajectory artifact: one file, every section
+        # keyed (null = section not requested this run)
+        with open(BENCH_OUT, "w") as f:
+            json.dump(
+                {
+                    "headline": headline,
+                    "spec": spec_result,
+                    "mixed": mixed_result,
+                    "mixed_spec": mixed_spec_result,
+                },
+                f,
+                indent=2,
+            )
+            f.write("\n")
 
 
 if __name__ == "__main__":

@@ -74,16 +74,27 @@ class EngineConfig:
     spec_decode: bool = False
     spec_k_max: int = 4       # max drafted tokens per verify step
     spec_ngram_max: int = 3   # longest suffix n-gram the proposer matches
+    # sliding window (positions) of the per-sequence n-gram index: the
+    # proposer evicts registrations older than this, bounding its memory
+    # at ~window x ngram_max entries on arbitrarily long streams
+    spec_index_window: int = 8192
     # stall-free mixed batching (Sarathi-style): whenever decode-ready
     # rows and pending prefill chunks coexist, pack both into ONE
     # token-budgeted model step — decode rows ride as q_len=1 rows next
     # to the prefill chunks, so an admission wave never stalls running
-    # decode streams for longer than one budgeted step. Mutually
-    # exclusive with spec_decode (v1); unsupported with pp>1, sp>1 and
+    # decode streams for longer than one budgeted step. Composes with
+    # spec_decode (see mixed_spec); unsupported with pp>1, sp>1 and
     # the int32-packed pallas+int8 KV pools (the mixed step row-scatters
     # KV mid-page). Runtime-togglable like spec_decode: incompatible
     # engines just never build a mixed step (logged once).
     mixed_batching: bool = False
+    # spec x mixed composition: with both features on, spec-eligible
+    # decode rows inside a mixed step carry their n-gram drafts as
+    # ragged q_len = 1+k verify rows (budget counts 1+k per row, so
+    # drafts trade off transparently against prefill chunk size). False
+    # keeps decode rows at q_len=1 inside mixed steps; spec then only
+    # runs standalone verify dispatches between admission waves.
+    mixed_spec: bool = True
     # token budget of one mixed step: decode rows cost 1 each, prefill
     # chunks shrink to fit the leftover (non-final chunks round down to
     # a page multiple). Bounds how long one step can stall decode — the

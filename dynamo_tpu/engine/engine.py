@@ -250,11 +250,13 @@ class JaxEngine:
         )
 
         # self-speculative decoding (engine/spec.py): the verify step is
-        # a multi-query gather step — row-scatter KV write + the oracle
-        # attention over the slot matrix. int32-PACKED pools have no
-        # row-scatter path (a byte-level scatter into packed rows would
-        # corrupt pages) and pp's stage executor has no multi-query
-        # decode, so both gate it off loudly instead of corrupting.
+        # a multi-query unified step — row-scatter KV write + the oracle
+        # attention over the slot matrix (gather backends) or the ragged
+        # flash kernel (pallas backends, same path mixed steps read
+        # through). int32-PACKED pools have no row-scatter path (a
+        # byte-level scatter into packed rows would corrupt pages) and
+        # pp's stage executor has no multi-query decode, so both gate it
+        # off loudly instead of corrupting.
         if config.spec_decode:
             if config.spec_k_max < 1:
                 raise ValueError("spec_k_max must be >= 1")
@@ -505,6 +507,11 @@ class JaxEngine:
             "mixed_prefill_tokens": 0,
             "mixed_step_tokens_max": 0,
             "mixed_decode_stall_saved_s": 0.0,
+            # spec x mixed composition: decode rows that rode a mixed
+            # step as ragged verify windows (their drafted/accepted/
+            # emitted counts fold into the spec_* counters above, so
+            # spec_acceptance_rate/spec_tokens_per_step stay one truth)
+            "mixed_spec_rows": 0,
         }
         # updates run in worker threads outside _kv_lock (serving prefill
         # + concurrent prefill_only dispatches) — guard the RMWs
@@ -542,11 +549,12 @@ class JaxEngine:
         self._spec_fn = jax.jit(
             self._spec_verify_step, donate_argnums=(1,), static_argnums=(12,)
         )
-        # mixed prefill+decode step: decode rows (q_len=1, host-known
-        # carry) + prefill chunk rows in ONE [n, T] ragged dispatch;
-        # every row samples at its last valid column (all_greedy static)
+        # mixed prefill+decode step: decode rows (q_len=1 — or ragged
+        # 1+k VERIFY windows when spec composes) + prefill chunk rows in
+        # ONE [n, T] ragged dispatch; every row samples at its last
+        # valid column (all_greedy static)
         self._mixed_fn = jax.jit(
-            self._mixed_model_step, donate_argnums=(1,), static_argnums=(12,)
+            self._mixed_model_step, donate_argnums=(1,), static_argnums=(14,)
         )
         # occurrence counts for penalty sampling, allocated on first use
         # (B x V int8; ~33 MB at B=256, V=128k)
@@ -763,6 +771,7 @@ class JaxEngine:
             "mixed_steps": ps["mixed_steps"],
             "mixed_decode_rows": ps["mixed_decode_rows"],
             "mixed_prefill_tokens": ps["mixed_prefill_tokens"],
+            "mixed_spec_rows": ps["mixed_spec_rows"],
         }
 
     # ------------------------------------------------------------------
@@ -1019,23 +1028,24 @@ class JaxEngine:
         acceptance (ops/sampling.verify_draft_tokens) emits the accepted
         prefix plus one corrected/bonus token.
 
-        Attention is the chunked-prefill gather path (ops/attention.py):
-        multi-query positions over the sequence's slot matrix, KV written
-        first so each draft attends its accepted prefix — the same
-        unified-step contract prefill uses. Draft positions that end up
-        REJECTED leave garbage KV in their slots; that is sound because
-        the causal mask hides any slot beyond a query's position and the
-        next dispatches rewrite those slots before any query can reach
-        them (host-side num_computed/device_pos rewind keeps page
-        registration behind the accepted prefix).
+        Attention follows the unified-step contract prefill uses (KV
+        written first so each draft attends its accepted prefix): the
+        chunked-prefill gather oracle (ops/attention.py) off-TPU, and on
+        pallas engines the ragged flash kernel
+        (ops/pallas_attention.ragged_paged_attention — per-row q_pos0 /
+        q_len = draft_len+1, mid-page pos0 native) so the verify step
+        rides the same flash path the mixed step uses instead of paying
+        the gather oracle's materialized-logits cliff. Draft positions
+        that end up REJECTED leave garbage KV in their slots; that is
+        sound because the causal mask hides any slot beyond a query's
+        position and the next dispatches rewrite those slots before any
+        query can reach them (host-side num_computed/device_pos rewind
+        keeps page registration behind the accepted prefix).
 
         Returns ((out_tokens [B, T], n_emit [B]), kv)."""
         s = self.page_size
         b, w = block_tables.shape
         t = tokens.shape[1]
-        smat = (
-            block_tables[:, :, None] * s + jnp.arange(s, dtype=jnp.int32)
-        ).reshape(b, -1)
         max_len = self.config.max_model_len
         page_idx = jnp.minimum(positions // s, w - 1)
         wslots = (
@@ -1048,9 +1058,24 @@ class JaxEngine:
         wslots = jnp.where(
             active[:, None] & col_ok & (positions < max_len), wslots, 0
         ).astype(jnp.int32)
-        attn = llama.AttnSpec.gather(
-            smat, page_size=s, kv_tp=self.config.mesh.tp
-        )
+        if self._attn_pallas:
+            # ragged flash read (row-scatter write happens in
+            # llama._attn_block, same as the mixed step); inactive rows
+            # get q_len 0 and emit zeros
+            attn = llama.AttnSpec.gather(
+                None, page_size=s, interpret=self._attn_interpret,
+                mesh=self._attn_mesh, block_tables=block_tables,
+                q_pos0=positions[:, 0],
+                lengths=jnp.where(active, draft_len + 1, 0),
+                kv_tp=self.config.mesh.tp,
+            )
+        else:
+            smat = (
+                block_tables[:, :, None] * s + jnp.arange(s, dtype=jnp.int32)
+            ).reshape(b, -1)
+            attn = llama.AttnSpec.gather(
+                smat, page_size=s, kv_tp=self.config.mesh.tp
+            )
         hidden, kv = llama.forward(
             params, self.model_cfg, tokens, positions, kv,
             wslots.reshape(-1), attn,
@@ -1064,7 +1089,7 @@ class JaxEngine:
 
     def _mixed_model_step(self, params, kv, tokens, positions, write_slots,
                           slot_matrix, last_idx, temp, topk, topp, key,
-                          btables, all_greedy=False):
+                          btables, draft=None, dlen=None, all_greedy=False):
         """One MIXED prefill+decode step — the stall-free batching
         dispatch (Sarathi-style): tokens [n, T] where decode rows carry
         their host-known last token at q_len=1 and prefill rows carry
@@ -1075,11 +1100,24 @@ class JaxEngine:
         next token, final-chunk rows' sample is their first token,
         non-final chunk rows' sample is garbage the sync discards.
 
+        spec x mixed composition (`draft` [n, k_max] + `dlen` [n] set):
+        decode rows become ragged VERIFY rows — q_len = 1 + dlen (carry
+        plus n-gram drafts, exactly a standalone `_spec_verify_step`
+        window riding the unified step). Each row's logits are gathered
+        over a fixed (k_max+1)-wide window ending at its last valid
+        column, then `verify_draft_tokens` runs rejection-sampling
+        acceptance over ALL rows at once: prefill rows have dlen=0, so
+        their window column 0 IS the plain sample at last_idx (greedy:
+        the same argmax; sampled: the same shortlist distribution) and
+        n_emit=1. Returns ((out_tokens [n, k_max+1], n_emit [n]), kv)
+        in spec mode, (sampled [n], kv) otherwise.
+
         Attention backends: the gather oracle with ragged `q_lens`
         everywhere; on pallas engines a row-scatter KV write + the
         ragged flash kernel (`btables` set; the page-granular prefill
         scatter cannot express a decode row's mid-page write, see
-        llama._attn_block). Returns (sampled [n], kv)."""
+        llama._attn_block). Verify rows need nothing new from either
+        backend: they are just ragged rows whose q_pos0 is mid-page."""
         if btables is not None:
             attn = llama.AttnSpec.gather(
                 None, page_size=self.page_size,
@@ -1095,6 +1133,24 @@ class JaxEngine:
         hidden, kv = llama.forward(
             params, self.model_cfg, tokens, positions, kv, write_slots, attn
         )
+        if draft is not None:
+            # spec window: gather (k_max+1) hidden columns per row ending
+            # at last_idx — decode verify rows span [0, dlen] (offset 0
+            # since last_idx == dlen), prefill rows put their sample
+            # column at window slot 0 and the clamped tail is garbage
+            # verify never reads (dlen == 0 -> n_emit == 1)
+            win = draft.shape[1] + 1
+            offs = jnp.minimum(
+                (last_idx - dlen)[:, None] + jnp.arange(win, dtype=jnp.int32),
+                tokens.shape[1] - 1,
+            ).astype(jnp.int32)
+            win_h = jnp.take_along_axis(hidden, offs[:, :, None], axis=1)
+            lg = llama.logits(params, self.model_cfg, win_h)  # [n, win, V]
+            out, n_emit = verify_draft_tokens(
+                lg, draft, dlen, key, temp, topk, topp,
+                all_greedy=all_greedy,
+            )
+            return (out, n_emit), kv
         last_h = jnp.take_along_axis(
             hidden, last_idx[:, None, None].astype(jnp.int32), axis=1
         )[:, 0]  # [n, D]
@@ -1498,7 +1554,10 @@ class JaxEngine:
                 # seed the n-gram index with the prompt once; the index
                 # survives preemption (the token history it covers does
                 # not change across a re-prefill)
-                seq.spec = NgramProposer(self.config.spec_ngram_max)
+                seq.spec = NgramProposer(
+                    self.config.spec_ngram_max,
+                    self.config.spec_index_window,
+                )
                 seq.spec.extend(seq.tokens)
             if seq.has_penalties:
                 self._count_prompt(seq)
@@ -2135,9 +2194,9 @@ class JaxEngine:
     def _mixed_unsupported_reason(self) -> Optional[str]:
         """None when mixed steps can run on this engine, else the reason
         — init raises it for an explicit misconfig, the runtime toggle
-        logs it once and keeps the normal paths."""
-        if self.config.spec_decode:
-            return "mixed_batching and spec_decode are mutually exclusive (v1)"
+        logs it once and keeps the normal paths. spec_decode COMPOSES
+        (spec-eligible decode rows ride mixed steps as ragged q_len=1+k
+        verify rows — see _build_mixed); it is no longer an exclusion."""
         if self._pp:
             return "mixed_batching unsupported with pp>1 (v1)"
         if self._sp:
@@ -2237,12 +2296,41 @@ class JaxEngine:
         rows = self._mixed_eligible_decode()
         if not rows:
             return None
+        # spec x mixed composition: propose n-gram drafts for the decode
+        # rows up front — each spec row costs 1 + k budget tokens, so
+        # drafts trade off transparently against prefill chunk size. A
+        # discarded build never strands a probe (only observe() re-arms
+        # the proposer's countdown).
+        drafts: dict[int, list[int]] = {}
+        if self.config.spec_decode and self.config.mixed_spec:
+            k_cap = min(self.config.spec_k_max, self.config.prefill_chunk - 1)
+            for i, seq in rows:
+                remaining = seq.max_new_tokens - seq.generated
+                room = self.config.max_model_len - 1 - seq.device_pos
+                k_i = min(k_cap, remaining - 1, room)
+                d = seq.spec.maybe_draft(k_i) if seq.spec is not None else []
+                if d:
+                    drafts[i] = d
         budget = self.config.mixed_step_tokens
-        n_dec = len(rows)
+        dec_cost = sum(1 + len(drafts.get(i, ())) for i, _ in rows)
+
+        def shed_drafts_to(room: int) -> int:
+            # drafts must never abort the stall-free step itself — a
+            # decode row is always valid at q_len=1, so shed drafts
+            # (arbitrary rows) until the budget fits both planes again;
+            # discarded drafts never strand a probe (only observe()
+            # re-arms the proposer's countdown)
+            cost = dec_cost
+            while cost > room and drafts:
+                _, d = drafts.popitem()
+                cost -= len(d)
+            return cost
+
         if self.config.mixed_decode_priority:
-            # latency-leaning default: every decode row joins (1 budget
-            # token each), prefill shrinks into what is left
-            leftover = budget - n_dec
+            # latency-leaning default: every decode row joins (1 + k
+            # budget tokens each), prefill shrinks into what is left
+            dec_cost = shed_drafts_to(budget - 1)
+            leftover = budget - dec_cost
             if leftover < 1:
                 return None  # budget cannot fit both planes
             picks = self._select_mixed_prefill(leftover)
@@ -2252,21 +2340,24 @@ class JaxEngine:
             # of them (a partial decode batch would starve the tail rows
             # — the normal alternating paths serve this case better)
             picks = self._select_mixed_prefill(budget)
-            if budget - sum(c for _, c in picks) < n_dec:
+            dec_cost = shed_drafts_to(budget - sum(c for _, c in picks))
+            if budget - sum(c for _, c in picks) < dec_cost:
                 return None
         if not picks:
             return None
         if self._inflight is not None:
             return "hold"
-        # grow decode rows' pages through the position this step writes;
-        # growth may preempt (possibly a participant) — refilter both
-        # sides against the post-growth slot state
+        # grow decode rows' pages through the positions this step writes
+        # ([device_pos, device_pos + drafts]); growth may preempt
+        # (possibly a participant) — refilter both sides against the
+        # post-growth slot state
         max_pos = self.config.max_model_len - 1
-        for _, seq in rows:
+        for i, seq in rows:
             if seq.slot < 0 or self.slots[seq.slot] is not seq:
                 continue
             if not self._ensure_pages_through(
-                seq, min(seq.device_pos, max_pos)
+                seq,
+                min(seq.device_pos + len(drafts.get(i, ())), max_pos),
             ):
                 return None  # growth preempted its own row; retry next tick
         rows = [
@@ -2279,12 +2370,16 @@ class JaxEngine:
         ]
         if not rows or not picks:
             return None
-        bld = self._build_mixed(rows, picks)
+        bld = self._build_mixed(rows, picks, drafts)
         t0 = time.perf_counter()
         try:
             S = await asyncio.to_thread(self._run_mixed_dispatch, bld)
             t_sync0 = time.perf_counter()
-            toks = await asyncio.to_thread(np.asarray, S)
+            # spec mode returns (out_tokens [n, k+1], n_emit [n])
+            toks = await asyncio.to_thread(
+                lambda: tuple(np.asarray(a) for a in S)
+                if isinstance(S, tuple) else np.asarray(S)
+            )
         except Exception:
             # contain the failure like _prefill_tick does: nothing was
             # advanced (bookkeeping happens at sync), so the normal
@@ -2312,16 +2407,25 @@ class JaxEngine:
         self._sync_mixed(bld, toks)
         return True
 
-    def _build_mixed(self, rows: list, picks: list) -> dict:
+    def _build_mixed(self, rows: list, picks: list,
+                     drafts: Optional[dict] = None) -> dict:
         """Host-side input build for one mixed step: decode rows first
-        (q_len=1, their host-known carry token), then one chunk per
+        (q_len=1, their host-known carry token — or a ragged 1+k verify
+        window [carry, d_1..d_k] when spec composes), then one chunk per
         prefill pick. Row count pads to a power of two and T to the
         chunk's prefill bucket, so the compiled families stay the
-        [pow2, bucket] grid group prefill already uses."""
+        [pow2, bucket] grid group prefill already uses (the verify
+        window k_max+1 never exceeds the smallest bucket in practice;
+        t_b covers it explicitly regardless)."""
         ps = self.page_size
+        use_spec = bool(drafts)
+        k_max = self.config.spec_k_max if use_spec else 0
+        max_len = self.config.max_model_len
         n_rows = len(rows) + len(picks)
         n = 1 << (n_rows - 1).bit_length()
-        t_b = self._bucket_for(max(c for _, c in picks))
+        t_b = self._bucket_for(
+            max(max(c for _, c in picks), k_max + 1)
+        )
         tok_arr = np.zeros((n, t_b), np.int32)
         pos_arr = np.zeros((n, t_b), np.int32)
         wslots = np.zeros((n, t_b), np.int32)
@@ -2329,6 +2433,9 @@ class JaxEngine:
         temp = np.zeros(n, np.float32)
         topk = np.zeros(n, np.int32)
         topp = np.ones(n, np.float32)
+        draft_arr = np.zeros((n, k_max), np.int32) if use_spec else None
+        dlen_arr = np.zeros(n, np.int32) if use_spec else None
+        pos0_arr = np.zeros(n, np.int32)
         smat = (
             None if self._attn_pallas
             else np.zeros((n, self._smat_width), np.int32)
@@ -2337,19 +2444,35 @@ class JaxEngine:
         w_need = 1
         j = 0
         for slot, seq in rows:
+            d = drafts.get(slot, []) if use_spec else []
+            kd = len(d)
+            pages = np.asarray(seq.page_ids, np.int32)
+            idx = seq.device_pos + np.arange(kd + 1)
             tok_arr[j, 0] = seq.last_token
-            pos_arr[j, 0] = seq.device_pos
-            wslots[j, 0] = self._write_slot(seq, seq.device_pos)
+            if kd:
+                tok_arr[j, 1:kd + 1] = d
+                draft_arr[j, :kd] = d
+            if use_spec:
+                dlen_arr[j] = kd
+            pos_arr[j, :kd + 1] = idx
+            pos0_arr[j] = seq.device_pos
+            # past-budget positions write the trash page (same clamp the
+            # standalone verify build applies)
+            ok = idx < max_len
+            wslots[j, :kd + 1] = np.where(
+                ok, pages[np.minimum(idx, max_len - 1) // ps] * ps + idx % ps, 0
+            )
             if smat is not None:
                 smat[j] = self._slot_matrix_row(seq)
+            last_idx[j] = kd
             temp[j] = seq.temperature
             topk[j] = seq.top_k
             topp[j] = seq.top_p
-            w_need = max(w_need, seq.device_pos // ps + 1)
+            w_need = max(w_need, (seq.device_pos + kd) // ps + 1)
             # the host-built window replaces any carry override for this
             # slot (its token is already in host history)
             self._overrides.pop(slot, None)
-            entries.append(("dec", slot, seq, 1))
+            entries.append(("dec", slot, seq, 1 + kd))
             j += 1
         for seq, chunk in picks:
             tokens = seq.tokens
@@ -2357,6 +2480,7 @@ class JaxEngine:
             idx = np.arange(start, start + chunk)
             tok_arr[j, :chunk] = tokens[start:start + chunk]
             pos_arr[j, :chunk] = idx
+            pos0_arr[j] = start
             pages = np.asarray(seq.page_ids, np.int32)
             wslots[j, :chunk] = pages[idx // ps] * ps + idx % ps
             if smat is not None:
@@ -2383,12 +2507,14 @@ class JaxEngine:
             tok=tok_arr, pos=pos_arr, wslots=wslots, smat=smat,
             last_idx=last_idx, temp=temp, topk=topk, topp=topp,
             btables=btables, entries=entries,
+            spec=use_spec, draft=draft_arr, dlen=dlen_arr, pos0=pos0_arr,
             all_greedy=bool((temp[:n_rows] <= 0.0).all()),
         )
 
     def _run_mixed_dispatch(self, bld: dict):
         """Jax half of a mixed step (worker thread, _kv_lock): returns
-        the device sampled-token vector [n]."""
+        the device sampled-token vector [n], or (out_tokens [n, k+1],
+        n_emit [n]) when spec verify rows composed in."""
         t0 = time.perf_counter()
         with self._kv_lock:
             self._key, sub = jax.random.split(self._key)
@@ -2402,32 +2528,59 @@ class JaxEngine:
                 jnp.asarray(bld["topp"]), sub,
                 jnp.asarray(bld["btables"])
                 if bld["btables"] is not None else None,
+                jnp.asarray(bld["draft"]) if bld["spec"] else None,
+                jnp.asarray(bld["dlen"]) if bld["spec"] else None,
                 bld["all_greedy"],
             )
         self._step_count += 1
-        S.copy_to_host_async()
+        for arr in (S if isinstance(S, tuple) else (S,)):
+            arr.copy_to_host_async()
         with self._phase_lock:
             self._phase_stats["mixed_dispatch_s"] += time.perf_counter() - t0
         return S
 
-    def _sync_mixed(self, bld: dict, toks: np.ndarray) -> None:
+    def _sync_mixed(self, bld: dict, toks) -> None:
         """Land a mixed step (event-loop thread): emit decode rows' next
         tokens and final chunks' first tokens, advance prefill
         bookkeeping, and re-arm each surviving row's carry override so a
         following NORMAL decode dispatch consumes the right token (mixed
         windows are host-built and never touch the device carry
-        vector — the same contract as spec verify)."""
-        n_dec = n_pf_tokens = 0
+        vector — the same contract as spec verify).
+
+        spec mode (`toks` = (out [n, k+1], n_emit [n])): decode rows
+        emit their accepted prefix + corrected/bonus token and REWIND
+        exactly like _sync_spec — num_computed/device_pos/page
+        registration advance only past emitted tokens, so a rejected
+        tail's garbage KV stays unregistered and is rewritten before any
+        query can attend it. Prefill rows read their sample from window
+        column 0 (n_emit is 1 there by construction)."""
+        spec_mode = bld["spec"]
+        if spec_mode:
+            out, n_emit = toks
+        n_dec = n_dec_tokens = n_pf_tokens = 0
+        spec_rows = drafted_total = accepted_total = emitted_total = 0
         now = time.perf_counter()
         for j, (kind, slot, seq, chunk) in enumerate(bld["entries"]):
             if kind == "dec":
                 n_dec += 1
+                n_dec_tokens += chunk
             else:
                 n_pf_tokens += chunk
             if slot < 0 or seq.slot != slot or self.slots[slot] is not seq:
                 continue  # finished/preempted while the step ran
-            tok = int(toks[j])
+            tok = int(out[j, 0]) if spec_mode else int(toks[j])
             if kind == "dec":
+                if spec_mode:
+                    spec_rows += 1
+                    drafted = int(bld["dlen"][j])
+                    emitted, accepted = self._emit_verify_row(
+                        slot, seq, out[j], int(n_emit[j]), drafted,
+                        int(bld["pos0"][j]),
+                    )
+                    drafted_total += drafted
+                    accepted_total += accepted
+                    emitted_total += emitted
+                    continue
                 seq.device_pos += 1
                 seq.num_computed += 1
                 self._register_full_pages(seq)
@@ -2460,9 +2613,17 @@ class JaxEngine:
             st["mixed_steps"] += 1
             st["mixed_decode_rows"] += n_dec
             st["mixed_prefill_tokens"] += n_pf_tokens
+            # budget accounting counts 1 + drafts per decode row — the
+            # cap the scheduler must keep under mixed_step_tokens
             st["mixed_step_tokens_max"] = max(
-                st["mixed_step_tokens_max"], n_dec + n_pf_tokens
+                st["mixed_step_tokens_max"], n_dec_tokens + n_pf_tokens
             )
+            if spec_mode:
+                st["mixed_spec_rows"] += spec_rows
+                st["spec_rows"] += spec_rows
+                st["spec_drafted"] += drafted_total
+                st["spec_accepted"] += accepted_total
+                st["spec_emitted"] += emitted_total
 
     # ---- decode -------------------------------------------------------
 
@@ -2647,6 +2808,20 @@ class JaxEngine:
         active, b = prep
         t = k_max + 1
         w = self.config.max_pages_per_seq
+        if self._attn_pallas:
+            # ragged flash kernel: attended-page width buckets to a
+            # power of two like group prefill and the mixed build — the
+            # kernel's page BlockSpecs DMA every table column per grid
+            # step, so a full-width table would stream (mostly trash)
+            # pages the causal mask never reads. Truncation is sound:
+            # every attended position <= device_pos + draft_len lies
+            # inside w_need pages.
+            ps = self.page_size
+            w_need = max(
+                (s.device_pos + len(drafts.get(s.slot, ()))) // ps + 1
+                for _, s in active
+            )
+            w = min(1 << (w_need - 1).bit_length(), w)
         tokens = np.zeros((b, t), np.int32)
         positions = np.zeros((b, t), np.int32)
         tables = np.zeros((b, w), np.int32)
@@ -2667,7 +2842,8 @@ class JaxEngine:
                 draft[i, :len(d)] = d
                 dlen[i] = len(d)
             positions[i] = seq.device_pos + np.arange(t, dtype=np.int32)
-            tables[i, : len(seq.page_ids)] = seq.page_ids
+            npg = min(len(seq.page_ids), w)
+            tables[i, :npg] = seq.page_ids[:npg]
             temp[i] = seq.temperature
             topk[i] = seq.top_k
             topp[i] = seq.top_p
@@ -2902,47 +3078,57 @@ class JaxEngine:
                     tops=top_list(seq, step, i),
                 )
 
+    def _emit_verify_row(self, slot: int, seq: Sequence, out_row,
+                         n: int, drafted: int, base: int) -> tuple:
+        """Land ONE verify row (shared by the standalone spec sync and
+        the mixed-step spec sync — the rollback invariants must not
+        fork): emit the accepted prefix + corrected/bonus token, then
+        REWIND the paged-cache bookkeeping to the accepted length —
+        num_computed, device_pos and prefix-page registration advance
+        only past tokens actually emitted, so the garbage KV a rejected
+        tail left in its slots stays unregistered and is rewritten by
+        the very next dispatch before any query can attend it. Returns
+        (emitted, accepted)."""
+        emitted = 0
+        for j in range(n):
+            if self.slots[slot] is not seq:
+                break  # EOS/length mid-window: the tail is discarded
+            seq.num_computed += 1
+            seq.device_pos = base + j + 1
+            self._register_full_pages(seq)
+            self._append_token(seq, int(out_row[j]))
+            emitted += 1
+        # counters reflect what actually LANDED: when an emitted draft
+        # finished the stream (EOS) the discarded tail — and the
+        # never-emitted bonus — must not inflate acceptance
+        accepted = n - 1 if emitted == n else emitted
+        if seq.spec is not None and drafted:
+            seq.spec.observe(drafted, accepted)
+        if self.slots[slot] is seq:
+            # the last emitted token is the new decode carry; a
+            # following NORMAL dispatch consumes it via the int
+            # override scatter (verify windows are host-built and
+            # never touch the device carry vector)
+            self._overrides[slot] = int(out_row[n - 1])
+        return emitted, accepted
+
     def _sync_spec(self, d: _Dispatch, arrs) -> None:
-        """Land a speculative verify dispatch: emit each row's accepted
-        prefix + corrected/bonus token, then REWIND the paged-cache
-        bookkeeping to the accepted length — num_computed, device_pos
-        and prefix-page registration advance only past tokens that were
-        actually emitted, so the garbage KV a rejected tail left in its
-        slots stays unregistered and is rewritten by the very next
-        dispatch before any query can attend it."""
+        """Land a speculative verify dispatch: one `_emit_verify_row`
+        per surviving row (emit accepted prefix + corrected/bonus token,
+        rewind bookkeeping to the accepted length)."""
         toks, n_emit = arrs[0], arrs[1]  # [B, T] i32, [B] i32
         drafted_total = accepted_total = emitted_total = rows = 0
         for i, seq in d.snapshot:
             if self.slots[i] is not seq:
                 continue  # finished/preempted meanwhile
             rows += 1
-            n = int(n_emit[i])
             drafted = int(d.draft_lens[i])
-            base = int(d.pos0[i])
-            emitted = 0
-            for j in range(n):
-                if self.slots[i] is not seq:
-                    break  # EOS/length mid-window: the tail is discarded
-                seq.num_computed += 1
-                seq.device_pos = base + j + 1
-                self._register_full_pages(seq)
-                self._append_token(seq, int(toks[i, j]))
-                emitted += 1
-            # counters reflect what actually LANDED: when an emitted
-            # draft finished the stream (EOS) the discarded tail — and
-            # the never-emitted bonus — must not inflate acceptance
-            accepted = n - 1 if emitted == n else emitted
-            if seq.spec is not None and drafted:
-                seq.spec.observe(drafted, accepted)
+            emitted, accepted = self._emit_verify_row(
+                i, seq, toks[i], int(n_emit[i]), drafted, int(d.pos0[i])
+            )
             drafted_total += drafted
             accepted_total += accepted
             emitted_total += emitted
-            if self.slots[i] is seq:
-                # the last emitted token is the new decode carry; a
-                # following NORMAL dispatch consumes it via the int
-                # override scatter (spec windows are host-built and
-                # never touch the device carry vector)
-                self._overrides[i] = int(toks[i, n - 1])
         with self._phase_lock:
             self._phase_stats["spec_rows"] += rows
             self._phase_stats["spec_drafted"] += drafted_total

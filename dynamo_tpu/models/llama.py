@@ -542,11 +542,12 @@ def _attn_block(
             k.reshape(b * t, kh * hd), v.reshape(b * t, kh * hd),
         )
         if attn.block_tables is not None and attn.q_pos0 is not None:
-            # mixed prefill+decode step on the pallas backend: the WRITE
-            # is the row scatter above — decode rows land mid-page, which
-            # the page-granular prefill scatter cannot express — and the
-            # READ is the ragged flash kernel (per-row q_pos0/q_len;
-            # decode rows are q_len=1, chunk rows causal inside the chunk)
+            # mixed prefill+decode and spec-verify steps on the pallas
+            # backend: the WRITE is the row scatter above — decode and
+            # verify rows land mid-page, which the page-granular prefill
+            # scatter cannot express — and the READ is the ragged flash
+            # kernel (per-row q_pos0/q_len; decode rows are q_len=1,
+            # verify rows q_len=1+k, chunk rows causal inside the chunk)
             from dynamo_tpu.ops.pallas_attention import ragged_paged_attention
 
             rg = functools.partial(

@@ -871,11 +871,13 @@ def ragged_paged_attention(
     page_size: int,
     interpret: bool = False,
 ) -> jax.Array:
-    """Read-only paged attention with PER-ROW query lengths — the mixed
-    prefill+decode step's kernel (KV already written, row-scattered by
-    the caller): decode rows are q_len=1 at an arbitrary (mid-page)
-    position, chunked-prefill rows span [q_pos0, q_pos0+q_len) with
-    causal masking inside the chunk, padding rows (q_len=0) emit zeros.
+    """Read-only paged attention with PER-ROW query lengths — the kernel
+    behind the mixed prefill+decode step AND the pallas spec-verify path
+    (KV already written, row-scattered by the caller): decode rows are
+    q_len=1 at an arbitrary (mid-page) position, speculative verify rows
+    span q_len = draft_len+1 from a mid-page q_pos0, chunked-prefill
+    rows span [q_pos0, q_pos0+q_len) with causal masking inside the
+    chunk, padding rows (q_len=0) emit zeros.
 
     Delegates to the flash prefill kernel (ops/pallas_prefill.py), whose
     online-softmax grid already handles per-row ragged lengths; unlike

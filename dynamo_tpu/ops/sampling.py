@@ -192,7 +192,11 @@ def verify_draft_tokens(
     """Speculative-decoding verification over a batch of drafted windows.
 
     The engine ran ONE model step over [carry, d_1, .., d_k] and `logits`
-    holds the target distribution at every window position. Acceptance:
+    holds the target distribution at every window position — either a
+    standalone verify dispatch (`_spec_verify_step`) or the decode rows
+    of a MIXED step (`_mixed_model_step`, where prefill rows ride along
+    with draft_len=0: their window column 0 is then exactly the plain
+    sampler's draw and n_emit is 1). Acceptance:
 
     - greedy rows: exact match — d_j is accepted iff it equals the argmax
       at position j-1, so the emitted stream is byte-identical to the

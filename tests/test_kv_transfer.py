@@ -145,3 +145,51 @@ def test_page_size_mismatch_rejected():
         raise AssertionError("expected ValueError")
     except ValueError as e:
         assert "page-size mismatch" in str(e)
+
+
+def test_kv_quant_mismatch_rejected():
+    """An int8-KV source cannot device-transfer into a model-dtype pool
+    (or vice versa): the device path moves raw rows and has no
+    quantize/dequantize step — mixed pairs must go through the
+    host-staged plane, which converts on injection."""
+    import pytest
+
+    src = make_engine()
+    dst = make_engine(kv_quantization="int8")
+    with pytest.raises(ValueError, match="kv_quantization"):
+        device_transfer_kv(src, dst, [1], [1], 8)
+    # and the mirrored direction
+    with pytest.raises(ValueError, match="kv_quantization"):
+        device_transfer_kv(dst, src, [1], [1], 8)
+
+
+async def test_round_trip_restores_exact_rows():
+    """gather -> reshard -> scatter restores the source rows EXACTLY
+    (every layer, K and V, partial trailing page included) — the
+    device path must be bit-faithful, not merely token-faithful."""
+    prompt = [5, 17, 42, 9, 88, 3, 14, 21, 77, 31]  # 10 tokens: partial page
+    src = make_engine()
+    dst = make_engine()
+    _, src_pages, n_kv = await _prefill_on(src, prompt)
+
+    dst_pages = dst.allocator.allocate(len(src_pages))
+    device_transfer_kv(src, dst, src_pages, dst_pages, n_kv)
+
+    def slots(pages, ps):
+        return (
+            np.asarray(pages)[:, None] * ps + np.arange(ps)
+        ).reshape(-1)[:n_kv]
+
+    s_sl = slots(src_pages, src.page_size)
+    d_sl = slots(dst_pages, dst.page_size)
+    for layer in range(len(src.kv.k)):
+        np.testing.assert_array_equal(
+            np.asarray(src.kv.k[layer][s_sl]),
+            np.asarray(dst.kv.k[layer][d_sl]),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(src.kv.v[layer][s_sl]),
+            np.asarray(dst.kv.v[layer][d_sl]),
+        )
+    await src.close()
+    await dst.close()

@@ -184,17 +184,22 @@ async def test_mixed_with_int8_kv_gather_matches_plain():
 def test_mixed_incompatible_configs_raise():
     import pytest
 
-    with pytest.raises(ValueError, match="mutually exclusive"):
-        make_engine(mixed_batching=True, spec_decode=True)
     with pytest.raises(ValueError, match="mixed_step_tokens"):
         make_engine(mixed_batching=True, mixed_step_tokens=0)
+    # spec_decode is NOT an exclusion anymore: the two features compose
+    # (ragged verify rows inside mixed steps, tests/test_spec_mixed.py)
+    engine = make_engine(mixed_batching=True, spec_decode=True)
+    assert engine._mixed_unsupported_reason() is None
 
 
 async def test_mixed_runtime_toggle_on_unsupported_engine_degrades():
     """Toggling mixed_batching on at runtime (the bench A/B pattern) on
     an engine whose config cannot support it must keep serving through
     the normal paths, not corrupt or crash."""
-    engine = make_engine(spec_decode=True)  # mixed+spec mutually exclusive
+    from dynamo_tpu.parallel.mesh import MeshConfig
+
+    # pp>1: the stage executor has no ragged multi-query step
+    engine = make_engine(mesh=MeshConfig(pp=2))
     engine.config.mixed_batching = True
     held, streams = await _admission_wave(engine, settle_s=0.5)
     ps = engine.phase_stats

@@ -114,6 +114,35 @@ def test_ngram_proposer_lookup_and_gating():
 # ops-level verification sampler
 
 
+def test_ngram_index_window_bounds_memory():
+    """The proposer must stay bounded on arbitrarily long streams: a
+    100k-token extend with a 1k-position window may hold at most
+    window x ngram_max index entries (and at most ~2 windows of
+    history), old registrations are evicted, and a recurring n-gram
+    re-registered inside the window keeps drafting."""
+    rng = np.random.RandomState(3)
+    p = NgramProposer(3, index_window=1000)
+    p.extend(rng.randint(1, 64, size=100_000).tolist())
+    assert len(p._index) <= 3 * 1000
+    # history keeps the windowed tail only (chunked truncation: < 2x)
+    assert len(p.history) < 2 * 1000
+    assert p._hist_base + len(p.history) == 100_000
+    # an n-gram seen ONLY before the window is gone (no stale drafts)
+    p2 = NgramProposer(3, index_window=100)
+    p2.extend([201, 202, 203, 204])
+    p2.extend(list(range(1, 150)))
+    assert p2.propose(4) == []
+    assert (201, 202, 203) not in p2._index
+    # ...but a recent recurrence still drafts
+    p3 = NgramProposer(3, index_window=100)
+    p3.extend([1, 2, 3, 4, 1, 2, 3])
+    assert p3.propose(3) == [4, 1, 2]
+    # default window comes from EngineConfig.spec_index_window
+    from dynamo_tpu.engine import EngineConfig
+
+    assert EngineConfig().spec_index_window == 8192
+
+
 def test_verify_greedy_exact_match():
     V = 16
     logits = jax.random.normal(jax.random.PRNGKey(3), (2, 4, V)) * 3
