@@ -66,6 +66,12 @@ MIXED_OSL = int(os.environ.get("BENCH_MIXED_OSL", str(max(OSL, 128))))
 # the stdout line stays the one-line headline artifact. Downstream
 # trajectory tooling parses the file, not stdout.
 BENCH_OUT = os.environ.get("BENCH_OUT", "")
+# BENCH_TRACE=path: arm the span recorder (dynamo_tpu/utils/tracing.py)
+# for the whole run and dump Chrome/Perfetto trace-event JSON there at
+# exit — request spans (submit->finish) plus the engine step timeline
+# (prefill/decode/mixed/spec_verify dispatches with rows/tokens/walls).
+# Load the file at https://ui.perfetto.dev (docs/observability.md).
+BENCH_TRACE = os.environ.get("BENCH_TRACE", "")
 
 ENV_HELP = """bench.py — serving benchmark; configuration via env vars:
   BENCH_MODEL                  preset override (auto-picked from HBM)
@@ -100,6 +106,10 @@ ENV_HELP = """bench.py — serving benchmark; configuration via env vars:
                                {headline, spec, mixed, mixed_spec}
                                (sections not run are null); stdout keeps
                                the one-line headline artifact
+  BENCH_TRACE                  path: record the whole run with the span
+                               recorder (utils/tracing.py) and dump
+                               Perfetto-loadable trace-event JSON there
+                               (request spans + engine step timeline)
   (BENCH_MIXED=1 BENCH_SPEC=1 together add the COMPOSED spec x mixed
   A/B: repetitive held streams + an admission wave, mixed-only vs
   mixed+spec — ragged verify rows inside the mixed steps)
@@ -118,6 +128,11 @@ def main() -> None:
     from dynamo_tpu.runtime.pipeline.context import Context
 
     import __graft_entry__
+
+    if BENCH_TRACE:
+        from dynamo_tpu.utils import tracing
+
+        tracing.enable()
 
     cfg = __graft_entry__._pick_config(QUANT)
     if os.environ.get("BENCH_MODEL"):
@@ -847,6 +862,13 @@ def main() -> None:
                 indent=2,
             )
             f.write("\n")
+    if BENCH_TRACE:
+        import sys
+
+        # stdout stays the one-line headline artifact; the trace note
+        # goes to stderr like other diagnostics
+        n_ev = engine.dump_trace(BENCH_TRACE)
+        print(f"trace: {n_ev} events -> {BENCH_TRACE}", file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -71,6 +71,7 @@ from dynamo_tpu.ops.sampling import (
 )
 from dynamo_tpu.parallel import mesh as meshmod
 from dynamo_tpu.runtime.pipeline.context import Context
+from dynamo_tpu.utils import tracing
 
 log = logging.getLogger("dynamo_tpu.engine")
 
@@ -398,6 +399,11 @@ class JaxEngine:
 
         self._event_seq = 0
         self._event_subscribers: list[Callable[[dict], None]] = []
+        # per-request finish summaries (ttft/itl/queue-wait/tokens) feed
+        # the Prometheus histograms (llm/http/metrics.EngineMetrics) and
+        # anything else that wants request-level latency without scraping
+        # per-frame meta fields
+        self._request_observers: list[Callable[[dict], None]] = []
         self.allocator = PageAllocator(
             self.num_pages, self.page_size, on_event=self._emit_event,
             on_cached=self._on_page_cached if config.host_kv_pages else None,
@@ -740,6 +746,19 @@ class JaxEngine:
                 cb(event)
             except Exception:
                 log.exception("kv event subscriber failed")
+
+    def subscribe_requests(self, cb: Callable[[dict], None]) -> None:
+        """Per-request finish summaries: {request_id, finish_reason,
+        prompt_tokens, tokens, queue_wait_s, ttft_s, itl_s} — fired once
+        per sequence at finish (see _finish)."""
+        self._request_observers.append(cb)
+
+    def dump_trace(self, path: str) -> int:
+        """Write the process trace ring (utils/tracing.py) as
+        Chrome/Perfetto trace-event JSON; returns the event count.
+        Recording must be armed (DYN_TRACE=1 or tracing.enable()) for
+        the engine's step timeline and request spans to be present."""
+        return tracing.dump(path)
 
     def metrics(self) -> dict:
         """ForwardPassMetrics equivalent (reference:
@@ -1224,6 +1243,12 @@ class JaxEngine:
             request, pre, self.page_size, self.config.max_model_len
         )
         seq.t_submit = time.perf_counter()
+        if tracing.enabled():
+            tracing.instant(
+                "seq.submit", cat="lifecycle", req=request.id,
+                ts=seq.t_submit, seq_id=seq.seq_id,
+                prompt_tokens=seq.prompt_len,
+            )
         seq.preloaded = _preloaded
         self.waiting.append(seq)
         self._ensure_loop()
@@ -1439,6 +1464,7 @@ class JaxEngine:
             except (asyncio.CancelledError, Exception):  # noqa: BLE001
                 pass
         for seq in list(self.waiting) + [s for s in self.slots if s]:
+            self._note_finished(seq, FINISH_REASON_CANCELLED)
             seq.out_queue.put_nowait(
                 EngineOutput.final(FINISH_REASON_CANCELLED).to_dict()
             )
@@ -1447,6 +1473,10 @@ class JaxEngine:
     # main loop
 
     async def _loop(self) -> None:
+        # the loop task inherits the contextvars of WHICHEVER request
+        # created it; unbind the request id so engine-loop log records
+        # and spans never join against that arbitrary first request
+        tracing.set_request(None)
         try:
             while not self._closed:
                 # offload first: pending write-through copies must pin
@@ -1501,6 +1531,10 @@ class JaxEngine:
         except Exception:
             log.exception("engine loop crashed; failing all requests")
             for seq in list(self.waiting) + [s for s in self.slots if s]:
+                # the observability plane must cover the failure case it
+                # exists for: histograms + the request trace span record
+                # these as errors, same as a per-sequence _finish would
+                self._note_finished(seq, FINISH_REASON_ERROR)
                 seq.out_queue.put_nowait(EngineOutput.final("error").to_dict())
             self.waiting.clear()
             self.slots = [None] * len(self.slots)
@@ -1527,6 +1561,9 @@ class JaxEngine:
             seq = self.waiting[0]
             if seq.ctx.is_stopped():
                 self.waiting.popleft()
+                # observability parity with _finish: requests that die in
+                # the waiting queue still count in histograms/trace spans
+                self._note_finished(seq, FINISH_REASON_CANCELLED)
                 seq.out_queue.put_nowait(
                     EngineOutput.final(FINISH_REASON_CANCELLED).to_dict()
                 )
@@ -1534,6 +1571,7 @@ class JaxEngine:
                 continue
             if seq.max_new_tokens <= 0:
                 self.waiting.popleft()
+                self._note_finished(seq, FINISH_REASON_LENGTH)
                 seq.out_queue.put_nowait(
                     EngineOutput.final(FINISH_REASON_LENGTH).to_dict()
                 )
@@ -1545,6 +1583,12 @@ class JaxEngine:
             seq.slot = slot
             seq.prefilling = True
             seq.t_admit = time.perf_counter()
+            if tracing.enabled():
+                tracing.instant(
+                    "seq.admit", cat="lifecycle", req=seq.ctx.id,
+                    ts=seq.t_admit, slot=slot,
+                    prefix_cached_tokens=seq.num_cached,
+                )
             seq.first_meta = {
                 "prefix_cached_tokens": seq.num_cached,
                 "prompt_tokens": seq.prompt_len,
@@ -2079,20 +2123,31 @@ class JaxEngine:
         # returns asynchronously (measured 0.125 s of calls for 196k
         # prefill tokens); the token counters are the load-bearing part
         now = time.perf_counter()
+        n_tok = int(
+            sum(min(s.total_tokens - s.num_computed, bucket) for s in seqs)
+        )
         with self._phase_lock:
             self._phase_stats["prefill_dispatch_s"] += now - t_dispatch0
             self._phase_stats["prefill_dispatches"] += 1
-            self._phase_stats["prefill_tokens"] += int(
-                sum(
-                    min(s.total_tokens - s.num_computed, bucket)
-                    for s in seqs
-                )
+            self._phase_stats["prefill_tokens"] += n_tok
+        if tracing.enabled():
+            # step timeline: same site that feeds _phase_stats, so the
+            # trace and the counters can never disagree about a dispatch
+            tracing.complete(
+                "prefill", t_dispatch0, now, cat="step",
+                track="engine.steps", rows=len(seqs), tokens=n_tok,
+                bucket=bucket,
             )
         for seq in seqs:
             if seq.num_computed + min(
                 seq.total_tokens - seq.num_computed, bucket
             ) >= seq.total_tokens:
                 seq.t_first_dispatched = now
+                if tracing.enabled():
+                    tracing.instant(
+                        "seq.first_dispatch", cat="lifecycle",
+                        req=seq.ctx.id, ts=now,
+                    )
                 # restore-gate calibration: the prefill rate a request
                 # actually experiences (admission -> prompt computed,
                 # batching included) is the recompute side of the
@@ -2404,6 +2459,11 @@ class JaxEngine:
             # the whole dispatch+fetch wall is time the decode rows did
             # NOT spend parked behind a separate prefill dispatch
             self._phase_stats["mixed_decode_stall_saved_s"] += now - t0
+        if tracing.enabled():
+            tracing.complete(
+                "mixed.sync", t_sync0, now, cat="step",
+                track="engine.sync", rows=len(bld["entries"]),
+            )
         self._sync_mixed(bld, toks)
         return True
 
@@ -2535,8 +2595,18 @@ class JaxEngine:
         self._step_count += 1
         for arr in (S if isinstance(S, tuple) else (S,)):
             arr.copy_to_host_async()
+        t1 = time.perf_counter()
         with self._phase_lock:
-            self._phase_stats["mixed_dispatch_s"] += time.perf_counter() - t0
+            self._phase_stats["mixed_dispatch_s"] += t1 - t0
+        if tracing.enabled():
+            entries = bld["entries"]
+            tracing.complete(
+                "mixed", t0, t1, cat="step", track="engine.steps",
+                rows=len(entries),
+                decode_rows=sum(1 for e in entries if e[0] == "dec"),
+                tokens=sum(e[3] for e in entries),
+                spec=bld["spec"],
+            )
         return S
 
     def _sync_mixed(self, bld: dict, toks) -> None:
@@ -2601,6 +2671,11 @@ class JaxEngine:
                 seq.prefilling = False
                 seq.device_pos = seq.num_computed
                 seq.t_first_dispatched = now
+                if tracing.enabled():
+                    tracing.instant(
+                        "seq.first_dispatch", cat="lifecycle",
+                        req=seq.ctx.id, ts=now,
+                    )
                 self._stamp_first_meta(seq)
                 self._append_token(seq, tok, extra_meta=seq.first_meta)
                 seq.first_meta = None
@@ -2867,22 +2942,31 @@ class JaxEngine:
                 out = self._run_spec_dispatch_locked(bld)
             else:
                 out = self._run_decode_dispatch_locked(bld)
-        with self._phase_lock:
-            if bld.spec:
-                self._phase_stats["spec_dispatch_s"] += (
-                    time.perf_counter() - t0
-                )
+        t1 = time.perf_counter()
+        rows = len(bld.active)
+        if bld.spec:
+            n_tok = rows + int(np.sum(bld.dlen))
+            with self._phase_lock:
+                self._phase_stats["spec_dispatch_s"] += t1 - t0
                 self._phase_stats["spec_dispatches"] += 1
-                return out
-            self._phase_stats["decode_dispatch_s"] += (
-                time.perf_counter() - t0
-            )
+            if tracing.enabled():
+                tracing.complete(
+                    "spec_verify", t0, t1, cat="step",
+                    track="engine.steps", rows=rows, tokens=n_tok,
+                )
+            return out
+        n_tok = int(np.sum(bld.act)) * bld.steps
+        with self._phase_lock:
+            self._phase_stats["decode_dispatch_s"] += t1 - t0
             self._phase_stats["decode_dispatches"] += 1
             # dispatched decode token-SLOTS (active rows x steps):
             # includes the <= steps-1 overshoot positions of rows that
             # finish mid-scan, so this bounds emitted tokens from above
-            self._phase_stats["decode_tokens"] += (
-                int(np.sum(bld.act)) * bld.steps
+            self._phase_stats["decode_tokens"] += n_tok
+        if tracing.enabled():
+            tracing.complete(
+                "decode", t0, t1, cat="step", track="engine.steps",
+                rows=rows, tokens=n_tok, steps=bld.steps,
             )
         return out
 
@@ -3032,13 +3116,20 @@ class JaxEngine:
         arrs = await asyncio.to_thread(
             lambda: tuple(np.asarray(a) for a in d.out_dev)
         )  # (toks, lps[, top_ids, top_lps]) each [K+1, B(, 8)]
+        t_sync1 = time.perf_counter()
         with self._phase_lock:
             # keep the phase families separable: a spec verify step's
             # fetch wall belongs with its dispatch wall, not in the
             # scanned-decode sync ratio
             self._phase_stats[
                 "spec_sync_s" if d.spec else "decode_sync_s"
-            ] += time.perf_counter() - t_sync0
+            ] += t_sync1 - t_sync0
+        if tracing.enabled():
+            tracing.complete(
+                "spec_verify.sync" if d.spec else "decode.sync",
+                t_sync0, t_sync1, cat="step", track="engine.sync",
+                rows=len(d.snapshot),
+            )
         if d.spec:
             self._sync_spec(d, arrs)
             return
@@ -3406,6 +3497,13 @@ class JaxEngine:
         if seq.spec is not None:
             seq.spec.extend([token])
         seq.generated += 1
+        if seq.generated == 1:
+            seq.t_first_emit = time.perf_counter()
+            if tracing.enabled():
+                tracing.instant(
+                    "seq.first_token", cat="lifecycle", req=seq.ctx.id,
+                    ts=seq.t_first_emit,
+                )
         frame = EngineOutput(token_ids=[token])
         if seq.want_logprobs:
             # NaN = no local logprob (disagg remotely-sampled first token)
@@ -3437,5 +3535,41 @@ class JaxEngine:
             self._prefilling.remove(seq)
         seq.prefilling = False
         seq.finish = reason
+        self._note_finished(seq, reason)
         seq.out_queue.put_nowait(EngineOutput.final(reason).to_dict())
         self._wake.set()
+
+    def _note_finished(self, seq: Sequence, reason: str) -> None:
+        """Request-level observability at finish: the latency summary for
+        subscribe_requests observers (histograms) and the request's
+        submit→finish span on the trace plane."""
+        now = time.perf_counter()
+        summary = {
+            "request_id": seq.ctx.id,
+            "finish_reason": reason,
+            "prompt_tokens": seq.prompt_len,
+            "tokens": seq.generated,
+            "queue_wait_s": (
+                seq.t_admit - seq.t_submit
+                if seq.t_admit and seq.t_submit else None
+            ),
+            "ttft_s": (
+                seq.t_first_emit - seq.t_submit
+                if seq.t_first_emit and seq.t_submit else None
+            ),
+            "itl_s": (
+                (now - seq.t_first_emit) / (seq.generated - 1)
+                if seq.t_first_emit and seq.generated > 1 else None
+            ),
+        }
+        for cb in self._request_observers:
+            try:
+                cb(summary)
+            except Exception:
+                log.exception("request observer failed")
+        if tracing.enabled() and seq.t_submit:
+            tracing.complete(
+                "request", seq.t_submit, now, cat="request",
+                req=seq.ctx.id, finish_reason=reason,
+                prompt_tokens=seq.prompt_len, tokens=seq.generated,
+            )

@@ -65,6 +65,11 @@ class Sequence:
     t_submit: float = 0.0
     t_admit: float = 0.0
     t_first_dispatched: float = 0.0
+    # first token actually EMITTED host-side (fetch landed): with t_submit
+    # it is the engine-observed TTFT, with finish time and `generated` the
+    # request's mean ITL — the inputs of the request-finish summaries the
+    # engine hands to subscribe_requests (Prometheus histograms)
+    t_first_emit: float = 0.0
     # disagg: (first_token, k [L,T,Kh*Hd], v) delivered by a remote prefill
     # worker — admission injects this into pages instead of computing it
     preloaded: Optional[tuple] = None

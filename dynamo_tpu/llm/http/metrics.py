@@ -23,6 +23,17 @@ def _fmt_labels(labels: dict[str, str]) -> str:
     return "{" + inner + "}"
 
 
+def _fmt_le(bound: float) -> str:
+    """Bucket `le` label value: canonical float repr ("1.0", "0.005",
+    "+Inf"), never locale-dependent and never the bare-int "1" an
+    int-typed bucket tuple would produce via str() — consecutive scrapes
+    must diff cleanly whatever Python built the bucket bounds."""
+    f = float(bound)
+    if f == float("inf"):
+        return "+Inf"
+    return repr(f)
+
+
 class Counter:
     def __init__(self, name: str, help_: str):
         self.name = name
@@ -86,15 +97,23 @@ class Histogram:
     def render(self) -> Iterable[str]:
         yield f"# HELP {self.name} {self.help}"
         yield f"# TYPE {self.name} histogram"
-        for key, counts in self._counts.items():
+        # the unlabeled base series ALWAYS renders (zero before any
+        # observation, and it stays once labeled series appear): scrapers
+        # and rate() queries need _sum/_count points to exist from the
+        # first scrape AND never go stale later — a series that appears,
+        # vanishes and reappears breaks continuity. Sorted keys + .get
+        # (no defaultdict insertion side effects) keep scrapes diffable.
+        for key in sorted({(), *self._counts}):
+            counts = self._counts.get(key) or [0] * len(self.buckets)
             labels = dict(key)
+            total = self._totals.get(key, 0)
             cum = 0
             for b, c in zip(self.buckets, counts):
                 cum += c
-                yield f'{self.name}_bucket{_fmt_labels({**labels, "le": str(b)})} {cum}'
-            yield f'{self.name}_bucket{_fmt_labels({**labels, "le": "+Inf"})} {self._totals[key]}'
-            yield f"{self.name}_sum{_fmt_labels(labels)} {self._sums[key]}"
-            yield f"{self.name}_count{_fmt_labels(labels)} {self._totals[key]}"
+                yield f'{self.name}_bucket{_fmt_labels({**labels, "le": _fmt_le(b)})} {cum}'
+            yield f'{self.name}_bucket{_fmt_labels({**labels, "le": "+Inf"})} {total}'
+            yield f"{self.name}_sum{_fmt_labels(labels)} {self._sums.get(key, 0.0)}"
+            yield f"{self.name}_count{_fmt_labels(labels)} {total}"
 
 
 class ServiceMetrics:
@@ -119,6 +138,78 @@ class ServiceMetrics:
         for metric in (self.requests_total, self.inflight, self.duration, *self.extra):
             lines.extend(metric.render())
         return "\n".join(lines) + "\n"
+
+
+# inter-token latencies sit in the single-digit-millisecond range on TPU;
+# the default (request-duration) buckets would dump every observation in
+# the first bucket
+ITL_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+)
+TOKENS_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0,
+                  512.0, 1024.0, 2048.0, 4096.0, 8192.0, 16384.0)
+
+
+class EngineMetrics:
+    """Engine-side request latency histograms + `Engine.metrics()` gauges,
+    rendered through `ServiceMetrics.extra` so ONE `GET /metrics` scrape
+    covers the service and the engine behind it (reference: the stats
+    plane merges ForwardPassMetrics into the HTTP exposition).
+
+    The histograms are fed by the engine's per-request summaries
+    (`JaxEngine.subscribe_requests`, fired at finish): TTFT is submit →
+    first token emitted by the engine (fetch included, transport to the
+    client excluded), ITL the request's mean inter-token gap, queue wait
+    submit → decode-slot admission. Gauges re-read `engine.metrics()` at
+    every render, so they are scrape-time fresh without a poll loop."""
+
+    def __init__(self, engine=None, prefix: str = "dynamo_tpu"):
+        self.engine = engine
+        self._prefix = prefix
+        self.ttft = Histogram(
+            f"{prefix}_engine_ttft_seconds",
+            "Engine TTFT: request submit to first token emitted",
+        )
+        self.itl = Histogram(
+            f"{prefix}_engine_itl_seconds",
+            "Mean inter-token latency per finished request",
+            buckets=ITL_BUCKETS,
+        )
+        self.queue_wait = Histogram(
+            f"{prefix}_engine_queue_wait_seconds",
+            "Request submit to decode-slot admission",
+        )
+        self.tokens = Histogram(
+            f"{prefix}_engine_tokens_per_request",
+            "Generated tokens per finished request",
+            buckets=TOKENS_BUCKETS,
+        )
+        if engine is not None and hasattr(engine, "subscribe_requests"):
+            engine.subscribe_requests(self.observe)
+
+    def observe(self, summary: dict) -> None:
+        """Request-finish hook (see JaxEngine._finish for the fields)."""
+        if summary.get("ttft_s") is not None:
+            self.ttft.observe(summary["ttft_s"])
+        if summary.get("itl_s") is not None:
+            self.itl.observe(summary["itl_s"])
+        if summary.get("queue_wait_s") is not None:
+            self.queue_wait.observe(summary["queue_wait_s"])
+        if summary.get("tokens"):
+            self.tokens.observe(float(summary["tokens"]))
+
+    def render(self) -> Iterable[str]:
+        if self.engine is not None:
+            try:
+                gauges = self.engine.metrics()
+            except Exception:  # noqa: BLE001 — a scrape must never 500
+                gauges = {}
+            for key, val in gauges.items():
+                name = f"{self._prefix}_engine_{key}"
+                yield f"# TYPE {name} gauge"
+                yield f"{name} {float(val)}"
+        for h in (self.ttft, self.itl, self.queue_wait, self.tokens):
+            yield from h.render()
 
 
 class InflightGuard:

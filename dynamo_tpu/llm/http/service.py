@@ -20,11 +20,14 @@ from __future__ import annotations
 import asyncio
 import contextlib
 import json
+import time
+import uuid
 from typing import Optional
 
 from aiohttp import web
 
 from dynamo_tpu.llm.http.metrics import ServiceMetrics
+from dynamo_tpu.utils import tracing
 from dynamo_tpu.llm.protocols.openai import (
     ChatCompletionRequest,
     CompletionRequest,
@@ -86,6 +89,7 @@ class HttpService:
                 web.post("/v1/completions", self._completions),
                 web.get("/v1/models", self._models),
                 web.get("/metrics", self._metrics),
+                web.get("/debug/trace", self._debug_trace),
                 web.get("/health", self._health),
                 web.get("/live", self._health),
             ]
@@ -129,6 +133,13 @@ class HttpService:
             text=self.metrics.render(), content_type="text/plain", charset="utf-8"
         )
 
+    async def _debug_trace(self, request: web.Request) -> web.Response:
+        """Chrome/Perfetto trace-event JSON of the in-process span ring
+        (utils/tracing.py). Empty unless tracing is armed (DYN_TRACE=1);
+        load the body at https://ui.perfetto.dev — see
+        docs/observability.md."""
+        return web.json_response(tracing.export())
+
     async def _chat_completions(self, request: web.Request) -> web.StreamResponse:
         return await self._serve_llm(
             request, kind="chat", parse=ChatCompletionRequest.from_body
@@ -140,6 +151,39 @@ class HttpService:
         )
 
     async def _serve_llm(self, request: web.Request, kind: str, parse) -> web.StreamResponse:
+        # request id: echo the caller's x-request-id (distributed callers
+        # stitch their own traces with it) or mint one; it becomes the
+        # Context id, the trace/span key, and the JSONL log join key for
+        # everything downstream in this task tree
+        rid = request.headers.get("x-request-id") or uuid.uuid4().hex
+        t0 = time.perf_counter()
+        status = 500
+        token = tracing.set_request(rid)
+        try:
+            resp = await self._handle_llm(request, kind, parse, rid)
+            status = resp.status
+            if not resp.prepared:
+                # streaming responses already sent their headers (the
+                # echo rides in _stream_sse); only unsent ones take it here
+                resp.headers.setdefault("X-Request-Id", rid)
+            return resp
+        except (asyncio.CancelledError, ConnectionResetError):
+            # client closed the request (nginx's 499 convention): a
+            # flaky-client trace must not read as server 500s — aiohttp
+            # cancels the handler on disconnect, and a mid-stream drop
+            # surfaces as ConnectionResetError from resp.write()
+            status = 499
+            raise
+        finally:
+            tracing.reset_request(token)
+            tracing.complete(
+                "http.request", t0, time.perf_counter(), cat="http",
+                req=rid, endpoint=kind, status=status,
+            )
+
+    async def _handle_llm(
+        self, request: web.Request, kind: str, parse, rid: str
+    ) -> web.StreamResponse:
         try:
             body = await request.json()
         except (json.JSONDecodeError, UnicodeDecodeError):
@@ -160,7 +204,7 @@ class HttpService:
             return _error_response(404, f"model {req.model!r} not found")
 
         guard = self.metrics.inflight_guard(req.model, kind)
-        ctx = Context(req)
+        ctx = Context(req, request_id=rid)
         try:
             stream = await engine.generate(ctx)
         except Exception as exc:  # noqa: BLE001 — admission or engine failure
@@ -211,6 +255,7 @@ class HttpService:
                 "Content-Type": "text/event-stream",
                 "Cache-Control": "no-cache",
                 "Connection": "keep-alive",
+                "X-Request-Id": ctx.id,
             }
         )
         await resp.prepare(request)

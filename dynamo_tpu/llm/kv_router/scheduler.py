@@ -27,6 +27,7 @@ from typing import Optional, Protocol
 
 from dynamo_tpu.llm.kv_router.indexer import OverlapScores
 from dynamo_tpu.llm.kv_router.protocols import ForwardPassMetrics, KVHitRateEvent
+from dynamo_tpu.utils import tracing
 
 
 @dataclass
@@ -100,6 +101,17 @@ class KvScheduler:
         decision = self.selector.select(
             workers, overlaps, isl_tokens, self.block_size
         )
+        if decision is not None and tracing.enabled():
+            # request id rides the contextvar (schedule() runs inside the
+            # frontend handler's task tree) — the span shows WHICH worker
+            # won and why next to the request's preprocess/engine spans
+            tracing.instant(
+                "kv_router.decision", cat="router",
+                worker_id=decision.worker_id,
+                overlap_blocks=decision.overlap_blocks,
+                logit=round(decision.logit, 4),
+                isl_tokens=isl_tokens,
+            )
         if decision is not None and self.component is not None:
             import asyncio
 

@@ -33,6 +33,7 @@ from dynamo_tpu.llm.protocols.openai import (
 from dynamo_tpu.llm.tokenizer import HuggingFaceTokenizer
 from dynamo_tpu.runtime.pipeline.context import Context
 from dynamo_tpu.runtime.pipeline.engine import AsyncEngine, Operator
+from dynamo_tpu.utils import tracing
 from dynamo_tpu.utils.logging import get_logger
 
 log = get_logger("dynamo_tpu.preprocessor")
@@ -192,14 +193,17 @@ class OpenAIPreprocessor(Operator):
         self, request: Context, next_engine: AsyncEngine
     ) -> AsyncIterator[dict]:
         req = request.payload
-        if isinstance(req, ChatCompletionRequest):
-            pre, prompt = self.preprocess_chat(req)
-            kind = "chat"
-        elif isinstance(req, CompletionRequest):
-            pre, prompt = self.preprocess_completion(req)
-            kind = "completion"
-        else:
-            raise TypeError(f"unsupported request type {type(req).__name__}")
+        with tracing.span("preprocess", cat="preprocess", req=request.id) as sp:
+            if isinstance(req, ChatCompletionRequest):
+                pre, prompt = self.preprocess_chat(req)
+                kind = "chat"
+            elif isinstance(req, CompletionRequest):
+                pre, prompt = self.preprocess_completion(req)
+                kind = "completion"
+            else:
+                raise TypeError(f"unsupported request type {type(req).__name__}")
+            if sp is not None:
+                sp.set(kind=kind, prompt_tokens=len(pre.token_ids))
 
         delta = DeltaGenerator(req.model, kind=kind)
         delta.prompt_tokens = len(pre.token_ids)

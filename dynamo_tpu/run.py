@@ -179,10 +179,17 @@ async def run_http(args, out: str) -> None:
         watcher = ModelWatcher(drt, svc.manager, router_mode=args.router_mode)
         await watcher.start()
     else:
-        pipeline, card, _engine = await build_output(args, out)
+        pipeline, card, engine = await build_output(args, out)
         name = args.model_name or (card.display_name if card else "echo")
         svc.manager.add_chat_model(name, pipeline)
         svc.manager.add_completion_model(name, pipeline)
+        if engine is not None:
+            # one scrape covers service + engine: Engine.metrics() gauges
+            # and the TTFT/ITL/queue-wait/tokens histograms render through
+            # the /metrics endpoint via the ServiceMetrics.extra hook
+            from dynamo_tpu.llm.http.metrics import EngineMetrics
+
+            svc.metrics.extra.append(EngineMetrics(engine))
     await svc.start(args.http_host, args.http_port)
     log.info("serving OpenAI HTTP on %s:%d", args.http_host, svc.port)
     await asyncio.Event().wait()

@@ -13,6 +13,8 @@ import os
 import sys
 import time
 
+from dynamo_tpu.utils import tracing
+
 _CONFIGURED = False
 
 _LEVELS = {
@@ -35,6 +37,13 @@ class JsonlFormatter(logging.Formatter):
             "target": record.name,
             "message": record.getMessage(),
         }
+        # join key against the trace plane: the active request id (bound
+        # by the HTTP frontend for the handler's task tree, see
+        # utils/tracing.py) stamps every record emitted serving that
+        # request, so JSONL logs line up with /debug/trace spans
+        rid = tracing.current_request()
+        if rid is not None:
+            out["request_id"] = rid
         if record.exc_info:
             out["exception"] = self.formatException(record.exc_info)
         return json.dumps(out)

@@ -226,3 +226,80 @@ async def test_metrics_exposed():
         text = await r.text()
         assert 'dynamo_tpu_http_service_requests_total{endpoint="chat",model="tiny",status="success"} 1' in text
         assert "dynamo_tpu_http_service_request_duration_seconds_bucket" in text
+
+
+async def test_engine_metrics_render_through_extra():
+    """ServiceMetrics.extra: one scrape covers service + engine (the
+    run.py serving path appends an EngineMetrics per local engine)."""
+    from dynamo_tpu.llm.http.metrics import EngineMetrics
+
+    class StubEngine:
+        def metrics(self):
+            return {"request_active_slots": 3, "kv_total_blocks": 63}
+
+    async with http_service() as (svc, session):
+        svc.metrics.extra.append(EngineMetrics(StubEngine()))
+        r = await session.get("/metrics")
+        text = await r.text()
+        assert "dynamo_tpu_engine_request_active_slots 3.0" in text
+        assert "dynamo_tpu_engine_kv_total_blocks 63.0" in text
+        # histograms render complete zero series before any traffic
+        assert "dynamo_tpu_engine_ttft_seconds_count 0" in text
+        assert 'dynamo_tpu_engine_itl_seconds_bucket{le="+Inf"} 0' in text
+
+
+async def test_debug_trace_request_span():
+    """/debug/trace returns Chrome trace-event JSON carrying the request
+    span (x-request-id echoed end to end) for a completed completion."""
+    from dynamo_tpu.utils import tracing
+
+    tracing.enable()
+    tracing.clear()
+    try:
+        async with http_service() as (svc, session):
+            r = await session.post(
+                "/v1/completions",
+                json={"model": "tiny", "prompt": "hello world"},
+                headers={"x-request-id": "trace-me-1"},
+            )
+            assert r.status == 200
+            assert r.headers["X-Request-Id"] == "trace-me-1"
+            # a request without the header gets a minted id echoed back
+            r2 = await session.post(
+                "/v1/completions",
+                json={"model": "tiny", "prompt": "again", "stream": True},
+            )
+            assert r2.status == 200
+            minted = r2.headers["X-Request-Id"]
+            assert minted
+            await _read_sse(r2)
+
+            r = await session.get("/debug/trace")
+            assert r.status == 200
+            d = await r.json()
+            evs = d["traceEvents"]
+            ts = [e["ts"] for e in evs if e["ph"] != "M"]
+            assert ts == sorted(ts)
+            assert all(e["ph"] in ("X", "i", "M") for e in evs)
+            spans = [
+                e for e in evs
+                if e["name"] == "http.request" and e["ph"] == "X"
+            ]
+            mine = [
+                e for e in spans if e["args"].get("request_id") == "trace-me-1"
+            ]
+            assert mine and mine[0]["args"]["status"] == 200
+            assert mine[0]["dur"] >= 0
+            assert any(
+                e["args"].get("request_id") == minted for e in spans
+            )
+            # the preprocessor span joined the same request id via the
+            # handler's contextvar binding
+            assert any(
+                e["name"] == "preprocess"
+                and e["args"].get("request_id") == "trace-me-1"
+                for e in evs
+            )
+    finally:
+        tracing.disable()
+        tracing.clear()

@@ -1,0 +1,343 @@
+"""Trace pipeline tests (dynamo_tpu/utils/tracing.py): span nesting,
+contextvar propagation across async tasks, ring-buffer eviction, off-mode
+no-op, Perfetto export shape, and the engine's lifecycle + step timeline
+through a real tiny-model serve.
+"""
+
+import asyncio
+import contextlib
+import json
+import time
+
+from dynamo_tpu.utils import tracing
+
+
+@contextlib.contextmanager
+def armed(buffer: int = tracing._DEFAULT_BUFFER):
+    """Arm recording with a clean ring; restore the disabled default (and
+    the default ring size) afterwards so other tests see no trace state."""
+    tracing.enable(buffer=buffer)
+    tracing.clear()
+    try:
+        yield
+    finally:
+        tracing.enable(buffer=tracing._DEFAULT_BUFFER)
+        tracing.disable()
+        tracing.clear()
+
+
+def _events(ph=None):
+    evs = [e for e in tracing.export()["traceEvents"] if e["ph"] != "M"]
+    if ph is not None:
+        evs = [e for e in evs if e["ph"] == ph]
+    return evs
+
+
+# ------------------------------------------------------------ core recorder
+
+
+def test_off_mode_is_noop():
+    tracing.disable()
+    tracing.clear()
+    # the span factory hands back ONE shared no-op context manager — no
+    # per-call allocation on the disabled hot path
+    cm = tracing.span("x")
+    assert cm is tracing.span("y")
+    with cm as sp:
+        assert sp is None
+    tracing.instant("evt", foo=1)
+    tracing.complete("c", 0.0, 1.0, rows=3)
+    assert _events() == []
+
+
+def test_span_nesting():
+    with armed():
+        with tracing.span("outer", req="r1"):
+            with tracing.span("inner", req="r1") as sp:
+                sp.set(detail=7)
+        evs = {e["name"]: e for e in _events("X")}
+        outer, inner = evs["outer"], evs["inner"]
+        # same track (request id), and the inner interval is contained in
+        # the outer one (0.5 us slack for the 0.1 us rounding)
+        assert outer["tid"] == inner["tid"]
+        assert outer["ts"] <= inner["ts"] + 0.5
+        assert inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"] + 0.5
+        assert inner["args"]["detail"] == 7
+        assert outer["args"]["request_id"] == "r1"
+
+
+def test_span_records_exception_and_reraises():
+    with armed():
+        try:
+            with tracing.span("boom", req="r1"):
+                raise ValueError("x")
+        except ValueError:
+            pass
+        (ev,) = _events("X")
+        assert ev["args"]["error"] == "ValueError"
+
+
+async def test_contextvar_propagates_across_tasks():
+    with armed():
+        async def child():
+            # tasks created inside the bound scope inherit the request id
+            assert tracing.current_request() == "req-xyz"
+            tracing.instant("child.evt")
+
+        token = tracing.set_request("req-xyz")
+        try:
+            await asyncio.gather(
+                asyncio.create_task(child()), asyncio.create_task(child())
+            )
+        finally:
+            tracing.reset_request(token)
+        assert tracing.current_request() is None
+        evs = [e for e in _events("i") if e["name"] == "child.evt"]
+        assert len(evs) == 2
+        assert all(e["args"]["request_id"] == "req-xyz" for e in evs)
+
+
+def test_request_scope_nests_and_restores():
+    assert tracing.current_request() is None
+    with tracing.request_scope("abc"):
+        assert tracing.current_request() == "abc"
+        with tracing.request_scope(None):
+            assert tracing.current_request() is None
+        assert tracing.current_request() == "abc"
+    assert tracing.current_request() is None
+
+
+def test_ring_buffer_eviction_newest_win():
+    with armed(buffer=8):
+        for i in range(50):
+            tracing.instant("e", i=i)
+        evs = _events("i")
+        assert len(evs) == 8
+        assert [e["args"]["i"] for e in evs] == list(range(42, 50))
+
+
+def test_track_eviction_pins_explicit_tracks():
+    """Request-id churn must never evict the static engine rows: the
+    step timeline keeps ONE tid however many requests pass through."""
+    with armed():
+        tracing.instant("s", track="engine.steps")
+        steps_tid = tracing._tracks["engine.steps"]
+        for i in range(tracing._TRACKS_MAX + 50):
+            tracing.instant("e", req=f"r{i}")
+        assert tracing._tracks["engine.steps"] == steps_tid
+        assert len(tracing._tracks) <= tracing._TRACKS_MAX + 1
+
+
+def test_export_monotonic_ts_and_dump(tmp_path):
+    with armed():
+        t0 = time.perf_counter()
+        # recorded deliberately out of ts order; export must sort
+        tracing.complete("b", t0, t0 + 0.01, track="engine.steps", rows=1)
+        tracing.instant("a", track="engine.steps")
+        tracing.complete("c", t0 - 0.5, t0, track="other")
+        path = tmp_path / "trace.json"
+        n = tracing.dump(str(path))
+        d = json.loads(path.read_text())
+        evs = d["traceEvents"]
+        ts = [e["ts"] for e in evs if e["ph"] != "M"]
+        assert n == 3
+        assert ts == sorted(ts)
+        assert all(e["ph"] in ("X", "i", "M") for e in evs)
+        assert all(e["dur"] >= 0 for e in evs if e["ph"] == "X")
+        names = {e["args"]["name"] for e in evs if e["ph"] == "M"}
+        assert {"engine.steps", "other"} <= names
+
+
+def test_jsonl_formatter_attaches_request_id():
+    """JSONL log records join against spans via the tracing contextvar
+    (works with recording DISARMED — the id binding is unconditional)."""
+    import logging
+
+    from dynamo_tpu.utils.logging import JsonlFormatter
+
+    tracing.disable()
+    rec = logging.LogRecord("t", logging.INFO, "f", 1, "hello %s", ("x",), None)
+    fmt = JsonlFormatter()
+    with tracing.request_scope("rid-123"):
+        out = json.loads(fmt.format(rec))
+    assert out["request_id"] == "rid-123"
+    out = json.loads(fmt.format(rec))
+    assert "request_id" not in out
+
+
+# -------------------------------------------------- histograms / EngineMetrics
+
+
+def test_histogram_renders_zero_series_and_stable_le():
+    from dynamo_tpu.llm.http.metrics import Histogram
+
+    # int-typed bucket bounds on purpose: le must format as canonical
+    # float repr ("1.0"), not str(int) ("1")
+    h = Histogram("x_seconds", "t", buckets=(1, 2.5))
+    lines = list(h.render())
+    assert 'x_seconds_bucket{le="1.0"} 0' in lines
+    assert 'x_seconds_bucket{le="2.5"} 0' in lines
+    assert 'x_seconds_bucket{le="+Inf"} 0' in lines
+    assert "x_seconds_sum 0.0" in lines
+    assert "x_seconds_count 0" in lines
+    h.observe(1.5, model="m")
+    lines = list(h.render())
+    assert 'x_seconds_bucket{le="1.0",model="m"} 0' in lines
+    assert 'x_seconds_bucket{le="2.5",model="m"} 1' in lines
+    assert 'x_seconds_count{model="m"} 1' in lines
+
+
+def test_engine_metrics_gauges_and_histograms():
+    from dynamo_tpu.llm.http.metrics import EngineMetrics, ServiceMetrics
+
+    class Stub:
+        def subscribe_requests(self, cb):
+            self.cb = cb
+
+        def metrics(self):
+            return {"request_active_slots": 2, "gpu_cache_usage_perc": 0.5}
+
+    stub = Stub()
+    em = EngineMetrics(stub)
+    stub.cb(
+        {
+            "request_id": "r",
+            "finish_reason": "stop",
+            "prompt_tokens": 4,
+            "tokens": 8,
+            "queue_wait_s": 0.001,
+            "ttft_s": 0.02,
+            "itl_s": 0.004,
+        }
+    )
+    # partial summaries (cancelled before first token) must not crash
+    stub.cb({"request_id": "r2", "finish_reason": "cancelled", "tokens": 0,
+             "queue_wait_s": None, "ttft_s": None, "itl_s": None})
+    sm = ServiceMetrics()
+    sm.extra.append(em)
+    text = sm.render()
+    assert "dynamo_tpu_engine_request_active_slots 2.0" in text
+    assert "dynamo_tpu_engine_gpu_cache_usage_perc 0.5" in text
+    assert "dynamo_tpu_engine_ttft_seconds_count 1" in text
+    assert "dynamo_tpu_engine_itl_seconds_count 1" in text
+    assert "dynamo_tpu_engine_queue_wait_seconds_count 1" in text
+    assert "dynamo_tpu_engine_tokens_per_request_count 1" in text
+
+
+# -------------------------------------------------------------- engine e2e
+
+
+def _tiny_engine():
+    from dynamo_tpu.engine import EngineConfig, JaxEngine
+    from dynamo_tpu.models import config as cfgmod
+
+    return JaxEngine(
+        EngineConfig(
+            model=cfgmod.get_config("tiny"),
+            dtype="float32",
+            page_size=8,
+            num_pages=64,
+            max_batch_size=4,
+            max_model_len=128,
+            prefill_chunk=32,
+            seed=0,
+        )
+    )
+
+
+async def test_engine_lifecycle_and_step_timeline():
+    from dynamo_tpu.llm.http.metrics import EngineMetrics
+    from dynamo_tpu.llm.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+    from dynamo_tpu.runtime.pipeline.context import Context
+
+    with armed():
+        engine = _tiny_engine()
+        em = EngineMetrics(engine)
+
+        async def one(rid, prompt):
+            pre = PreprocessedRequest(
+                token_ids=list(prompt),
+                stop_conditions=StopConditions(max_tokens=6, ignore_eos=True),
+                sampling_options=SamplingOptions(greedy=True),
+            )
+            return [
+                f
+                async for f in await engine.generate(
+                    Context(pre.to_dict(), request_id=rid)
+                )
+            ]
+
+        await asyncio.gather(
+            one("rq-0", [3, 5, 7, 9, 11]), one("rq-1", [2, 4, 6])
+        )
+        await engine.close()
+
+        evs = tracing.export()["traceEvents"]
+        by_name: dict = {}
+        for e in evs:
+            by_name.setdefault(e["name"], []).append(e)
+        # per-sequence lifecycle: submit -> admit -> first dispatch ->
+        # first token -> the request span, for BOTH requests
+        for name in ("seq.submit", "seq.admit", "seq.first_dispatch",
+                     "seq.first_token", "request"):
+            rids = {e["args"]["request_id"] for e in by_name.get(name, [])}
+            assert rids == {"rq-0", "rq-1"}, (name, rids)
+        for e in by_name["request"]:
+            assert e["ph"] == "X"
+            assert e["args"]["tokens"] == 6
+            assert e["args"]["finish_reason"] == "length"
+        # step timeline: prefill + decode dispatch events with rows/tokens
+        assert by_name["prefill"], "no prefill step events"
+        assert all(
+            e["args"]["rows"] >= 1 and e["args"]["tokens"] >= 1
+            for e in by_name["prefill"]
+        )
+        assert by_name["decode"], "no decode step events"
+        assert all(
+            e["args"]["tokens"] == e["args"]["rows"] * e["args"]["steps"]
+            for e in by_name["decode"]
+        )
+        assert by_name["decode.sync"], "no decode sync events"
+        # engine histograms observed both finishes
+        text = "\n".join(em.render())
+        assert "dynamo_tpu_engine_ttft_seconds_count 2" in text
+        assert "dynamo_tpu_engine_queue_wait_seconds_count 2" in text
+        assert "dynamo_tpu_engine_tokens_per_request_count 2" in text
+        # Engine.dump_trace round-trips as Perfetto-loadable JSON
+        import tempfile, os
+
+        path = os.path.join(tempfile.mkdtemp(), "engine_trace.json")
+        n = engine.dump_trace(path)
+        d = json.load(open(path))
+        assert n > 0 and isinstance(d["traceEvents"], list)
+
+
+async def test_trace_off_engine_unchanged():
+    """With DYN_TRACE unset the serve records nothing and emits the same
+    stream (the ≤1% overhead contract's correctness half)."""
+    from dynamo_tpu.llm.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+    from dynamo_tpu.runtime.pipeline.context import Context
+
+    tracing.disable()
+    tracing.clear()
+    engine = _tiny_engine()
+    pre = PreprocessedRequest(
+        token_ids=[3, 5, 7],
+        stop_conditions=StopConditions(max_tokens=4, ignore_eos=True),
+        sampling_options=SamplingOptions(greedy=True),
+    )
+    frames = [
+        f async for f in await engine.generate(Context(pre.to_dict()))
+    ]
+    await engine.close()
+    toks = [t for f in frames for t in f.get("token_ids") or []]
+    assert len(toks) == 4
+    assert _events() == []
