@@ -61,6 +61,12 @@ MIXED_TOKENS = int(os.environ.get("BENCH_MIXED_TOKENS", "1024"))
 MIXED_HELD = int(os.environ.get("BENCH_MIXED_HELD", "8"))
 MIXED_WAVE = int(os.environ.get("BENCH_MIXED_WAVE", "16"))
 MIXED_OSL = int(os.environ.get("BENCH_MIXED_OSL", str(max(OSL, 128))))
+# BENCH_PIPELINE=1: step-pipeline A/B — the same held+wave mixed cycle
+# run serialized (EngineConfig.step_pipeline=False: every step is
+# dispatch -> fetch -> sync) then pipelined, reporting the sync-fetch
+# wall (`mixed_sync_s + decode_sync_s`) as a fraction of the total
+# dispatch+sync step wall. Also runs whenever BENCH_MIXED=1 is set.
+PIPE = MIXED or os.environ.get("BENCH_PIPELINE", "") not in ("", "0")
 # BENCH_OUT=path: ALSO write a machine-readable JSON results file with
 # every section keyed separately (headline, spec, mixed, mixed_spec) —
 # the stdout line stays the one-line headline artifact. Downstream
@@ -101,11 +107,17 @@ ENV_HELP = """bench.py — serving benchmark; configuration via env vars:
   BENCH_MIXED_WAVE             admission-wave prompt count (16)
   BENCH_MIXED_OSL              held streams' output length
                                (max(BENCH_OSL, 128))
+  BENCH_PIPELINE=1             step-pipeline A/B: the held+wave mixed
+                               cycle serialized (step_pipeline=False)
+                               vs pipelined — sync-fetch wall as a
+                               fraction of the step wall (also runs
+                               whenever BENCH_MIXED=1)
   BENCH_OUT                    path: write a machine-readable JSON file
                                with every section's numbers keyed as
-                               {headline, spec, mixed, mixed_spec}
-                               (sections not run are null); stdout keeps
-                               the one-line headline artifact
+                               {headline, spec, mixed, mixed_spec,
+                               pipeline_ab} (sections not run are
+                               null); stdout keeps the one-line
+                               headline artifact
   BENCH_TRACE                  path: record the whole run with the span
                                recorder (utils/tracing.py) and dump
                                Perfetto-loadable trace-event JSON there
@@ -159,7 +171,7 @@ def main() -> None:
             max_model_len=ISL + max(
                 OSL,
                 SPEC_OSL if SPEC else 0,
-                MIXED_OSL if MIXED else 0,
+                MIXED_OSL if (MIXED or PIPE) else 0,
             ) + 32,
             prefill_chunk=ISL,
             decode_steps=DECODE_STEPS,
@@ -557,6 +569,63 @@ def main() -> None:
                 ),
             }
 
+        async def pipeline_ab():
+            """Step-pipeline A/B (EngineConfig.step_pipeline): one
+            held+wave mixed cycle fully SERIALIZED (every step is
+            dispatch -> fetch -> sync; mixed ticks "hold" behind
+            in-flight dispatches) vs pipelined (dispatch N+1 launches
+            behind N; the fetch overlaps device compute). The honest
+            comparison is the sync-fetch wall as a FRACTION of the
+            total dispatch+sync step wall — absolute walls vary with
+            how many steps each wave happens to run."""
+            for on in (False, True):  # compile both paths' families
+                engine.config.step_pipeline = on
+                await mixed_wave(True)
+            out = {}
+            for key, on in (("serialized", False), ("pipelined", True)):
+                engine.config.step_pipeline = on
+                ps_a = engine.phase_stats
+                wave = await mixed_wave(True)
+                ps_b = engine.phase_stats
+                d = {k: ps_b[k] - ps_a[k] for k in ps_b}
+                sync = d["mixed_sync_s"] + d["decode_sync_s"]
+                step = (
+                    d["mixed_dispatch_s"] + d["decode_dispatch_s"]
+                    + d["spec_dispatch_s"] + d["spec_sync_s"] + sync
+                )
+                out[key] = {
+                    "mixed_sync_s": round(d["mixed_sync_s"], 4),
+                    "decode_sync_s": round(d["decode_sync_s"], 4),
+                    "sync_wall_s": round(sync, 4),
+                    "step_wall_s": round(step, 4),
+                    "sync_frac": round(sync / step, 4) if step else None,
+                    # syncs whose fetch ran while another dispatch was
+                    # already queued on device, and the wall they hid
+                    # (counted in pipeline_overlap_s INSTEAD of the
+                    # *_sync_s stall counters); overlap_frac = hidden
+                    # share of the total fetch wall
+                    "overlapped_syncs": d["pipeline_overlapped"],
+                    "overlap_hidden_s": round(d["pipeline_overlap_s"], 4),
+                    "overlap_frac": (
+                        round(
+                            d["pipeline_overlap_s"]
+                            / (d["pipeline_overlap_s"] + sync), 4
+                        )
+                        if d["pipeline_overlap_s"] + sync else None
+                    ),
+                    "mixed_holds": d["mixed_holds"],
+                    "mixed_carry_rows": d["mixed_carry_rows"],
+                    "wave": wave,
+                }
+            engine.config.step_pipeline = True
+            sf_ser = out["serialized"]["sync_frac"]
+            sf_pipe = out["pipelined"]["sync_frac"]
+            out["sync_frac_improved"] = (
+                sf_ser is not None and sf_pipe is not None
+                and sf_pipe < sf_ser
+            )
+            return out
+
         if FAST:
             probe = rng.randint(1, cfg.vocab_size, size=ISL).tolist()
             cold, warm = {}, {}
@@ -570,6 +639,7 @@ def main() -> None:
                 await spec_ab() if SPEC else None,
                 await mixed_ab() if MIXED else None,
                 await mixed_spec_ab() if (SPEC and MIXED) else None,
+                await pipeline_ab() if PIPE else None,
             )
 
         # prefix-cache TTFT probe, WAVE-based (BASELINE.md: KV-aware
@@ -702,6 +772,7 @@ def main() -> None:
             await spec_ab() if SPEC else None,
             await mixed_ab() if MIXED else None,
             await mixed_spec_ab() if (SPEC and MIXED) else None,
+            await pipeline_ab() if PIPE else None,
         )
 
     (
@@ -714,6 +785,7 @@ def main() -> None:
         spec_result,
         mixed_result,
         mixed_spec_result,
+        pipeline_result,
     ) = asyncio.run(run())
     total_tokens = sum(r["tokens"] for r in records)
     toks_per_sec_chip = total_tokens / wall / n_chips
@@ -844,6 +916,12 @@ def main() -> None:
                     **({} if mixed_spec_result is None else {
                         "mixed_spec": mixed_spec_result,
                     }),
+                    # BENCH_PIPELINE=1 (or BENCH_MIXED=1): step-pipeline
+                    # A/B — sync-fetch wall fraction, serialized vs
+                    # pipelined
+                    **({} if pipeline_result is None else {
+                        "pipeline_ab": pipeline_result,
+                    }),
                 },
             }
     print(json.dumps(headline))
@@ -857,6 +935,7 @@ def main() -> None:
                     "spec": spec_result,
                     "mixed": mixed_result,
                     "mixed_spec": mixed_spec_result,
+                    "pipeline_ab": pipeline_result,
                 },
                 f,
                 indent=2,

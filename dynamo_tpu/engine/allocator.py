@@ -122,13 +122,22 @@ class PageAllocator:
         meta.refs += 1
         return pid
 
-    def peek_prefix_tokens(self, token_ids: list[int]) -> int:
+    def peek_prefix_tokens(
+        self,
+        token_ids: Optional[list[int]] = None,
+        hashes: Optional[list[int]] = None,
+    ) -> int:
         """Non-destructive longest-cached-prefix length in tokens (no
-        refcounts taken) — the disagg decision input."""
-        from dynamo_tpu.llm.tokens import compute_block_hashes
+        refcounts taken) — the disagg decision input. Pass `hashes` when
+        the caller already holds the prompt's chained block hashes (the
+        serve path computes them again at allocation; hashing the full
+        prompt twice per request is pure waste on long prompts)."""
+        if hashes is None:
+            from dynamo_tpu.llm.tokens import compute_block_hashes
 
+            hashes = compute_block_hashes(token_ids or [], self.page_size)
         n = 0
-        for h in compute_block_hashes(token_ids, self.page_size):
+        for h in hashes:
             if h not in self._by_hash:
                 break
             n += 1

@@ -111,6 +111,17 @@ class EngineConfig:
     # keep their full size and decode rows join only when the budget has
     # room left (throughput-leaning; decode may wait a step).
     mixed_decode_priority: bool = True
+    # zero-stall step pipeline: build and dispatch step N+1 while step
+    # N's sampled tokens are still in flight to the host. Mixed steps'
+    # q_len=1 decode rows read their input token from the device-
+    # resident carry vector (no host round trip), so a mixed window can
+    # launch behind an in-flight decode or mixed dispatch instead of
+    # holding a tick; spec-eligible rows whose host history is stale
+    # shed their drafts and still advance at q_len=1 (drafts resume
+    # once the sync catches host history up). Greedy streams are
+    # byte-identical on vs off. False restores the serialized
+    # dispatch->fetch->sync steps (the A/B baseline).
+    step_pipeline: bool = True
     # admission batching window for PACED arrivals: when decode streams
     # are running and fewer than `prefill_batch_min_rows` sequences are
     # pending prefill, hold the prefill dispatch up to this many seconds

@@ -22,10 +22,15 @@ dispatch, so a long prompt never stalls active decode streams for more than
 a chunk (the reference's disagg rationale, reference
 docs/disagg_serving.md:1-10, applied to aggregated serving).
 
-Decode is **pipelined**: dispatch N+1 is enqueued in a worker thread (using
-the on-device sampled tokens of dispatch N as carry — no host round trip)
-while N's tokens are fetched for emission, so host work overlaps device
-compute.
+Decode — and, with `EngineConfig.step_pipeline` (default), mixed
+prefill+decode steps — are **pipelined**: dispatch N+1 is enqueued in a
+worker thread (using the on-device sampled tokens of dispatch N as carry
+— no host round trip) while N's tokens are fetched for emission, so host
+work overlaps device compute. Slow-changing dispatch inputs (block
+tables, sampling/penalty params) are device-resident, scatter-updated
+only on admit/growth, so the steady-state hot path uploads one fused
+[positions, active] array per dispatch (docs/architecture.md "Step
+pipeline").
 Overshoot tokens of sequences that finished in N are discarded at sync;
 their trailing writes land in pages that are never hash-registered, so the
 prefix cache stays sound.
@@ -76,15 +81,24 @@ from dynamo_tpu.utils import tracing
 log = logging.getLogger("dynamo_tpu.engine")
 
 
+def _pad_pow2(vals: list) -> list:
+    """Pad an index/value vector to a power of two by REPEATING the last
+    entry (same slot, same value — idempotent under scatter): every
+    distinct length is a distinct XLA program, and unpadded each new
+    length costs a fresh remote compile mid-serve."""
+    m = 1 << (len(vals) - 1).bit_length()
+    return vals + [vals[-1]] * (m - len(vals))
+
+
 class _Dispatch:
-    """One in-flight decode dispatch: device tokens + the slot snapshot it
-    was built from."""
+    """One in-flight dispatch (decode scan, spec verify, or a pipelined
+    mixed step): device tokens + the slot snapshot it was built from."""
 
     __slots__ = ("out_dev", "snapshot", "steps", "spec", "pos0",
-                 "draft_lens")
+                 "draft_lens", "mixed", "bld")
 
     def __init__(self, out_dev, snapshot, steps, spec=False, pos0=None,
-                 draft_lens=None):
+                 draft_lens=None, mixed=False, bld=None):
         self.out_dev = out_dev          # [steps, B] device array
         self.snapshot = snapshot        # list[(slot_index, Sequence)]
         self.steps = steps
@@ -94,6 +108,11 @@ class _Dispatch:
         self.spec = spec
         self.pos0 = pos0
         self.draft_lens = draft_lens
+        # pipelined mixed step: out_dev is the mixed step's sampled
+        # tokens (or (out, n_emit) with spec rows); bld is the host
+        # build dict — sync routes through _sync_mixed
+        self.mixed = mixed
+        self.bld = bld
 
 
 class _DecodeBuild:
@@ -101,12 +120,13 @@ class _DecodeBuild:
     JaxEngine._maybe_dispatch_decode)."""
 
     __slots__ = ("positions", "tables", "act", "temp", "topk", "topp",
-                 "fp", "prp", "rp", "seeds", "use_ext", "want_lps",
+                 "pos_act", "dirty", "use_ext", "want_lps",
                  "want_tops", "overrides", "active", "steps", "all_greedy",
                  "width", "spec", "tokens", "draft", "dlen", "pos0")
 
     def __init__(self, **kw):
         self.spec = False  # speculative verify build (host-built tokens)
+        self.dirty = None  # pending device-state scatter snapshot
         for k, v in kw.items():
             setattr(self, k, v)
 
@@ -460,6 +480,40 @@ class JaxEngine:
         # slot -> first-token carry override: (device token vector, row)
         # from a batched prefill dispatch, or a host int (disagg inject)
         self._overrides: dict[int, object] = {}
+        # device-carry validity: _carry_ok[slot] means the device carry
+        # vector row holds the slot's CURRENT input token (set after a
+        # decode dispatch updates it, or after a mixed step's in-jit
+        # carry scatter) — the step pipeline's license to build the next
+        # window from the device carry while host history is still
+        # stale. Invalidated whenever an override supersedes the carry
+        # (prefill first tokens, spec verify syncs, disagg injects) and
+        # on preemption/finish (the slot may be reused).
+        self._carry_ok = np.zeros(config.max_batch_size, bool)
+        # device-resident slow-changing dispatch inputs (the step
+        # pipeline's second leg): block tables and sampling/penalty
+        # params live on device and are scatter-updated only when a
+        # slot's state changes (admit / page growth) instead of being
+        # re-uploaded with every dispatch. Host mirrors stay
+        # authoritative on the loop thread; `_dirty_slots` collects
+        # changed slots, each dispatch BUILD snapshots them
+        # (`_snap_dirty`) and the dispatch worker applies the scatter
+        # under _kv_lock (`_flush_dev_state_locked`) — pow2-padded index
+        # vectors, same contract as the override batching below. Layout:
+        # samp_f = [temp, top_p, freq_pen, pres_pen, rep_pen],
+        # samp_i = [top_k, seed]. Rows of released slots keep garbage
+        # (inactive rows are masked / write the trash page).
+        _B = config.max_batch_size
+        _W = config.max_pages_per_seq
+        self._host_tables = np.zeros((_B, _W), np.int32)
+        self._host_samp_f = np.zeros((_B, 5), np.float32)
+        self._host_samp_f[:, 1] = 1.0  # top_p
+        self._host_samp_f[:, 4] = 1.0  # rep_pen
+        self._host_samp_i = np.zeros((_B, 2), np.int32)
+        self._host_samp_i[:, 1] = -1  # seed sentinel
+        self._dev_tables = jnp.zeros((_B, _W), jnp.int32)
+        self._dev_samp_f = jnp.asarray(self._host_samp_f)
+        self._dev_samp_i = jnp.asarray(self._host_samp_i)
+        self._dirty_slots: set[int] = set()
         # serializes the donated self.kv (and self._key) between the
         # decode worker thread and prefill dispatches the event-loop
         # thread may run concurrently via the public prefill_only path
@@ -518,6 +572,26 @@ class JaxEngine:
             # emitted counts fold into the spec_* counters above, so
             # spec_acceptance_rate/spec_tokens_per_step stay one truth)
             "mixed_spec_rows": 0,
+            # step pipeline (EngineConfig.step_pipeline): sync walls
+            # spent while ANOTHER dispatch was already in flight — time
+            # the host fetch overlapped device compute instead of
+            # serializing against it. pipeline_overlapped counts the
+            # syncs that overlapped; mixed_holds counts the ticks the
+            # SERIALIZED mixed path parked both planes waiting for an
+            # in-flight decode dispatch (0 with pipelining on);
+            # mixed_carry_rows counts mixed decode rows whose input
+            # token came from the device carry instead of host history;
+            # mixed_spec_shed counts spec-eligible rows that shed their
+            # drafts because host history was stale (they advanced at
+            # q_len=1 — the shed-don't-stall fallback).
+            "pipeline_overlap_s": 0.0,
+            "pipeline_overlapped": 0,
+            "mixed_holds": 0,
+            "mixed_carry_rows": 0,
+            "mixed_spec_shed": 0,
+            # 0/1: mixed dispatch failed and the engine degraded to the
+            # contained normal paths (see _mixed_disabled)
+            "mixed_disabled": 0,
         }
         # updates run in worker threads outside _kv_lock (serving prefill
         # + concurrent prefill_only dispatches) — guard the RMWs
@@ -543,12 +617,12 @@ class JaxEngine:
         # want_lps static so the common no-logprobs batch skips the
         # per-step logsumexp over [B, V]
         self._decode_fn = jax.jit(
-            self._decode_multi, donate_argnums=(1,), static_argnums=(11, 12, 21)
+            self._decode_multi, donate_argnums=(1,), static_argnums=(9, 10, 15)
         )
         # decode with penalties / per-request seeds (rare path; counts
         # [B, V] int8 donated through the scan)
         self._decode_ext_fn = jax.jit(
-            self._decode_multi, donate_argnums=(1, 13), static_argnums=(11, 12, 21)
+            self._decode_multi, donate_argnums=(1, 11), static_argnums=(9, 10, 15)
         )
         # speculative verify: one multi-query step over [carry, drafts]
         # with rejection-sampling acceptance (all_greedy static)
@@ -558,9 +632,13 @@ class JaxEngine:
         # mixed prefill+decode step: decode rows (q_len=1 — or ragged
         # 1+k VERIFY windows when spec composes) + prefill chunk rows in
         # ONE [n, T] ragged dispatch; every row samples at its last
-        # valid column (all_greedy static)
+        # valid column (all_greedy + the pallas table width static). The
+        # carry vector (argnum 7) is donated: the step scatters decode
+        # rows' samples into it in-jit, which is what lets a pipelined
+        # build read the next input token without a host round trip.
         self._mixed_fn = jax.jit(
-            self._mixed_model_step, donate_argnums=(1,), static_argnums=(14,)
+            self._mixed_model_step, donate_argnums=(1, 7),
+            static_argnums=(11, 12),
         )
         # occurrence counts for penalty sampling, allocated on first use
         # (B x V int8; ~33 MB at B=256, V=128k)
@@ -791,6 +869,16 @@ class JaxEngine:
             "mixed_decode_rows": ps["mixed_decode_rows"],
             "mixed_prefill_tokens": ps["mixed_prefill_tokens"],
             "mixed_spec_rows": ps["mixed_spec_rows"],
+            # 1 when a failed mixed dispatch tripped the permanent
+            # degrade to the contained normal paths — the one log line
+            # is easy to miss, the /metrics scrape is not
+            "mixed_disabled": 1 if self._mixed_disabled else 0,
+            # step-pipeline health (EngineConfig.step_pipeline): syncs
+            # whose fetch wall overlapped an already-queued dispatch,
+            # and the wall they hid
+            "pipeline_overlapped": ps["pipeline_overlapped"],
+            "pipeline_overlap_s": round(ps["pipeline_overlap_s"], 4),
+            "mixed_carry_rows": ps["mixed_carry_rows"],
         }
 
     # ------------------------------------------------------------------
@@ -904,11 +992,11 @@ class JaxEngine:
             return S, kv, counts
         return _sample(lg, key), kv
 
-    def _decode_multi(self, params, kv, tokens, carry_lps, positions,
-                      block_tables, active, temp, topk, topp, key,
+    def _decode_multi(self, params, kv, tokens, carry_lps, pos_act,
+                      block_tables, samp_f, samp_i, key,
                       all_greedy=False, want_lps=False, counts=None,
-                      fp=None, prp=None, rp=None, seeds=None, fresh=None,
-                      carry_tid=None, carry_tlp=None, want_tops=False):
+                      fresh=None, carry_tid=None, carry_tlp=None,
+                      want_tops=False):
         """`decode_steps` decode iterations in ONE dispatch (lax.scan with
         on-device token feedback + slot computation) — the antidote to
         per-token host round trips, which dominate wall clock when the
@@ -916,10 +1004,20 @@ class JaxEngine:
         logprobs [K+1, B]), kv) — row 0 is the input carry — plus updated
         counts on the penalty path.
 
-        `counts` (+ fp/prp/rp/seeds) switches on the penalty/seeded
-        sampling path: carry tokens of `fresh` rows (prefill/disagg
-        overrides never counted before) are bumped first, then each
-        step's sampled token."""
+        Inputs follow the step pipeline's H2D split: `pos_act` [B, 2] =
+        [positions, active] is the ONE fused per-dispatch upload;
+        `block_tables`, `samp_f` = [temp, top_p, freq_pen, pres_pen,
+        rep_pen] and `samp_i` = [top_k, seed] are the persistent
+        device-resident arrays (scatter-updated on admit/growth only).
+
+        `counts` switches on the penalty/seeded sampling path: carry
+        tokens of `fresh` rows (prefill/disagg overrides never counted
+        before) are bumped first, then each step's sampled token."""
+        positions = pos_act[:, 0]
+        active = pos_act[:, 1].astype(bool)
+        temp, topp = samp_f[:, 0], samp_f[:, 1]
+        fp, prp, rp = samp_f[:, 2], samp_f[:, 3], samp_f[:, 4]
+        topk, seeds = samp_i[:, 0], samp_i[:, 1]
         s = self.page_size
         b, w = block_tables.shape
         smat = None
@@ -1106,18 +1204,32 @@ class JaxEngine:
         )
         return (out, n_emit), kv
 
-    def _mixed_model_step(self, params, kv, tokens, positions, write_slots,
-                          slot_matrix, last_idx, temp, topk, topp, key,
-                          btables, draft=None, dlen=None, all_greedy=False):
+    def _mixed_model_step(self, params, kv, hot, row_meta, samp_f, samp_i,
+                          dev_tables, carry, key, draft=None, dlen=None,
+                          all_greedy=False, w_b=1):
         """One MIXED prefill+decode step — the stall-free batching
-        dispatch (Sarathi-style): tokens [n, T] where decode rows carry
-        their host-known last token at q_len=1 and prefill rows carry
-        one chunk, per-row query lengths `last_idx + 1`. KV is written
-        first, each row attends its own slots under the causal mask
-        (the unified-step contract, ops/attention.py), and every row
-        samples at its last valid column — decode rows' sample is their
-        next token, final-chunk rows' sample is their first token,
-        non-final chunk rows' sample is garbage the sync discards.
+        dispatch (Sarathi-style): decode rows carry their last token at
+        q_len=1 and prefill rows carry one chunk, per-row query lengths
+        `last_idx + 1`. KV is written first, each row attends its own
+        slots under the causal mask (the unified-step contract,
+        ops/attention.py), and every row samples at its last valid
+        column — decode rows' sample is their next token, final-chunk
+        rows' sample is their first token, non-final chunk rows' sample
+        is garbage the sync discards.
+
+        Step-pipeline input contract: `hot` [3, n, T] packs the
+        per-step tokens/positions/write-slots into ONE fused H2D
+        upload; `row_meta` [n, 4] = [last_idx, slot_row, carry_mask,
+        dec_mask] is the second. Everything slow-changing is gathered
+        in-jit from the persistent device arrays by slot row — block
+        tables from `dev_tables` (pallas: sliced to the static `w_b`
+        page bucket; gather: expanded to the full slot matrix) and
+        sampling params from `samp_f`/`samp_i`. Rows with carry_mask
+        read their input token from the device `carry` vector instead
+        of host token history (their previous step's sample has not
+        reached the host yet — the pipelined build), and every decode
+        row's newest sample is scattered back into `carry` (donated) so
+        the NEXT pipelined build needs no host round trip either.
 
         spec x mixed composition (`draft` [n, k_max] + `dlen` [n] set):
         decode rows become ragged VERIFY rows — q_len = 1 + dlen (carry
@@ -1128,30 +1240,59 @@ class JaxEngine:
         acceptance over ALL rows at once: prefill rows have dlen=0, so
         their window column 0 IS the plain sample at last_idx (greedy:
         the same argmax; sampled: the same shortlist distribution) and
-        n_emit=1. Returns ((out_tokens [n, k_max+1], n_emit [n]), kv)
-        in spec mode, (sampled [n], kv) otherwise.
+        n_emit=1. Returns ((out_tokens [n, k_max+1], n_emit [n]), kv,
+        new_carry) in spec mode, (sampled [n], kv, new_carry) otherwise.
 
         Attention backends: the gather oracle with ragged `q_lens`
         everywhere; on pallas engines a row-scatter KV write + the
-        ragged flash kernel (`btables` set; the page-granular prefill
-        scatter cannot express a decode row's mid-page write, see
-        llama._attn_block). Verify rows need nothing new from either
-        backend: they are just ragged rows whose q_pos0 is mid-page."""
-        if btables is not None:
+        ragged flash kernel (the page-granular prefill scatter cannot
+        express a decode row's mid-page write, see llama._attn_block).
+        Verify rows need nothing new from either backend: they are just
+        ragged rows whose q_pos0 is mid-page."""
+        tokens, positions, wslots = hot[0], hot[1], hot[2]
+        last_idx = row_meta[:, 0]
+        slot_rows = row_meta[:, 1]
+        carry_mask = row_meta[:, 2].astype(bool)
+        dec_mask = row_meta[:, 3].astype(bool)
+        n = tokens.shape[0]
+        temp, topp = samp_f[slot_rows, 0], samp_f[slot_rows, 1]
+        topk = samp_i[slot_rows, 0]
+        tbl = dev_tables[slot_rows]  # [n, W] per-row block tables
+        # pipelined decode rows take their input token from the device
+        # carry; padding rows gather slot 0 and are masked off
+        tokens = tokens.at[:, 0].set(
+            jnp.where(carry_mask, carry[slot_rows], tokens[:, 0])
+        )
+        if self._attn_pallas:
             attn = llama.AttnSpec.gather(
                 None, page_size=self.page_size,
                 interpret=self._attn_interpret, mesh=self._attn_mesh,
-                block_tables=btables, q_pos0=positions[:, 0],
+                block_tables=tbl[:, :w_b], q_pos0=positions[:, 0],
                 lengths=last_idx + 1, kv_tp=self.config.mesh.tp,
             )
         else:
+            smat = (
+                tbl[:, :, None] * self.page_size
+                + jnp.arange(self.page_size, dtype=jnp.int32)
+            ).reshape(n, -1)
             attn = llama.AttnSpec.gather(
-                slot_matrix, page_size=self.page_size,
+                smat, page_size=self.page_size,
                 lengths=last_idx + 1, kv_tp=self.config.mesh.tp,
             )
         hidden, kv = llama.forward(
-            params, self.model_cfg, tokens, positions, kv, write_slots, attn
+            params, self.model_cfg, tokens, positions, kv,
+            wslots.reshape(-1), attn,
         )
+
+        def _scatter_carry(vals):
+            # every decode row's newest sample becomes the device-
+            # resident q_len=1 input of the NEXT step; prefill/padding
+            # rows scatter out of range and drop (a padding row shares
+            # slot 0 with whatever lives there — it must not race the
+            # real row's write)
+            idx = jnp.where(dec_mask, slot_rows, carry.shape[0])
+            return carry.at[idx].set(vals, mode="drop")
+
         if draft is not None:
             # spec window: gather (k_max+1) hidden columns per row ending
             # at last_idx — decode verify rows span [0, dlen] (offset 0
@@ -1169,7 +1310,10 @@ class JaxEngine:
                 lg, draft, dlen, key, temp, topk, topp,
                 all_greedy=all_greedy,
             )
-            return (out, n_emit), kv
+            last_col = jnp.take_along_axis(
+                out, jnp.maximum(n_emit - 1, 0)[:, None], axis=1
+            )[:, 0]
+            return (out, n_emit), kv, _scatter_carry(last_col)
         last_h = jnp.take_along_axis(
             hidden, last_idx[:, None, None].astype(jnp.int32), axis=1
         )[:, 0]  # [n, D]
@@ -1177,13 +1321,14 @@ class JaxEngine:
         toks = sample_tokens(
             lg, key, temp, topk, topp, all_greedy=all_greedy
         )
-        return toks, kv
+        return toks, kv, _scatter_carry(toks)
 
     # ------------------------------------------------------------------
     # engine protocol
 
     async def generate(
-        self, request: Context, _preloaded: Optional[tuple] = None
+        self, request: Context, _preloaded: Optional[tuple] = None,
+        _blocks: Optional["TokenBlockSequence"] = None,
     ) -> AsyncIterator[dict]:
         if self._closed:
             # the loop has exited; a queued request would hang forever
@@ -1240,7 +1385,8 @@ class JaxEngine:
                     f"{self.model_cfg.hidden_size}"
                 )
         seq = Sequence.from_request(
-            request, pre, self.page_size, self.config.max_model_len
+            request, pre, self.page_size, self.config.max_model_len,
+            blocks=_blocks,
         )
         seq.t_submit = time.perf_counter()
         if tracing.enabled():
@@ -1271,6 +1417,7 @@ class JaxEngine:
         v_arr: np.ndarray,
         ks_arr: Optional[np.ndarray] = None,
         vs_arr: Optional[np.ndarray] = None,
+        _blocks: Optional["TokenBlockSequence"] = None,
     ) -> AsyncIterator[dict]:
         """Decode-side disagg entry: like generate(), but the prompt's KV
         (computed by a remote prefill worker) is injected instead of
@@ -1302,7 +1449,9 @@ class JaxEngine:
                         f"expected {want_s}"
                     )
         preloaded = (int(first_token), k_arr, v_arr, ks_arr, vs_arr)
-        return await self.generate(request, _preloaded=preloaded)
+        return await self.generate(
+            request, _preloaded=preloaded, _blocks=_blocks
+        )
 
     async def prefill_only(
         self, pre: PreprocessedRequest, ctx: Optional[Context] = None,
@@ -1486,14 +1635,18 @@ class JaxEngine:
                 # stall-free mixed step first: when decode-ready rows
                 # and pending prefill chunks coexist, ONE token-budgeted
                 # dispatch advances both planes and the normal
-                # prefill/decode ticks stand down ("hold" = an in-flight
-                # decode dispatch must sync before the host-built mixed
-                # window is current; it lands below, mixed runs next
-                # tick)
+                # prefill/decode ticks stand down. With the step
+                # pipeline (default) the mixed tick dispatches BEHIND
+                # any in-flight dispatch (q_len=1 rows read the device
+                # carry), syncs the old one while the new executes, and
+                # leaves its own dispatch in flight ("pipelined");
+                # serialized engines instead "hold" a tick whenever a
+                # dispatch is in flight (host-built windows need synced
+                # token history)
                 mixed = None
                 if self.config.mixed_batching:
                     mixed = await self._mixed_tick()
-                    progressed |= mixed is True
+                    progressed |= mixed in (True, "pipelined")
                 # per tick: prefill chunks enqueue first (they own self.kv
                 # until their dispatch call returns), then decode dispatch
                 # N+1 runs in a worker thread WHILE the loop fetches
@@ -1503,19 +1656,46 @@ class JaxEngine:
                 # the loop serializes at ~2x device time per dispatch
                 if mixed is None:
                     progressed |= await self._prefill_tick()
+                pipe = self.config.step_pipeline
+                if not pipe and mixed != "pipelined":
+                    # serialized A/B baseline: dispatch -> fetch -> sync,
+                    # nothing overlaps — the old dispatch lands BEFORE
+                    # the next one is even built
+                    old, self._inflight = self._inflight, None
+                    if old is not None:
+                        await self._sync_dispatch(old)
+                        progressed = True
                 new_task = None
                 snapshot = (
                     self._maybe_dispatch_decode() if mixed is None else None
                 )
+                if snapshot == "sync_first":
+                    # worthwhile spec drafts behind an in-flight
+                    # dispatch: sync it NOW and re-enter the build, so
+                    # the verify window dispatches THIS tick instead of
+                    # after a dead tick (the standalone-spec half of the
+                    # step pipeline — verify windows are host-built, so
+                    # the sync is a real data dependency, but the dead
+                    # tick between it and the verify dispatch was not)
+                    old, self._inflight = self._inflight, None
+                    if old is not None:
+                        await self._sync_dispatch(old)
+                        progressed = True
+                    snapshot = self._maybe_dispatch_decode()
+                    if snapshot == "sync_first":  # nothing left in flight
+                        snapshot = None
                 if snapshot is not None:
                     new_task = asyncio.create_task(
                         asyncio.to_thread(self._run_decode_dispatch, snapshot)
                     )
                     progressed = True
-                old, self._inflight = self._inflight, None
-                if old is not None:
-                    await self._sync_dispatch(old)
-                    progressed = True
+                if pipe and mixed != "pipelined":
+                    old, self._inflight = self._inflight, None
+                    if old is not None:
+                        await self._sync_dispatch(
+                            old, overlapped=new_task is not None
+                        )
+                        progressed = True
                 if new_task is not None:
                     self._inflight = await new_task
                 if progressed:
@@ -1594,6 +1774,7 @@ class JaxEngine:
                 "prompt_tokens": seq.prompt_len,
             }
             self.slots[slot] = seq
+            self._mark_slot_state(seq)
             if self.config.spec_decode and seq.spec is None:
                 # seed the n-gram index with the prompt once; the index
                 # survives preemption (the token history it covers does
@@ -1608,6 +1789,47 @@ class JaxEngine:
             self._prefilling.append(seq)
             progressed = True
         return progressed
+
+    def _mark_slot_state(self, seq: Sequence) -> None:
+        """Refresh a slot's device-resident input rows (block table +
+        sampling params) in the host mirrors and queue the scatter —
+        called on admit and on page growth, the only times a LIVE slot's
+        slow-changing inputs change (loop thread only)."""
+        i = seq.slot
+        row = self._host_tables[i]
+        row[:] = 0
+        n = min(len(seq.page_ids), row.shape[0])
+        row[:n] = seq.page_ids[:n]
+        self._host_samp_f[i] = (
+            seq.temperature, seq.top_p, seq.frequency_penalty,
+            seq.presence_penalty, seq.repetition_penalty,
+        )
+        self._host_samp_i[i] = (seq.top_k, seq.seed)
+        self._dirty_slots.add(i)
+
+    def _snap_dirty(self):
+        """Snapshot (loop thread) the slots whose device-resident rows
+        changed since the last dispatch; the dispatch worker applies it
+        under _kv_lock via `_flush_dev_state_locked`. None when nothing
+        changed — the steady-state decode path then uploads NOTHING
+        slow-changing."""
+        if not self._dirty_slots:
+            return None
+        idx = np.asarray(_pad_pow2(sorted(self._dirty_slots)), np.int32)
+        self._dirty_slots.clear()
+        return (
+            idx, self._host_tables[idx].copy(),
+            self._host_samp_f[idx].copy(), self._host_samp_i[idx].copy(),
+        )
+
+    def _flush_dev_state_locked(self, snap) -> None:
+        if snap is None:
+            return
+        idx, tb, sf, si = snap
+        sl = jnp.asarray(idx)
+        self._dev_tables = self._dev_tables.at[sl].set(jnp.asarray(tb))
+        self._dev_samp_f = self._dev_samp_f.at[sl].set(jnp.asarray(sf))
+        self._dev_samp_i = self._dev_samp_i.at[sl].set(jnp.asarray(si))
 
     def _reset_and_count(self, counts, row, tokens, reset=True):
         """Zero a slot's occurrence-count row (first chunk) and
@@ -1909,6 +2131,10 @@ class JaxEngine:
         seq.prefilling = False
         seq.device_pos = seq.num_computed
         self._overrides[seq.slot] = tok
+        # the override supersedes whatever the device carry row holds
+        # (a previous tenant's token, or garbage) — the step pipeline
+        # must not read it until a dispatch re-arms it
+        self._carry_ok[seq.slot] = False
         seq.carry_pending = True
         if not isinstance(tok, tuple):
             # disagg-injected first token: sampled remotely, already on
@@ -2335,11 +2561,25 @@ class JaxEngine:
         whole family is that prefill and decode serialize on the donated
         KV cache regardless of how the host interleaves dispatches).
 
-        Returns True (a step ran — the normal prefill/decode ticks stand
-        down), "hold" (worthwhile, but the in-flight decode dispatch must
-        sync first: mixed windows are host-built like spec verify, so
-        token history has to be current — skip both planes this tick and
-        run next tick), or None (not applicable: normal paths run)."""
+        With `EngineConfig.step_pipeline` (default) the step launches
+        BEHIND whatever dispatch is already in flight: rows that
+        advanced deterministically in that dispatch (a plain decode
+        scan, or a previous mixed step's q_len=1 rows) join at q_len=1
+        reading their input token from the device carry vector —
+        `_carry_ok` is the license — and spec-eligible rows among them
+        SHED their drafts (n-gram drafting needs synced host history;
+        they still advance, drafts resume once the sync catches up,
+        `mixed_spec_shed`). Rows whose in-flight advance is
+        data-dependent (verify windows) sit the step out. The old
+        dispatch is synced while the new one executes, and the new step
+        stays in flight ("pipelined" return) for the next tick to land.
+
+        Returns True (a serialized step ran and synced), "pipelined" (a
+        step was dispatched and left in flight; the old dispatch was
+        synced here), "hold" (serialized engines only: worthwhile, but
+        the in-flight dispatch must sync first — host-built windows
+        need current token history), or None (not applicable: normal
+        paths run)."""
         if self._closed or self._mixed_disabled or not self._prefilling:
             return None
         why = self._mixed_unsupported_reason()
@@ -2348,18 +2588,87 @@ class JaxEngine:
                 self._mixed_warned = True
                 log.warning("mixed_batching disabled: %s", why)
             return None
+        pipeline = self.config.step_pipeline
+        # classify the in-flight dispatch's rows: deterministic advances
+        # can pipeline through the device carry, data-dependent ones
+        # (verify windows) block until their sync
+        stale_det: dict[int, Sequence] = {}
+        blocked: set[int] = set()
+        infl = self._inflight
+        if infl is not None and pipeline:
+            if infl.spec:
+                blocked = {i for i, _ in infl.snapshot}
+            elif infl.mixed:
+                for kind, slot, seq, chunk in infl.bld["entries"]:
+                    if kind != "dec":
+                        continue
+                    if chunk == 1 and self._carry_ok[slot]:
+                        stale_det[slot] = seq
+                    else:
+                        blocked.add(slot)
+            else:
+                # plain decode scan: every row advances exactly
+                # decode_steps and the scan's last sample is already in
+                # the device carry vector
+                for i, s in infl.snapshot:
+                    if self._carry_ok[i]:
+                        stale_det[i] = s
+                    else:
+                        blocked.add(i)
         rows = self._mixed_eligible_decode()
+        if rows:
+            rows = [(i, s) for i, s in rows if i not in blocked]
         if not rows:
             return None
+        carry_rows = {i for i, s in rows if stale_det.get(i) is s}
+        if (
+            carry_rows and self.config.spec_decode and self.config.mixed_spec
+            and any(
+                s.spec is not None and s.spec.gate_open()
+                for i, s in rows if i in carry_rows
+            )
+        ):
+            # a carry row whose acceptance gate is OPEN would draft if
+            # its host history were current — and an accepted draft is
+            # worth a whole extra token per step, which beats hiding one
+            # host fetch wall. Sync the in-flight dispatch NOW (the same
+            # trade the standalone path makes via "sync_first") and
+            # rebuild from fresh history; gated-off rows keep the
+            # zero-stall overlap and shed instead. Without this, steady
+            # pipelined flow NEVER syncs between mixed steps and the
+            # spec x mixed win silently disappears.
+            old, self._inflight = self._inflight, None
+            if old is not None:
+                await self._sync_dispatch(old)
+            rows = self._mixed_eligible_decode()
+            if not rows:
+                return True  # the sync itself made progress
+            carry_rows = set()
         # spec x mixed composition: propose n-gram drafts for the decode
         # rows up front — each spec row costs 1 + k budget tokens, so
         # drafts trade off transparently against prefill chunk size. A
         # discarded build never strands a probe (only observe() re-arms
-        # the proposer's countdown).
+        # the proposer's countdown). Carry rows never draft: their host
+        # history is stale until the in-flight sync lands, so the
+        # proposer would continue the wrong suffix — shed, don't stall.
         drafts: dict[int, list[int]] = {}
+        shed = 0
         if self.config.spec_decode and self.config.mixed_spec:
             k_cap = min(self.config.spec_k_max, self.config.prefill_chunk - 1)
             for i, seq in rows:
+                if i in carry_rows:
+                    if seq.spec is not None:
+                        shed += 1
+                        # tick the probe countdown even though stale
+                        # history forbids drafting: a shed row whose
+                        # gate is closed would otherwise NEVER decrement
+                        # it under sustained pipelined flow (carry rows
+                        # skip maybe_draft) and stay gated off until the
+                        # flow breaks — when the countdown expires,
+                        # gate_open flips and the sync-first escape
+                        # above re-drafts from fresh history
+                        seq.spec.shed_tick()
+                    continue
                 remaining = seq.max_new_tokens - seq.generated
                 room = self.config.max_model_len - 1 - seq.device_pos
                 k_i = min(k_cap, remaining - 1, room)
@@ -2400,7 +2709,12 @@ class JaxEngine:
                 return None
         if not picks:
             return None
-        if self._inflight is not None:
+        if self._inflight is not None and not pipeline:
+            # serialized baseline: host-built windows need synced token
+            # history — park both planes this tick (the stall the step
+            # pipeline exists to remove)
+            with self._phase_lock:
+                self._phase_stats["mixed_holds"] += 1
             return "hold"
         # grow decode rows' pages through the positions this step writes
         # ([device_pos, device_pos + drafts]); growth may preempt
@@ -2425,8 +2739,33 @@ class JaxEngine:
         ]
         if not rows or not picks:
             return None
-        bld = self._build_mixed(rows, picks, drafts)
-        t0 = time.perf_counter()
+        bld = self._build_mixed(
+            rows, picks, drafts, carry_rows=carry_rows, pipelined=pipeline
+        )
+        bld["n_shed"] = shed
+        # the picked chunks leave the prefill queue while the step is in
+        # flight (a pipelined step may still be unsynced when the next
+        # prefill tick runs — it must not re-dispatch the same chunk);
+        # the sync re-appends non-final chunks, the failure path restores
+        for seq, _ in picks:
+            self._prefilling.remove(seq)
+        if pipeline:
+            task = asyncio.create_task(
+                asyncio.to_thread(self._run_mixed_dispatch, bld)
+            )
+            old, self._inflight = self._inflight, None
+            if old is not None:
+                # the old dispatch's fetch overlaps the mixed step just
+                # queued behind it — the zero-stall handoff
+                await self._sync_dispatch(old, overlapped=True)
+            try:
+                S = await task
+            except Exception:
+                self._mixed_dispatch_failed(bld)
+                return None
+            self._inflight = _Dispatch(S, [], 1, mixed=True, bld=bld)
+            return "pipelined"
+        t0 = bld["t0"]
         try:
             S = await asyncio.to_thread(self._run_mixed_dispatch, bld)
             t_sync0 = time.perf_counter()
@@ -2436,22 +2775,7 @@ class JaxEngine:
                 if isinstance(S, tuple) else np.asarray(S)
             )
         except Exception:
-            # contain the failure like _prefill_tick does: nothing was
-            # advanced (bookkeeping happens at sync), so the normal
-            # paths can retry everything — re-arm the decode rows' carry
-            # overrides the build consumed (their last_token IS the
-            # host truth; the device carry vector may predate earlier
-            # mixed steps), then disable mixed steps on this engine —
-            # retrying a failing dispatch family every tick would wedge
-            # the loop instead of degrading to the contained paths
-            log.exception(
-                "mixed step of %d rows failed; disabling mixed batching "
-                "(normal prefill/decode paths take over)", len(bld["entries"])
-            )
-            for kind, slot, seq, _ in bld["entries"]:
-                if kind == "dec" and slot >= 0 and self.slots[slot] is seq:
-                    self._overrides[slot] = int(seq.last_token)
-            self._mixed_disabled = True
+            self._mixed_dispatch_failed(bld)
             return None
         now = time.perf_counter()
         with self._phase_lock:
@@ -2467,8 +2791,49 @@ class JaxEngine:
         self._sync_mixed(bld, toks)
         return True
 
+    def _mixed_dispatch_failed(self, bld: dict) -> None:
+        """Contain a failed mixed dispatch like _prefill_tick contains
+        prefill failures: nothing landed host-side except the build's
+        own bookkeeping, so un-advance pipelined q_len=1 rows, re-arm
+        every decode row's carry override from host truth (the device
+        carry vector may predate earlier steps — in the pipelined case
+        the previous dispatch was already synced before the failure
+        surfaced, so `last_token` IS current), restore the prefill picks
+        in FIFO order, re-queue the unflushed device-state scatter, then
+        disable mixed steps on this engine — retrying a failing dispatch
+        family every tick would wedge the loop instead of degrading to
+        the contained normal paths."""
+        log.exception(
+            "mixed step of %d rows failed; disabling mixed batching "
+            "(normal prefill/decode paths take over)", len(bld["entries"])
+        )
+        pf_restore = []
+        for kind, slot, seq, chunk in bld["entries"]:
+            if kind == "dec":
+                if slot >= 0 and self.slots[slot] is seq:
+                    if bld["pipelined"] and chunk == 1:
+                        seq.device_pos -= 1
+                    self._overrides[slot] = int(seq.last_token)
+                    self._carry_ok[slot] = False
+            elif (
+                seq.slot >= 0 and self.slots[seq.slot] is seq
+                and seq not in self._prefilling
+            ):
+                pf_restore.append(seq)
+        for seq in reversed(pf_restore):
+            self._prefilling.appendleft(seq)
+        if bld["dirty"] is not None:
+            # the device-state scatter may never have run; re-dirty so
+            # the next normal dispatch flushes it
+            self._dirty_slots.update(int(i) for i in bld["dirty"][0])
+        self._mixed_disabled = True
+        with self._phase_lock:
+            self._phase_stats["mixed_disabled"] = 1
+
     def _build_mixed(self, rows: list, picks: list,
-                     drafts: Optional[dict] = None) -> dict:
+                     drafts: Optional[dict] = None,
+                     carry_rows: frozenset = frozenset(),
+                     pipelined: bool = False) -> dict:
         """Host-side input build for one mixed step: decode rows first
         (q_len=1, their host-known carry token — or a ragged 1+k verify
         window [carry, d_1..d_k] when spec composes), then one chunk per
@@ -2476,7 +2841,18 @@ class JaxEngine:
         chunk's prefill bucket, so the compiled families stay the
         [pow2, bucket] grid group prefill already uses (the verify
         window k_max+1 never exceeds the smallest bucket in practice;
-        t_b covers it explicitly regardless)."""
+        t_b covers it explicitly regardless).
+
+        Step-pipeline contract: `carry_rows` slots read their q_len=1
+        input from the device carry in-jit (their host token is a stale
+        placeholder here); when `pipelined`, every q_len=1 decode row's
+        `device_pos` advances NOW — deterministically, exactly like the
+        decode scan's build — so the NEXT build can launch behind this
+        still-unsynced step. Sampling params and block tables are NOT
+        built here: the step gathers them in-jit from the persistent
+        device arrays via `slot_rows` (`w_b` is the static pallas
+        attended-page bucket; 0 on gather engines, which expand the
+        full slot matrix in-jit)."""
         ps = self.page_size
         use_spec = bool(drafts)
         k_max = self.config.spec_k_max if use_spec else 0
@@ -2486,22 +2862,19 @@ class JaxEngine:
         t_b = self._bucket_for(
             max(max(c for _, c in picks), k_max + 1)
         )
-        tok_arr = np.zeros((n, t_b), np.int32)
-        pos_arr = np.zeros((n, t_b), np.int32)
-        wslots = np.zeros((n, t_b), np.int32)
-        last_idx = np.zeros(n, np.int32)
-        temp = np.zeros(n, np.float32)
-        topk = np.zeros(n, np.int32)
-        topp = np.ones(n, np.float32)
+        t0 = time.perf_counter()
+        hot = np.zeros((3, n, t_b), np.int32)  # [tokens, positions, wslots]
+        tok_arr, pos_arr, wslots = hot[0], hot[1], hot[2]
+        # [last_idx, slot_row, carry_mask, dec_mask] per row — the second
+        # fused upload
+        meta = np.zeros((n, 4), np.int32)
+        all_greedy = True
         draft_arr = np.zeros((n, k_max), np.int32) if use_spec else None
         dlen_arr = np.zeros(n, np.int32) if use_spec else None
         pos0_arr = np.zeros(n, np.int32)
-        smat = (
-            None if self._attn_pallas
-            else np.zeros((n, self._smat_width), np.int32)
-        )
         entries = []  # (kind, slot, seq, chunk) per built row
         w_need = 1
+        n_carry = 0
         j = 0
         for slot, seq in rows:
             d = drafts.get(slot, []) if use_spec else []
@@ -2522,16 +2895,30 @@ class JaxEngine:
             wslots[j, :kd + 1] = np.where(
                 ok, pages[np.minimum(idx, max_len - 1) // ps] * ps + idx % ps, 0
             )
-            if smat is not None:
-                smat[j] = self._slot_matrix_row(seq)
-            last_idx[j] = kd
-            temp[j] = seq.temperature
-            topk[j] = seq.top_k
-            topp[j] = seq.top_p
+            meta[j] = (kd, slot, slot in carry_rows, 1)
+            n_carry += slot in carry_rows
+            # the step's in-jit scatter puts this row's newest sample in
+            # the device carry vector — license for the next pipelined
+            # build (position-deterministic only for q_len=1 rows; the
+            # classifier in _mixed_tick checks that separately)
+            self._carry_ok[slot] = True
+            all_greedy = all_greedy and seq.temperature <= 0.0
             w_need = max(w_need, (seq.device_pos + kd) // ps + 1)
-            # the host-built window replaces any carry override for this
-            # slot (its token is already in host history)
-            self._overrides.pop(slot, None)
+            if slot in carry_rows:
+                # a stale override (set by a sync that landed after this
+                # row's last build) stays put: the pending syncs of the
+                # in-flight steps overwrite it before any non-stale
+                # build can consume it
+                pass
+            else:
+                # the host-built window replaces any carry override for
+                # this slot (its token is already in host history)
+                self._overrides.pop(slot, None)
+            if pipelined and kd == 0:
+                # deterministic advance, mirrored from the decode scan's
+                # build: the next pipelined window builds from here while
+                # this step is still in flight (sync does NOT re-advance)
+                seq.device_pos += 1
             entries.append(("dec", slot, seq, 1 + kd))
             j += 1
         for seq, chunk in picks:
@@ -2543,54 +2930,44 @@ class JaxEngine:
             pos0_arr[j] = start
             pages = np.asarray(seq.page_ids, np.int32)
             wslots[j, :chunk] = pages[idx // ps] * ps + idx % ps
-            if smat is not None:
-                smat[j] = self._slot_matrix_row(seq)
-            last_idx[j] = chunk - 1
-            temp[j] = seq.temperature
-            topk[j] = seq.top_k
-            topp[j] = seq.top_p
+            meta[j] = (chunk - 1, seq.slot, 0, 0)
+            all_greedy = all_greedy and seq.temperature <= 0.0
             w_need = max(w_need, -(-(start + chunk) // ps))
             entries.append(("pf", seq.slot, seq, chunk))
             j += 1
-        btables = None
-        if self._attn_pallas:
-            # attended-page width buckets to a power of two like group
-            # prefill (full width would DMA every trash page per tile)
-            w_b = min(
-                1 << (w_need - 1).bit_length(), self.config.max_pages_per_seq
-            )
-            btables = np.zeros((n, w_b), np.int32)
-            for jj, (_, _, seq, _) in enumerate(entries):
-                npg = min(len(seq.page_ids), w_b)
-                btables[jj, :npg] = seq.page_ids[:npg]
+        # attended-page width buckets to a power of two like group
+        # prefill (full width would DMA every trash page per tile);
+        # static 0 on gather engines so w_b never forks their traces
+        w_b = min(
+            1 << (w_need - 1).bit_length(), self.config.max_pages_per_seq
+        ) if self._attn_pallas else 0
         return dict(
-            tok=tok_arr, pos=pos_arr, wslots=wslots, smat=smat,
-            last_idx=last_idx, temp=temp, topk=topk, topp=topp,
-            btables=btables, entries=entries,
+            hot=hot, meta=meta, entries=entries,
             spec=use_spec, draft=draft_arr, dlen=dlen_arr, pos0=pos0_arr,
-            all_greedy=bool((temp[:n_rows] <= 0.0).all()),
+            all_greedy=all_greedy, w_b=w_b, pipelined=pipelined,
+            n_carry=n_carry, n_shed=0, t0=t0, dirty=self._snap_dirty(),
         )
 
     def _run_mixed_dispatch(self, bld: dict):
         """Jax half of a mixed step (worker thread, _kv_lock): returns
         the device sampled-token vector [n], or (out_tokens [n, k+1],
-        n_emit [n]) when spec verify rows composed in."""
+        n_emit [n]) when spec verify rows composed in. Flushes any
+        pending device-state scatter first, uploads the two fused hot
+        arrays, and threads the donated carry vector through the step
+        (the in-jit decode-row scatter that makes pipelined builds
+        host-round-trip-free)."""
         t0 = time.perf_counter()
         with self._kv_lock:
+            self._flush_dev_state_locked(bld["dirty"])
             self._key, sub = jax.random.split(self._key)
-            S, self.kv = self._mixed_fn(
+            S, self.kv, self._carry_toks = self._mixed_fn(
                 self.params, self.kv,
-                jnp.asarray(bld["tok"]), jnp.asarray(bld["pos"]),
-                jnp.asarray(bld["wslots"].reshape(-1)),
-                jnp.asarray(bld["smat"]) if bld["smat"] is not None else None,
-                jnp.asarray(bld["last_idx"]),
-                jnp.asarray(bld["temp"]), jnp.asarray(bld["topk"]),
-                jnp.asarray(bld["topp"]), sub,
-                jnp.asarray(bld["btables"])
-                if bld["btables"] is not None else None,
+                jnp.asarray(bld["hot"]), jnp.asarray(bld["meta"]),
+                self._dev_samp_f, self._dev_samp_i, self._dev_tables,
+                self._carry_toks, sub,
                 jnp.asarray(bld["draft"]) if bld["spec"] else None,
                 jnp.asarray(bld["dlen"]) if bld["spec"] else None,
-                bld["all_greedy"],
+                bld["all_greedy"], bld["w_b"],
             )
         self._step_count += 1
         for arr in (S if isinstance(S, tuple) else (S,)):
@@ -2605,7 +2982,7 @@ class JaxEngine:
                 rows=len(entries),
                 decode_rows=sum(1 for e in entries if e[0] == "dec"),
                 tokens=sum(e[3] for e in entries),
-                spec=bld["spec"],
+                spec=bld["spec"], pipelined=bld["pipelined"],
             )
         return S
 
@@ -2646,12 +3023,17 @@ class JaxEngine:
                     emitted, accepted = self._emit_verify_row(
                         slot, seq, out[j], int(n_emit[j]), drafted,
                         int(bld["pos0"][j]),
+                        keep_pos=bld["pipelined"] and drafted == 0,
                     )
                     drafted_total += drafted
                     accepted_total += accepted
                     emitted_total += emitted
                     continue
-                seq.device_pos += 1
+                if not bld["pipelined"]:
+                    # pipelined builds advanced device_pos up front (the
+                    # deterministic-advance contract); serialized steps
+                    # advance here at sync
+                    seq.device_pos += 1
                 seq.num_computed += 1
                 self._register_full_pages(seq)
                 self._append_token(seq, tok)
@@ -2693,6 +3075,8 @@ class JaxEngine:
             st["mixed_step_tokens_max"] = max(
                 st["mixed_step_tokens_max"], n_dec_tokens + n_pf_tokens
             )
+            st["mixed_carry_rows"] += bld["n_carry"]
+            st["mixed_spec_shed"] += bld["n_shed"]
             if spec_mode:
                 st["mixed_spec_rows"] += spec_rows
                 st["spec_rows"] += spec_rows
@@ -2740,19 +3124,26 @@ class JaxEngine:
             # prompt cannot stall running streams.
             return None
 
-        if self._inflight is not None and self._inflight.spec:
-            # spec dispatches never pipeline: positions and carries for
-            # the NEXT dispatch are only known after sync. OUTSIDE the
-            # config check — a runtime spec_decode toggle-off must not
-            # let a normal dispatch launch from the stale host state
+        if self._inflight is not None and (
+            self._inflight.spec or self._inflight.mixed
+        ):
+            # spec verify windows advance data-dependently (positions
+            # and carries for the NEXT dispatch are only known after
+            # sync), and a pipelined mixed step re-arms carry overrides
+            # at ITS sync — a normal dispatch built from the pre-sync
+            # host state would replay a stale carry. OUTSIDE the config
+            # checks — runtime toggles must not let a normal dispatch
+            # launch from stale host state.
             return None
         if self.config.spec_decode:
             bld = self._maybe_build_spec(ready)
             if bld == "wait":
                 # worthwhile drafts exist but a normal dispatch is in
-                # flight: hold this build, let the sync land (advancing
-                # host history), and spec-dispatch next tick
-                return None
+                # flight: the step pipeline syncs it and re-enters this
+                # build in the SAME tick ("sync_first", see _loop);
+                # serialized engines hold the build a tick so the sync
+                # lands first
+                return "sync_first" if self.config.step_pipeline else None
             if bld is not None:
                 return bld
 
@@ -2772,47 +3163,37 @@ class JaxEngine:
             return None
         active, b = prep
 
-        w = self.config.max_pages_per_seq
-        positions = np.zeros(b, np.int32)
-        tables = np.zeros((b, w), np.int32)
-        act = np.zeros(b, bool)
-        temp = np.zeros(b, np.float32)
-        topk = np.zeros(b, np.int32)
-        topp = np.ones(b, np.float32)
-        fp = np.zeros(b, np.float32)
-        prp = np.zeros(b, np.float32)
-        rp = np.ones(b, np.float32)
-        seeds = np.full(b, -1, np.int32)
+        # the ONE fused per-dispatch H2D upload: [positions, active];
+        # block tables + sampling/penalty params stay device-resident
+        # (scatter-updated on admit/growth via the dirty snapshot below)
+        pos_act = np.zeros((b, 2), np.int32)
         use_ext = False
         want_lps = False
         want_tops = False
+        all_greedy = True
         for i, seq in active:
-            positions[i] = seq.device_pos
-            tables[i, : len(seq.page_ids)] = seq.page_ids
-            act[i] = True
-            temp[i] = seq.temperature
-            topk[i] = seq.top_k
-            topp[i] = seq.top_p
-            fp[i] = seq.frequency_penalty
-            prp[i] = seq.presence_penalty
-            rp[i] = seq.repetition_penalty
-            seeds[i] = seq.seed
+            pos_act[i, 0] = seq.device_pos
+            pos_act[i, 1] = 1
+            all_greedy = all_greedy and seq.temperature <= 0.0
             use_ext = use_ext or seq.has_penalties or seq.seed >= 0
             want_lps = want_lps or seq.want_logprobs
             want_tops = want_tops or seq.top_logprobs > 0
             seq.device_pos += k_steps
+            # the scan ends with this row's newest sample in the device
+            # carry vector — the pipelined mixed build's license to read
+            # it before this dispatch syncs
+            self._carry_ok[i] = True
 
         overrides = {
-            slot: val for slot, val in self._overrides.items() if act[slot]
+            slot: val for slot, val in self._overrides.items()
+            if pos_act[slot, 1]
         }
         self._overrides.clear()
         return _DecodeBuild(
-            positions=positions, tables=tables, act=act, temp=temp,
-            topk=topk, topp=topp, fp=fp, prp=prp, rp=rp, seeds=seeds,
-            use_ext=use_ext, want_lps=want_lps, want_tops=want_tops,
-            overrides=overrides, active=active,
-            steps=k_steps, width=b,
-            all_greedy=bool((temp[act] <= 0.0).all()) if act.any() else True,
+            pos_act=pos_act, use_ext=use_ext, want_lps=want_lps,
+            want_tops=want_tops, overrides=overrides, active=active,
+            steps=k_steps, width=b, all_greedy=all_greedy,
+            dirty=self._snap_dirty(),
         )
 
     def _grow_and_collect(self, ready, upto):
@@ -2923,8 +3304,12 @@ class JaxEngine:
             topk[i] = seq.top_k
             topp[i] = seq.top_p
             # the host token window replaces the device carry; any
-            # stale override for this slot is already in host history
+            # stale override for this slot is already in host history.
+            # The verify step advances data-dependently and never
+            # touches the carry vector — it is stale until the sync
+            # re-arms an int override.
             self._overrides.pop(i, None)
+            self._carry_ok[i] = False
         return _DecodeBuild(
             spec=True, tokens=tokens, positions=positions, tables=tables,
             draft=draft, dlen=dlen, pos0=pos0, act=act, temp=temp,
@@ -2955,7 +3340,7 @@ class JaxEngine:
                     track="engine.steps", rows=rows, tokens=n_tok,
                 )
             return out
-        n_tok = int(np.sum(bld.act)) * bld.steps
+        n_tok = int(bld.pos_act[:, 1].sum()) * bld.steps
         with self._phase_lock:
             self._phase_stats["decode_dispatch_s"] += t1 - t0
             self._phase_stats["decode_dispatches"] += 1
@@ -2993,6 +3378,7 @@ class JaxEngine:
         )
 
     def _run_decode_dispatch_locked(self, bld: "_DecodeBuild") -> _Dispatch:
+        self._flush_dev_state_locked(bld.dirty)
         w = bld.width  # bucketed dispatch width (power of two >= highest
         # active slot + 1; carries/counts slice to it and write back)
         toks = self._carry_toks[:w]
@@ -3003,7 +3389,12 @@ class JaxEngine:
         if bld.overrides:
             # batch the carry overrides into one scatter per source
             # vector — a per-slot .at[].set is a separate dispatch (~ms
-            # each through the tunnel)
+            # each through the tunnel). Index vectors pad to a power of
+            # two (_pad_pow2): every distinct length is a distinct XLA
+            # program, and under paced arrivals the override count
+            # varies per dispatch — unpadded, each new length costs a
+            # fresh ~2 s remote compile mid-serve (measured: 6 decode
+            # dispatches spent 12 s of wall on this)
             by_vec: dict[int, tuple] = {}
             ints: list[tuple[int, int]] = []
             for slot, val in bld.overrides.items():
@@ -3020,20 +3411,9 @@ class JaxEngine:
                     fresh[slot] = True
                     ints.append((slot, int(val)))
 
-            def pad_pow2(vals: list) -> list:
-                # scatter-index vectors pad to a power of two by
-                # REPEATING the last entry (same slot, same value —
-                # idempotent): every distinct length is a distinct XLA
-                # program, and under paced arrivals the override count
-                # varies per dispatch — unpadded, each new length costs
-                # a fresh ~2 s remote compile mid-serve (measured: 6
-                # decode dispatches spent 12 s of wall on this)
-                m = 1 << (len(vals) - 1).bit_length()
-                return vals + [vals[-1]] * (m - len(vals))
-
             for vec, lvec, tidm, tlpm, slots, rows in by_vec.values():
-                sl = jnp.asarray(pad_pow2(slots), jnp.int32)
-                rw = jnp.asarray(pad_pow2(rows), jnp.int32)
+                sl = jnp.asarray(_pad_pow2(slots), jnp.int32)
+                rw = jnp.asarray(_pad_pow2(rows), jnp.int32)
                 toks = toks.at[sl].set(vec[rw])
                 if bld.want_lps:  # each .at[].set is a tunnel dispatch;
                     lps = lps.at[sl].set(lvec[rw])  # skip when unused
@@ -3041,9 +3421,9 @@ class JaxEngine:
                     tid = tid.at[sl].set(tidm[rw])
                     tlp = tlp.at[sl].set(tlpm[rw])
             if ints:
-                sl = jnp.asarray(pad_pow2([s for s, _ in ints]), jnp.int32)
+                sl = jnp.asarray(_pad_pow2([s for s, _ in ints]), jnp.int32)
                 toks = toks.at[sl].set(
-                    jnp.asarray(pad_pow2([v for _, v in ints]), jnp.int32)
+                    jnp.asarray(_pad_pow2([v for _, v in ints]), jnp.int32)
                 )
                 if bld.want_lps:
                     # remotely-sampled first tokens (disagg) have no
@@ -3065,15 +3445,11 @@ class JaxEngine:
             )
         res = fn(
             self.params, self.kv,
-            toks, lps, jnp.asarray(bld.positions), jnp.asarray(bld.tables),
-            jnp.asarray(bld.act), jnp.asarray(bld.temp),
-            jnp.asarray(bld.topk), jnp.asarray(bld.topp),
+            toks, lps, jnp.asarray(bld.pos_act),
+            self._dev_tables[:w], self._dev_samp_f[:w],
+            self._dev_samp_i[:w],
             sub, bld.all_greedy, bld.want_lps,
             counts_in,
-            jnp.asarray(bld.fp) if bld.use_ext else None,
-            jnp.asarray(bld.prp) if bld.use_ext else None,
-            jnp.asarray(bld.rp) if bld.use_ext else None,
-            jnp.asarray(bld.seeds) if bld.use_ext else None,
             jnp.asarray(fresh) if bld.use_ext else None,
             tid if bld.want_tops else None,
             tlp if bld.want_tops else None,
@@ -3103,7 +3479,7 @@ class JaxEngine:
             arr.copy_to_host_async()
         return _Dispatch(S, bld.active, bld.steps)
 
-    async def _sync_dispatch(self, d: _Dispatch) -> None:
+    async def _sync_dispatch(self, d: _Dispatch, overlapped: bool = False) -> None:
         # first-token fetch tasks for sequences in this dispatch must
         # land first: their emission precedes these decode tokens in the
         # output stream
@@ -3113,23 +3489,55 @@ class JaxEngine:
             except Exception:
                 log.exception("first-token emit task failed")
         t_sync0 = time.perf_counter()
-        arrs = await asyncio.to_thread(
-            lambda: tuple(np.asarray(a) for a in d.out_dev)
-        )  # (toks, lps[, top_ids, top_lps]) each [K+1, B(, 8)]
+        if d.mixed:
+            out = d.out_dev
+            arrs = await asyncio.to_thread(
+                lambda: tuple(np.asarray(a) for a in out)
+                if isinstance(out, tuple) else np.asarray(out)
+            )  # sampled [n], or (out [n, k+1], n_emit [n]) with spec rows
+        else:
+            arrs = await asyncio.to_thread(
+                lambda: tuple(np.asarray(a) for a in d.out_dev)
+            )  # (toks, lps[, top_ids, top_lps]) each [K+1, B(, 8)]
         t_sync1 = time.perf_counter()
         with self._phase_lock:
-            # keep the phase families separable: a spec verify step's
-            # fetch wall belongs with its dispatch wall, not in the
-            # scanned-decode sync ratio
-            self._phase_stats[
-                "spec_sync_s" if d.spec else "decode_sync_s"
-            ] += t_sync1 - t_sync0
+            if overlapped:
+                # this fetch wall ran while ANOTHER dispatch was already
+                # queued on device — host wait the step pipeline hid
+                # behind device compute instead of serializing against
+                # it. It lands in the overlap counter INSTEAD of the
+                # family sync counter: `*_sync_s` measures stalls where
+                # the device sat idle behind a host fetch, and a hidden
+                # wall is by definition not one (the bench pipeline_ab
+                # fraction and the engine.overlap trace track both rely
+                # on this split)
+                self._phase_stats["pipeline_overlap_s"] += t_sync1 - t_sync0
+                self._phase_stats["pipeline_overlapped"] += 1
+            else:
+                # keep the phase families separable: a spec verify
+                # step's fetch wall belongs with its dispatch wall, not
+                # in the scanned-decode sync ratio
+                self._phase_stats[
+                    "mixed_sync_s" if d.mixed
+                    else "spec_sync_s" if d.spec else "decode_sync_s"
+                ] += t_sync1 - t_sync0
+            if d.mixed:
+                self._phase_stats["mixed_decode_stall_saved_s"] += (
+                    t_sync1 - d.bld["t0"]
+                )
         if tracing.enabled():
             tracing.complete(
-                "spec_verify.sync" if d.spec else "decode.sync",
-                t_sync0, t_sync1, cat="step", track="engine.sync",
-                rows=len(d.snapshot),
+                "mixed.sync" if d.mixed
+                else "spec_verify.sync" if d.spec else "decode.sync",
+                t_sync0, t_sync1, cat="step",
+                # overlapped syncs land on their own track so the
+                # timeline shows which fetch walls the pipeline hid
+                track="engine.overlap" if overlapped else "engine.sync",
+                rows=len(d.bld["entries"]) if d.mixed else len(d.snapshot),
             )
+        if d.mixed:
+            self._sync_mixed(d.bld, arrs)
+            return
         if d.spec:
             self._sync_spec(d, arrs)
             return
@@ -3170,7 +3578,8 @@ class JaxEngine:
                 )
 
     def _emit_verify_row(self, slot: int, seq: Sequence, out_row,
-                         n: int, drafted: int, base: int) -> tuple:
+                         n: int, drafted: int, base: int,
+                         keep_pos: bool = False) -> tuple:
         """Land ONE verify row (shared by the standalone spec sync and
         the mixed-step spec sync — the rollback invariants must not
         fork): emit the accepted prefix + corrected/bonus token, then
@@ -3179,13 +3588,23 @@ class JaxEngine:
         only past tokens actually emitted, so the garbage KV a rejected
         tail left in its slots stays unregistered and is rewritten by
         the very next dispatch before any query can attend it. Returns
-        (emitted, accepted)."""
+        (emitted, accepted).
+
+        `keep_pos`: a PIPELINED mixed step's dlen=0 (shed carry) row
+        advanced `device_pos` deterministically at build time, and a
+        NEXT pipelined build may have advanced it again before this
+        sync runs — the absolute rewind here would clobber that later
+        advance (the q_len=1 row has nothing to rewind: its one token
+        always lands). Rows with real drafts advance data-dependently,
+        are never carried into a following build, and keep the
+        rewind."""
         emitted = 0
         for j in range(n):
             if self.slots[slot] is not seq:
                 break  # EOS/length mid-window: the tail is discarded
             seq.num_computed += 1
-            seq.device_pos = base + j + 1
+            if not keep_pos:
+                seq.device_pos = base + j + 1
             self._register_full_pages(seq)
             self._append_token(seq, int(out_row[j]))
             emitted += 1
@@ -3227,10 +3646,12 @@ class JaxEngine:
             self._phase_stats["spec_emitted"] += emitted_total
 
     def _ensure_pages_through(self, seq: Sequence, upto_pos: int) -> bool:
+        grew = False
         while upto_pos // self.page_size >= len(seq.page_ids):
             got = self.allocator.allocate(1)
             if got is not None:
                 seq.page_ids.extend(got)
+                grew = True
                 continue
             victim = max(
                 (s for s in self.slots if s is not None), key=lambda s: s.seq_id
@@ -3238,6 +3659,10 @@ class JaxEngine:
             self._preempt(victim)
             if victim is seq:
                 return False
+        if grew:
+            # page growth is one of the two events (with admit) that
+            # change a live slot's device-resident block-table row
+            self._mark_slot_state(seq)
         return True
 
     def _preempt(self, seq: Sequence) -> None:
@@ -3246,6 +3671,10 @@ class JaxEngine:
         self.allocator.release(seq.page_ids)
         self.slots[seq.slot] = None
         self._overrides.pop(seq.slot, None)
+        # the slot may be reused: a preempted row mid-pipeline must not
+        # leave a "valid carry" claim behind (re-admission re-arms via
+        # the prefill override — the carry-staleness contract)
+        self._carry_ok[seq.slot] = False
         if seq in self._prefilling:
             self._prefilling.remove(seq)
         seq.slot = -1
@@ -3278,16 +3707,21 @@ class JaxEngine:
         seq.registered_pages = full
 
     def peek_prefix_tokens(
-        self, token_ids: list[int], max_tokens: Optional[int] = None
+        self, token_ids: list[int], max_tokens: Optional[int] = None,
+        hashes: Optional[list[int]] = None,
     ) -> int:
         """Non-destructive cached-prefix length across BOTH tiers (HBM,
         then host continuation) — the disagg/router decision input must
         agree with what _reserve_pages would actually reuse. For embed
         requests pass `max_tokens=embeds_offset`: reservation only
-        matches the text prefix below the image span."""
-        from dynamo_tpu.llm.tokens import compute_block_hashes
+        matches the text prefix below the image span. Pass `hashes`
+        (the prompt's chained block hashes) when the caller computed
+        them already — the disagg path hashes once per request and
+        threads the list through here AND admission."""
+        if hashes is None:
+            from dynamo_tpu.llm.tokens import compute_block_hashes
 
-        hashes = compute_block_hashes(token_ids, self.page_size)
+            hashes = compute_block_hashes(token_ids, self.page_size)
         if max_tokens is not None:
             hashes = hashes[: max_tokens // self.page_size]
         n = 0
@@ -3529,6 +3963,7 @@ class JaxEngine:
         self.allocator.release(seq.page_ids)
         if seq.slot >= 0:
             self._overrides.pop(seq.slot, None)
+            self._carry_ok[seq.slot] = False
             self.slots[seq.slot] = None
             seq.slot = -1
         if seq in self._prefilling:

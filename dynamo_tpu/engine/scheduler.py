@@ -125,12 +125,23 @@ class Sequence:
 
     @classmethod
     def from_request(
-        cls, ctx: Context, pre: PreprocessedRequest, page_size: int, max_model_len: int
+        cls, ctx: Context, pre: PreprocessedRequest, page_size: int,
+        max_model_len: int, blocks: Optional[TokenBlockSequence] = None,
     ) -> "Sequence":
+        if blocks is not None and (
+            blocks.block_size != page_size
+            or blocks.total_tokens != len(pre.token_ids)
+        ):
+            # a stale or mismatched precompute silently corrupts the
+            # prefix cache (wrong chained hashes) — recompute instead
+            blocks = None
         seq = cls(
             ctx=ctx,
             pre=pre,
-            blocks=TokenBlockSequence(pre.token_ids, page_size),
+            # the disagg decision path hashes the prompt once and threads
+            # the TokenBlockSequence through generate(); local requests
+            # hash here
+            blocks=blocks or TokenBlockSequence(pre.token_ids, page_size),
             prompt_len=len(pre.token_ids),
         )
         so = pre.sampling_options

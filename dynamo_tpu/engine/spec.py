@@ -126,6 +126,26 @@ class NgramProposer:
                 return h[cont - base:cont - base + k]
         return []
 
+    def gate_open(self) -> bool:
+        """Would `maybe_draft` consult the index right now (acceptance
+        EMA above the gate, or the probe countdown expired)? Side-effect
+        free — the step pipeline asks this to decide whether syncing the
+        in-flight dispatch (so host history catches up and this stream
+        can draft) is worth giving up one dispatch overlap."""
+        return self.ema >= GATE_THRESHOLD or self._cooldown <= 0
+
+    def shed_tick(self) -> None:
+        """A pipelined carry row shed its draft this step (stale host
+        history forbids proposing). Tick the probe countdown exactly
+        like a gated `maybe_draft` would have — without this, sustained
+        pipelined mixed flow never decrements it and a gated-off stream
+        stays gated off for the whole flow (the stranding RETRY_EVERY
+        exists to prevent). Once it reaches zero `gate_open` flips, and
+        the next mixed tick takes the sync-first escape to probe from
+        fresh history."""
+        if self._cooldown > 0:
+            self._cooldown -= 1
+
     def maybe_draft(self, k: int) -> list[int]:
         """Gated proposal: empty while the acceptance EMA is below the
         gate, except a periodic probe. Once the countdown expires the

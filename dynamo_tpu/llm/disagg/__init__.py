@@ -318,16 +318,28 @@ class DisaggDecodeWorker:
             else payload
         )
         decision = False
+        blocks = None
         if not pre.disagg.get("force_local"):
             # engine-level peek covers the host offload tier too (a
             # host-restorable prefix must not look uncached here); embed
             # requests can only ever reuse the text prefix below the image
             peek = getattr(self.engine, "peek_prefix_tokens", None)
             if peek is not None:
+                # hash the prompt ONCE per request: the same chained
+                # block hashes feed this decision AND admission (the
+                # TokenBlockSequence threads through generate below)
+                from dynamo_tpu.llm.tokens import TokenBlockSequence
+
+                blocks = TokenBlockSequence(
+                    pre.token_ids, self.engine.page_size
+                )
                 cap = (
                     pre.embeds_offset if pre.prompt_embeds is not None else None
                 )
-                prefix_hit = peek(pre.token_ids, max_tokens=cap)
+                prefix_hit = peek(
+                    pre.token_ids, max_tokens=cap,
+                    hashes=blocks.sequence_hashes(),
+                )
             else:
                 prefix_hit = self.engine.allocator.peek_prefix_tokens(
                     pre.token_ids
@@ -344,11 +356,13 @@ class DisaggDecodeWorker:
                 )
         if not decision:
             self.local_prefills += 1
-            return await self.engine.generate(request.map(pre.to_dict()))
-        return await self._generate_remote(request, pre)
+            return await self.engine.generate(
+                request.map(pre.to_dict()), _blocks=blocks
+            )
+        return await self._generate_remote(request, pre, blocks=blocks)
 
     async def _generate_remote(
-        self, request: Context, pre: PreprocessedRequest
+        self, request: Context, pre: PreprocessedRequest, blocks=None
     ) -> AsyncIterator[dict]:
         self.remote_prefills += 1
         rid = f"{request.id}-{uuid.uuid4().hex[:8]}"
@@ -365,7 +379,9 @@ class DisaggDecodeWorker:
         except asyncio.TimeoutError:
             self._pending.pop(rid, None)
             log.warning("remote prefill %s timed out; falling back local", rid)
-            return await self.engine.generate(request.map(pre.to_dict()))
+            return await self.engine.generate(
+                request.map(pre.to_dict()), _blocks=blocks
+            )
         finally:
             self._pending.pop(rid, None)
         k = np.concatenate([pending.parts[i][0] for i in range(pending.total)])
@@ -379,7 +395,8 @@ class DisaggDecodeWorker:
                 [pending.parts[i][3] for i in range(pending.total)]
             )
         return await self.engine.generate_remote(
-            request.map(pre.to_dict()), pending.first_token, k, v, ks, vs
+            request.map(pre.to_dict()), pending.first_token, k, v, ks, vs,
+            _blocks=blocks,
         )
 
     def stats(self) -> dict[str, Any]:

@@ -273,3 +273,47 @@ async def test_concurrent_prefill_only_and_serving():
     assert toks_b[0] == first_b
     assert (frames_b[0].get("meta") or {}).get("prefix_cached_tokens", 0) > 0
     await engine.close()
+
+
+async def test_peek_prefix_hashes_computed_once_and_threaded():
+    """The disagg decision used to hash the full prompt on every peek
+    and the serve path hashed it AGAIN at admission. The hash list now
+    computes once per request and threads through both call sites:
+    peek(hashes=...) must agree with the recompute path, and a
+    precomputed TokenBlockSequence passed to generate(_blocks=...) must
+    serve identically (admission reuses it instead of rehashing)."""
+    from dynamo_tpu.llm.tokens import TokenBlockSequence, compute_block_hashes
+
+    engine = make_engine()
+    prompt = list(range(40, 72))
+    ref, _ = await collect(
+        await engine.generate(Context(greedy(prompt, 6).to_dict()))
+    )
+    hashes = compute_block_hashes(prompt, engine.page_size)
+    # engine-level peek (both KV tiers) and allocator-level peek agree
+    # between the recompute path and the precomputed-hash path
+    assert engine.peek_prefix_tokens(prompt) == engine.peek_prefix_tokens(
+        prompt, hashes=hashes
+    ) > 0
+    assert engine.allocator.peek_prefix_tokens(
+        prompt
+    ) == engine.allocator.peek_prefix_tokens(hashes=hashes) > 0
+    # threading the precomputed blocks through generate() changes
+    # nothing observable (and rides the same prefix cache)
+    blocks = TokenBlockSequence(prompt, engine.page_size)
+    got, frames = await collect(
+        await engine.generate(
+            Context(greedy(prompt, 6).to_dict()), _blocks=blocks
+        )
+    )
+    assert got == ref
+    assert (frames[0].get("meta") or {}).get("prefix_cached_tokens", 0) > 0
+    # a mismatched precompute (wrong block size) is rejected, not used
+    bad = TokenBlockSequence(prompt, engine.page_size * 2)
+    got2, _ = await collect(
+        await engine.generate(
+            Context(greedy(prompt, 6).to_dict()), _blocks=bad
+        )
+    )
+    assert got2 == ref
+    await engine.close()

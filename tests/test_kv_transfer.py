@@ -58,6 +58,10 @@ async def _decode_with_preloaded_kv(engine, prompt, first_token, page_ids, n_kv)
     seq.prefilling = False
     seq.device_pos = n_kv
     engine.slots[slot] = seq
+    # mirror _admit's device-state contract: block tables and sampling
+    # params are device-resident now, and this helper bypasses admission
+    # — without the scatter the slot's table row is all trash-page zeros
+    engine._mark_slot_state(seq)
     engine._overrides[slot] = int(first_token)
     seq.carry_pending = True
     # mark pages as held so the allocator bookkeeping stays sane
