@@ -132,6 +132,31 @@ class EngineConfig:
     # sensitive deployments keep it well under their TTFT budget.
     prefill_batch_window_s: float = 0.0
     prefill_batch_min_rows: int = 8
+    # ---- fault-tolerance spine (docs/robustness.md) ----
+    # default end-to-end deadline per request, seconds (0 = none). A
+    # request-level `x-request-timeout` header overrides it. Expired
+    # requests are shed from the admission queue (429 before any device
+    # work) or cancelled mid-flight via the cancellation sweep with
+    # finish_reason="timeout".
+    request_timeout_s: float = 0.0
+    # prefill-worker page-wait budget (was a hardcoded 60 s): how long
+    # `prefill_only` waits for KV pages before surfacing a typed
+    # PoolExhaustedError (HTTP 503). A request deadline shrinks the
+    # effective wait further — the wait always fits the caller's budget.
+    prefill_wait_s: float = 60.0
+    # engine watchdog: a dispatch or result fetch that has not completed
+    # within this many seconds trips the degrade ladder and dumps a
+    # crash artifact (trace ring + phase stats). 0 disables. Set it well
+    # above the slowest expected jit COMPILE on the deployment — the
+    # watchdog cannot tell a hung dispatch from a 40 s TPU compile.
+    watchdog_dispatch_s: float = 0.0
+    # seconds a watchdog-tripped degrade rung stays shed before
+    # re-probing (engine/degrade.py); permanent trips (failed dispatch
+    # families) never re-probe.
+    degrade_reprobe_s: float = 30.0
+    # crash-artifact directory for watchdog dumps (trace ring + phase
+    # stats JSON); None = DYN_CRASH_DIR env or /tmp.
+    crash_dir: Optional[str] = None
     seed: int = 0
 
     def model_config(self) -> ModelConfig:

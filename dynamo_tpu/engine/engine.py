@@ -45,7 +45,11 @@ admission and preemption-resume are the same code path.
 from __future__ import annotations
 
 import asyncio
+import itertools
+import json
 import logging
+import os
+import tempfile
 import threading
 import time
 from collections import deque
@@ -58,12 +62,16 @@ import numpy as np
 from dynamo_tpu import compat
 from dynamo_tpu.engine.allocator import PageAllocator
 from dynamo_tpu.engine.config import EngineConfig
+from dynamo_tpu.engine.degrade import DegradeLadder
 from dynamo_tpu.engine.scheduler import Sequence
 from dynamo_tpu.llm.protocols.common import (
     FINISH_REASON_CANCELLED,
     FINISH_REASON_ERROR,
     FINISH_REASON_LENGTH,
+    FINISH_REASON_TIMEOUT,
+    DeadlineExceededError,
     EngineOutput,
+    PoolExhaustedError,
     PreprocessedRequest,
 )
 from dynamo_tpu.models import llama
@@ -76,7 +84,7 @@ from dynamo_tpu.ops.sampling import (
 )
 from dynamo_tpu.parallel import mesh as meshmod
 from dynamo_tpu.runtime.pipeline.context import Context
-from dynamo_tpu.utils import tracing
+from dynamo_tpu.utils import faults, tracing
 
 log = logging.getLogger("dynamo_tpu.engine")
 
@@ -592,10 +600,37 @@ class JaxEngine:
             # 0/1: mixed dispatch failed and the engine degraded to the
             # contained normal paths (see _mixed_disabled)
             "mixed_disabled": 0,
+            # fault-tolerance spine (docs/robustness.md): watchdog
+            # firings (a dispatch/fetch stalled past watchdog_dispatch_s
+            # and tripped a degrade rung), requests shed past-deadline
+            # BEFORE any device work (429), and mid-flight deadline
+            # expirations resolved by the cancellation sweep (timeout)
+            "watchdog_fired": 0,
+            "deadline_shed": 0,
+            "deadline_timeouts": 0,
         }
         # updates run in worker threads outside _kv_lock (serving prefill
         # + concurrent prefill_only dispatches) — guard the RMWs
         self._phase_lock = threading.Lock()
+
+        # ---- fault-tolerance spine (docs/robustness.md) ----
+        faults.load_env()  # arm DYN_FAULTS points (no-op when unset)
+        # degrade ladder: ordered feature shedding with re-probe
+        # recovery, generalizing the one-way mixed_disabled trip
+        self._degrade = DegradeLadder(reprobe_s=config.degrade_reprobe_s)
+        # watchdog: in-flight device-critical ops (dispatch calls and
+        # result fetches) register here as {token: (label, t_start)};
+        # the monitor task trips the ladder + dumps a crash artifact
+        # when one stalls past _watchdog_s. Mutated from worker threads
+        # under the GIL (token allocation via itertools.count is atomic).
+        self._watchdog_s = float(config.watchdog_dispatch_s or 0.0)
+        self._ops: dict[int, tuple[str, float]] = {}
+        self._op_ids = itertools.count(1)
+        self._watch_fired: set[int] = set()
+        self._watchdog_task: Optional[asyncio.Task] = None
+        self.last_crash_artifact: Optional[str] = None
+        # deadline sweep runs only when some live request carries one
+        self._has_deadlines = False
 
         # slot-matrix width: whole context in token slots (gather prefill)
         self._smat_width = config.max_pages_per_seq * config.page_size
@@ -872,13 +907,26 @@ class JaxEngine:
             # 1 when a failed mixed dispatch tripped the permanent
             # degrade to the contained normal paths — the one log line
             # is easy to miss, the /metrics scrape is not
-            "mixed_disabled": 1 if self._mixed_disabled else 0,
+            "mixed_disabled": 1 if (
+                self._mixed_disabled or self._degrade.tripped("mixed")
+            ) else 0,
             # step-pipeline health (EngineConfig.step_pipeline): syncs
             # whose fetch wall overlapped an already-queued dispatch,
             # and the wall they hid
             "pipeline_overlapped": ps["pipeline_overlapped"],
             "pipeline_overlap_s": round(ps["pipeline_overlap_s"], 4),
             "mixed_carry_rows": ps["mixed_carry_rows"],
+            # fault-tolerance spine (docs/robustness.md): per-rung
+            # degrade state (degraded_step_pipeline/.../_decode_scan),
+            # ladder transition totals, watchdog firings, deadline
+            # sheds/timeouts, and faults injected this process
+            **self._degrade.state(),
+            "degrades_total": self._degrade.degrades_total,
+            "recoveries_total": self._degrade.recoveries_total,
+            "watchdog_fired": ps["watchdog_fired"],
+            "deadline_shed": ps["deadline_shed"],
+            "deadline_timeouts": ps["deadline_timeouts"],
+            "faults_injected": faults.fired_total() if faults.active() else 0,
         }
 
     # ------------------------------------------------------------------
@@ -1388,6 +1436,21 @@ class JaxEngine:
             request, pre, self.page_size, self.config.max_model_len,
             blocks=_blocks,
         )
+        if not seq.deadline and self.config.request_timeout_s > 0:
+            # deployment default budget; a request-level x-request-timeout
+            # (ridden in via Context metadata) takes precedence
+            seq.deadline = time.time() + self.config.request_timeout_s
+        if seq.deadline:
+            self._has_deadlines = True
+            if seq.past_deadline():
+                # shed BEFORE any device work: the caller's budget is
+                # already gone, burning prefill on it helps nobody
+                with self._phase_lock:
+                    self._phase_stats["deadline_shed"] += 1
+                raise DeadlineExceededError(
+                    "request deadline expired before admission "
+                    f"(deadline={seq.deadline:.3f})"
+                )
         seq.t_submit = time.perf_counter()
         if tracing.enabled():
             tracing.instant(
@@ -1478,10 +1541,20 @@ class JaxEngine:
         seq = Sequence.from_request(
             ctx, pre, self.page_size, self.config.max_model_len
         )
-        deadline = asyncio.get_running_loop().time() + 60.0
+        # page-wait budget: the (previously hardcoded 60 s) config knob,
+        # shrunk to whatever remains of the request's own deadline — the
+        # wait must always fit the caller's end-to-end budget
+        wait_s = float(self.config.prefill_wait_s)
+        if seq.deadline:
+            wait_s = min(wait_s, max(seq.deadline - time.time(), 0.0))
+        deadline = asyncio.get_running_loop().time() + wait_s
         while not self._reserve_pages(seq):
             if asyncio.get_running_loop().time() > deadline:
-                raise RuntimeError("prefill worker out of KV pages")
+                # typed: a capacity condition the HTTP layer maps to 503
+                # + Retry-After, never a 5xx "server bug"
+                raise PoolExhaustedError(
+                    f"prefill worker out of KV pages after {wait_s:.1f}s"
+                )
             await asyncio.sleep(0.05)
         try:
             first_token = await self._prefill_forward(seq)
@@ -1594,13 +1667,184 @@ class JaxEngine:
             nks = nvs = None
         return nk, nv, nks, nvs
 
+    # ------------------------------------------------------------------
+    # fault-tolerance spine: feature gates, watchdog, deadlines
+    # (docs/robustness.md)
+
+    def _pipe_on(self) -> bool:
+        """Step pipeline effective flag: config AND the degrade ladder.
+        ONE predicate for every read site so a watchdog trip serializes
+        all of them at once."""
+        return self.config.step_pipeline and not self._degrade.disabled(
+            "step_pipeline"
+        )
+
+    def _spec_on(self) -> bool:
+        return self.config.spec_decode and not self._degrade.disabled("spec")
+
+    def _op_begin(self, label: str) -> Optional[int]:
+        """Register a device-critical op (dispatch call or result fetch)
+        with the watchdog; returns a token for `_op_end`. No-op (None)
+        when the watchdog is off — zero steady-state cost."""
+        if not self._watchdog_s:
+            return None
+        tok = next(self._op_ids)
+        self._ops[tok] = (label, time.perf_counter())
+        return tok
+
+    def _op_end(self, tok: Optional[int]) -> None:
+        if tok is not None:
+            self._ops.pop(tok, None)
+
+    def _ensure_watchdog(self) -> None:
+        if self._watchdog_s <= 0:
+            return
+        if self._watchdog_task is None or self._watchdog_task.done():
+            self._watchdog_task = asyncio.get_running_loop().create_task(
+                self._watchdog_loop()
+            )
+
+    async def _watchdog_loop(self) -> None:
+        """Monitor task: notice a dispatch/fetch that has stalled past
+        `watchdog_dispatch_s`, dump the trace ring + phase stats to a
+        crash artifact, and walk the degrade ladder. The hung op itself
+        cannot be killed (a wedged jit call holds the GIL-released device
+        tunnel) — the job here is to make the hang VISIBLE and to shed
+        the most speculative machinery so the next dispatch, if the
+        fault was transient, runs the conservative path."""
+        interval = min(max(self._watchdog_s / 4.0, 0.05), 1.0)
+        try:
+            while not self._closed:
+                await asyncio.sleep(interval)
+                if not self._ops:
+                    # fired-token set tracks only live ops
+                    self._watch_fired.clear()
+                    continue
+                now = time.perf_counter()
+                for tok, (label, t0) in list(self._ops.items()):
+                    stalled = now - t0
+                    if stalled <= self._watchdog_s or tok in self._watch_fired:
+                        continue
+                    self._watch_fired.add(tok)
+                    self._watchdog_fire(label, stalled)
+                self._watch_fired.intersection_update(self._ops)
+        except asyncio.CancelledError:
+            return
+
+    def _watchdog_fire(self, label: str, stalled_s: float) -> None:
+        with self._phase_lock:
+            self._phase_stats["watchdog_fired"] += 1
+        reason = f"watchdog: {label} stalled {stalled_s:.2f}s"
+        rung = self._degrade.trip_next(reason)
+        path = self._dump_crash_artifact(label, stalled_s, rung)
+        log.error(
+            "engine watchdog fired: %s has not completed after %.2fs "
+            "(budget %.2fs); degrade rung tripped: %s; crash artifact: %s",
+            label, stalled_s, self._watchdog_s, rung or "none left", path,
+        )
+        if tracing.enabled():
+            tracing.instant(
+                "watchdog.fire", cat="degrade", op=label,
+                stalled_s=round(stalled_s, 3), rung=rung or "",
+            )
+
+    def _dump_crash_artifact(
+        self, label: str, stalled_s: float, rung: Optional[str]
+    ) -> Optional[str]:
+        """Write the PR-4 trace ring + phase stats + metrics snapshot
+        next to the hang, so the postmortem does not depend on the
+        process surviving to serve /debug/trace. Best-effort: artifact
+        IO must never take the watchdog down."""
+        try:
+            crash_dir = (
+                self.config.crash_dir
+                or os.environ.get("DYN_CRASH_DIR")
+                or tempfile.gettempdir()
+            )
+            os.makedirs(crash_dir, exist_ok=True)
+            path = os.path.join(
+                crash_dir, f"engine_watchdog_{int(time.time() * 1000)}.json"
+            )
+            artifact = {
+                "op": label,
+                "stalled_s": round(stalled_s, 3),
+                "watchdog_dispatch_s": self._watchdog_s,
+                "rung_tripped": rung,
+                "degrade_state": self._degrade.state(),
+                "phase_stats": self.phase_stats,
+                "metrics": self.metrics(),
+                "inflight_ops": [
+                    {"op": lbl, "age_s": round(time.perf_counter() - t0, 3)}
+                    for lbl, t0 in self._ops.values()
+                ],
+                "trace": tracing.export(),
+            }
+            with open(path, "w") as f:
+                json.dump(artifact, f)
+            self.last_crash_artifact = path
+            return path
+        except Exception:  # noqa: BLE001 — the dump is best-effort
+            log.exception("watchdog crash-artifact dump failed")
+            return None
+
+    def _shed_expired_waiting(self) -> bool:
+        """Reject admission-queue requests whose deadline has passed —
+        BEFORE they touch the device. They resolve with a zero-token
+        `timeout` finish (the HTTP layer turns that into 429 +
+        Retry-After when the response has not started streaming)."""
+        if not self._has_deadlines or not self.waiting:
+            return False
+        now = time.time()
+        expired = [s for s in self.waiting if s.past_deadline(now)]
+        for seq in expired:
+            self.waiting.remove(seq)
+            with self._phase_lock:
+                self._phase_stats["deadline_shed"] += 1
+            if tracing.enabled():
+                # t_submit is a perf_counter stamp — subtract in the
+                # same clock domain (`now` above is epoch time.time())
+                tracing.instant(
+                    "seq.deadline_shed", cat="lifecycle", req=seq.ctx.id,
+                    queued_s=(
+                        round(time.perf_counter() - seq.t_submit, 3)
+                        if seq.t_submit else 0
+                    ),
+                )
+            self._note_finished(seq, FINISH_REASON_TIMEOUT)
+            seq.out_queue.put_nowait(
+                EngineOutput.final(FINISH_REASON_TIMEOUT).to_dict()
+            )
+        return bool(expired)
+
+    def _sweep_expired(self, seq: Sequence, now: float) -> bool:
+        """Mid-flight deadline check (cancellation-sweep companion):
+        finish an admitted sequence whose budget ran out."""
+        if not seq.past_deadline(now):
+            return False
+        with self._phase_lock:
+            self._phase_stats["deadline_timeouts"] += 1
+        if tracing.enabled():
+            tracing.instant(
+                "seq.deadline_timeout", cat="lifecycle", req=seq.ctx.id,
+                generated=seq.generated,
+            )
+        self._finish(seq, FINISH_REASON_TIMEOUT)
+        return True
+
     def _ensure_loop(self) -> None:
         if self._loop_task is None or self._loop_task.done():
             self._loop_task = asyncio.get_running_loop().create_task(self._loop())
+        self._ensure_watchdog()
 
     async def close(self) -> None:
         self._closed = True
         self._wake.set()
+        if self._watchdog_task is not None and not self._watchdog_task.done():
+            self._watchdog_task.cancel()
+            try:
+                await self._watchdog_task
+            except asyncio.CancelledError:
+                pass
         if self._loop_task:
             try:
                 await self._loop_task
@@ -1631,7 +1875,10 @@ class JaxEngine:
                 # offload first: pending write-through copies must pin
                 # their pages before this tick's admission can evict them
                 self._maybe_start_offload()
-                progressed = self._admit_new()
+                # deadline shed: queue members whose budget expired leave
+                # with 429/timeout before they can claim a slot or pages
+                progressed = self._shed_expired_waiting()
+                progressed |= self._admit_new()
                 # stall-free mixed step first: when decode-ready rows
                 # and pending prefill chunks coexist, ONE token-budgeted
                 # dispatch advances both planes and the normal
@@ -1656,7 +1903,7 @@ class JaxEngine:
                 # the loop serializes at ~2x device time per dispatch
                 if mixed is None:
                     progressed |= await self._prefill_tick()
-                pipe = self.config.step_pipeline
+                pipe = self._pipe_on()
                 if not pipe and mixed != "pipelined":
                     # serialized A/B baseline: dispatch -> fetch -> sync,
                     # nothing overlaps — the old dispatch lands BEFORE
@@ -1872,6 +2119,13 @@ class JaxEngine:
     def _reserve_pages(self, seq: Sequence) -> bool:
         """Prefix-match (HBM, then host tier) and allocate pages covering
         all current tokens; host-tier hits are restored by H2D scatter."""
+        try:
+            # chaos hook: an injected 'fail' here simulates KV-pool
+            # exhaustion — callers see the same False the real allocator
+            # returns when out of pages (docs/robustness.md)
+            faults.fire("engine.reserve")
+        except faults.FaultError:
+            return False
         t = seq.total_tokens
         hashes = seq.blocks.sequence_hashes()
         cap = seq.cacheable_pages(self.page_size)
@@ -1985,6 +2239,11 @@ class JaxEngine:
                 self._finish(seq, FINISH_REASON_CANCELLED)
                 progressed = True
                 continue
+            if self._has_deadlines and self._sweep_expired(seq, time.time()):
+                # deadline expired mid-prefill: resolve before burning
+                # the remaining chunks
+                progressed = True
+                continue
             if seq.preloaded is not None:
                 try:
                     tok = self._inject_chunk(seq)
@@ -2027,9 +2286,13 @@ class JaxEngine:
                 # wave, parking every pending first-token emission (and
                 # the stream consumers) until the LAST group dispatched.
                 # _kv_lock serializes the donated cache underneath.
-                toks = await asyncio.to_thread(
-                    self._prefill_group_dispatch, seqs, bucket
-                )
+                wd = self._op_begin("prefill.dispatch")
+                try:
+                    toks = await asyncio.to_thread(
+                        self._prefill_group_dispatch, seqs, bucket
+                    )
+                finally:
+                    self._op_end(wd)
                 self._note_prefilled(seqs, bucket)
             except Exception:
                 log.exception(
@@ -2209,6 +2472,7 @@ class JaxEngine:
         chunk was final). n is padded to a power of two so the set of
         compiled graphs stays bounded (padding rows write the trash
         page)."""
+        faults.fire("engine.prefill")
         n = 1 << (len(seqs) - 1).bit_length()
         smat = np.zeros((n, self._smat_width), np.int32)
         tok_arr = np.zeros((n, bucket), np.int32)
@@ -2580,7 +2844,10 @@ class JaxEngine:
         the in-flight dispatch must sync first — host-built windows
         need current token history), or None (not applicable: normal
         paths run)."""
-        if self._closed or self._mixed_disabled or not self._prefilling:
+        if (
+            self._closed or self._mixed_disabled
+            or self._degrade.disabled("mixed") or not self._prefilling
+        ):
             return None
         why = self._mixed_unsupported_reason()
         if why is not None:
@@ -2588,7 +2855,7 @@ class JaxEngine:
                 self._mixed_warned = True
                 log.warning("mixed_batching disabled: %s", why)
             return None
-        pipeline = self.config.step_pipeline
+        pipeline = self._pipe_on()
         # classify the in-flight dispatch's rows: deterministic advances
         # can pipeline through the device carry, data-dependent ones
         # (verify windows) block until their sync
@@ -2622,7 +2889,7 @@ class JaxEngine:
             return None
         carry_rows = {i for i, s in rows if stale_det.get(i) is s}
         if (
-            carry_rows and self.config.spec_decode and self.config.mixed_spec
+            carry_rows and self._spec_on() and self.config.mixed_spec
             and any(
                 s.spec is not None and s.spec.gate_open()
                 for i, s in rows if i in carry_rows
@@ -2653,7 +2920,7 @@ class JaxEngine:
         # proposer would continue the wrong suffix — shed, don't stall.
         drafts: dict[int, list[int]] = {}
         shed = 0
-        if self.config.spec_decode and self.config.mixed_spec:
+        if self._spec_on() and self.config.mixed_spec:
             k_cap = min(self.config.spec_k_max, self.config.prefill_chunk - 1)
             for i, seq in rows:
                 if i in carry_rows:
@@ -2827,6 +3094,10 @@ class JaxEngine:
             # the next normal dispatch flushes it
             self._dirty_slots.update(int(i) for i in bld["dirty"][0])
         self._mixed_disabled = True
+        # mirror into the degrade ladder (permanent: a FAILED dispatch
+        # family must not re-probe — retrying it every tick would wedge
+        # the loop; contrast the watchdog's transient stall trips)
+        self._degrade.trip("mixed", "mixed dispatch failed", permanent=True)
         with self._phase_lock:
             self._phase_stats["mixed_disabled"] = 1
 
@@ -2956,22 +3227,27 @@ class JaxEngine:
         arrays, and threads the donated carry vector through the step
         (the in-jit decode-row scatter that makes pipelined builds
         host-round-trip-free)."""
+        faults.fire("engine.mixed")
         t0 = time.perf_counter()
-        with self._kv_lock:
-            self._flush_dev_state_locked(bld["dirty"])
-            self._key, sub = jax.random.split(self._key)
-            S, self.kv, self._carry_toks = self._mixed_fn(
-                self.params, self.kv,
-                jnp.asarray(bld["hot"]), jnp.asarray(bld["meta"]),
-                self._dev_samp_f, self._dev_samp_i, self._dev_tables,
-                self._carry_toks, sub,
-                jnp.asarray(bld["draft"]) if bld["spec"] else None,
-                jnp.asarray(bld["dlen"]) if bld["spec"] else None,
-                bld["all_greedy"], bld["w_b"],
-            )
-        self._step_count += 1
-        for arr in (S if isinstance(S, tuple) else (S,)):
-            arr.copy_to_host_async()
+        wd = self._op_begin("mixed.dispatch")
+        try:
+            with self._kv_lock:
+                self._flush_dev_state_locked(bld["dirty"])
+                self._key, sub = jax.random.split(self._key)
+                S, self.kv, self._carry_toks = self._mixed_fn(
+                    self.params, self.kv,
+                    jnp.asarray(bld["hot"]), jnp.asarray(bld["meta"]),
+                    self._dev_samp_f, self._dev_samp_i, self._dev_tables,
+                    self._carry_toks, sub,
+                    jnp.asarray(bld["draft"]) if bld["spec"] else None,
+                    jnp.asarray(bld["dlen"]) if bld["spec"] else None,
+                    bld["all_greedy"], bld["w_b"],
+                )
+            self._step_count += 1
+            for arr in (S if isinstance(S, tuple) else (S,)):
+                arr.copy_to_host_async()
+        finally:
+            self._op_end(wd)
         t1 = time.perf_counter()
         with self._phase_lock:
             self._phase_stats["mixed_dispatch_s"] += t1 - t0
@@ -3095,9 +3371,12 @@ class JaxEngine:
             for i, s in enumerate(self.slots)
             if s is not None and not s.prefilling
         ]
+        now = time.time() if self._has_deadlines else 0.0
         for i, s in ready:
             if s.ctx.is_stopped():
                 self._finish(s, FINISH_REASON_CANCELLED)
+            elif now and self._sweep_expired(s, now):
+                pass  # finished with FINISH_REASON_TIMEOUT
         return [(i, s) for i, s in ready if self.slots[i] is s]
 
     def _maybe_dispatch_decode(self) -> Optional["_DecodeBuild"]:
@@ -3135,7 +3414,7 @@ class JaxEngine:
             # checks — runtime toggles must not let a normal dispatch
             # launch from stale host state.
             return None
-        if self.config.spec_decode:
+        if self._spec_on():
             bld = self._maybe_build_spec(ready)
             if bld == "wait":
                 # worthwhile drafts exist but a normal dispatch is in
@@ -3143,7 +3422,7 @@ class JaxEngine:
                 # build in the SAME tick ("sync_first", see _loop);
                 # serialized engines hold the build a tick so the sync
                 # lands first
-                return "sync_first" if self.config.step_pipeline else None
+                return "sync_first" if self._pipe_on() else None
             if bld is not None:
                 return bld
 
@@ -3153,7 +3432,13 @@ class JaxEngine:
         # low-packed (admission takes the first free slot), so the
         # power-of-two prefix covering the highest active slot bounds
         # compiled families to ~log2(max_batch/8)
-        k_steps = self.config.decode_steps
+        # last degrade rung ("serialized decode"): drop the multi-step
+        # scan to ONE step per dispatch — maximally conservative, still
+        # makes progress, and every host sync re-validates state
+        k_steps = (
+            1 if self._degrade.disabled("decode_scan")
+            else self.config.decode_steps
+        )
         # ensure every ready sequence has pages for all positions this
         # dispatch will write: [device_pos, device_pos + k_steps)
         prep = self._grow_and_collect(
@@ -3321,12 +3606,17 @@ class JaxEngine:
         """The jax half of a decode dispatch — runs in a worker thread
         under _kv_lock (the loop awaits it before its own next kv use,
         but the public prefill_only path can dispatch concurrently)."""
+        faults.fire("engine.dispatch")
         t0 = time.perf_counter()
-        with self._kv_lock:
-            if bld.spec:
-                out = self._run_spec_dispatch_locked(bld)
-            else:
-                out = self._run_decode_dispatch_locked(bld)
+        wd = self._op_begin("spec.dispatch" if bld.spec else "decode.dispatch")
+        try:
+            with self._kv_lock:
+                if bld.spec:
+                    out = self._run_spec_dispatch_locked(bld)
+                else:
+                    out = self._run_decode_dispatch_locked(bld)
+        finally:
+            self._op_end(wd)
         t1 = time.perf_counter()
         rows = len(bld.active)
         if bld.spec:
@@ -3489,16 +3779,20 @@ class JaxEngine:
             except Exception:
                 log.exception("first-token emit task failed")
         t_sync0 = time.perf_counter()
-        if d.mixed:
-            out = d.out_dev
-            arrs = await asyncio.to_thread(
-                lambda: tuple(np.asarray(a) for a in out)
-                if isinstance(out, tuple) else np.asarray(out)
-            )  # sampled [n], or (out [n, k+1], n_emit [n]) with spec rows
-        else:
-            arrs = await asyncio.to_thread(
-                lambda: tuple(np.asarray(a) for a in d.out_dev)
-            )  # (toks, lps[, top_ids, top_lps]) each [K+1, B(, 8)]
+        wd = self._op_begin("sync.fetch")
+        try:
+            if d.mixed:
+                out = d.out_dev
+                arrs = await asyncio.to_thread(
+                    lambda: tuple(np.asarray(a) for a in out)
+                    if isinstance(out, tuple) else np.asarray(out)
+                )  # sampled [n], or (out [n, k+1], n_emit [n]) with spec rows
+            else:
+                arrs = await asyncio.to_thread(
+                    lambda: tuple(np.asarray(a) for a in d.out_dev)
+                )  # (toks, lps[, top_ids, top_lps]) each [K+1, B(, 8)]
+        finally:
+            self._op_end(wd)
         t_sync1 = time.perf_counter()
         with self._phase_lock:
             if overlapped:

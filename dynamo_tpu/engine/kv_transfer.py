@@ -28,6 +28,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from dynamo_tpu.utils import faults
+
 
 def _expand_slots(page_ids, page_size: int, n_tokens: int) -> np.ndarray:
     slots = (
@@ -47,6 +49,9 @@ def device_transfer_kv(
     """Move `n_tokens` positions of KV from src pages to dst pages with
     no host staging. Engines may differ in mesh/tp (pools resharded in
     step 2); page sizes must match (repack via llm.kv_rearrange first)."""
+    # chaos hook (docs/robustness.md): 'fail' surfaces as FaultError to
+    # the disagg caller, whose fallback is recomputing the prefill
+    faults.fire("kv_transfer")
     if src_engine.page_size != dst_engine.page_size:
         raise ValueError(
             f"page-size mismatch {src_engine.page_size} != "

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import asyncio
 import itertools
+import time
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -83,6 +84,12 @@ class Sequence:
     # hashes over placeholder ids would alias distinct images)
     prompt_embeds: Optional[object] = None
     embeds_offset: int = 0
+    # end-to-end deadline, epoch seconds (time.time() domain — wall clock
+    # so it survives process hops on the data plane); 0.0 = none. Set
+    # from Context metadata (x-request-timeout) or the engine's
+    # request_timeout_s default; checked by the admission shed and the
+    # cancellation sweep (docs/robustness.md "Deadlines").
+    deadline: float = 0.0
 
     # per-request sampling (resolved once at admission)
     temperature: float = 0.0
@@ -180,7 +187,18 @@ class Sequence:
 
             seq.prompt_embeds = np.asarray(pre.prompt_embeds, np.float32)
             seq.embeds_offset = int(pre.embeds_offset)
+        # deadline rides Context metadata across hops (the HTTP frontend
+        # stamps it from x-request-timeout; see llm/http/service.py)
+        try:
+            seq.deadline = float(ctx.metadata.get("deadline") or 0.0)
+        except (TypeError, ValueError):
+            seq.deadline = 0.0
         return seq
+
+    def past_deadline(self, now: Optional[float] = None) -> bool:
+        if not self.deadline:
+            return False
+        return (now if now is not None else time.time()) > self.deadline
 
     @property
     def no_cache(self) -> bool:

@@ -28,6 +28,11 @@ from aiohttp import web
 
 from dynamo_tpu.llm.http.metrics import ServiceMetrics
 from dynamo_tpu.utils import tracing
+from dynamo_tpu.llm.protocols.common import (
+    FINISH_REASON_TIMEOUT,
+    DeadlineExceededError,
+    PoolExhaustedError,
+)
 from dynamo_tpu.llm.protocols.openai import (
     ChatCompletionRequest,
     CompletionRequest,
@@ -75,6 +80,7 @@ class HttpService:
         manager: Optional[ModelManager] = None,
         metrics: Optional[ServiceMetrics] = None,
         request_template=None,
+        request_timeout_s: Optional[float] = None,
     ):
         self.manager = manager or ModelManager()
         self.metrics = metrics or ServiceMetrics()
@@ -82,6 +88,11 @@ class HttpService:
         # into bodies that omit model/temperature/max tokens (reference:
         # request_template.rs applied by dynamo-run)
         self.request_template = request_template
+        # deployment-default end-to-end deadline (seconds; None = none).
+        # A request's `x-request-timeout` header overrides it. The
+        # resolved deadline rides Context metadata through the
+        # preprocessor into the engine (docs/robustness.md "Deadlines").
+        self.request_timeout_s = request_timeout_s
         self.app = web.Application()
         self.app.add_routes(
             [
@@ -203,12 +214,43 @@ class HttpService:
         if engine is None:
             return _error_response(404, f"model {req.model!r} not found")
 
+        # end-to-end deadline: x-request-timeout (seconds) or the service
+        # default; stamped into Context metadata as an absolute epoch
+        # deadline so it survives process hops on the data plane. A
+        # non-positive service default means DISABLED (same contract as
+        # EngineConfig.request_timeout_s) — only an explicit header can
+        # express "already expired".
+        timeout_s = (
+            self.request_timeout_s
+            if self.request_timeout_s and self.request_timeout_s > 0
+            else None
+        )
+        hdr = request.headers.get("x-request-timeout")
+        if hdr is not None:
+            try:
+                timeout_s = float(hdr)
+            except ValueError:
+                return _error_response(
+                    400, f"invalid x-request-timeout {hdr!r} (want seconds)"
+                )
+            if timeout_s <= 0:
+                # an already-spent budget is shed before any work at all
+                return _error_response(
+                    429, "request deadline already expired",
+                    headers={"Retry-After": "1"},
+                )
+
         guard = self.metrics.inflight_guard(req.model, kind)
         ctx = Context(req, request_id=rid)
+        if timeout_s is not None:
+            ctx.metadata["timeout_s"] = timeout_s
+            ctx.metadata["deadline"] = time.time() + timeout_s
         try:
             stream = await engine.generate(ctx)
         except Exception as exc:  # noqa: BLE001 — admission or engine failure
-            if not isinstance(exc, ValueError):
+            if not isinstance(
+                exc, (ValueError, DeadlineExceededError, PoolExhaustedError)
+            ):
                 log.error("engine failed for %s", req.model, exc_info=exc)
             guard.close()
             return _classify_error(exc)
@@ -238,7 +280,9 @@ class HttpService:
         except StopAsyncIteration:
             pass
         except Exception as exc:  # noqa: BLE001 — mapped to a status code
-            if not isinstance(exc, ValueError):
+            if not isinstance(
+                exc, (ValueError, DeadlineExceededError, PoolExhaustedError)
+            ):
                 log.error("stream failed before first frame for %s", ctx.id,
                           exc_info=exc)
             ctx.kill()
@@ -310,21 +354,67 @@ class HttpService:
         except Exception as exc:  # noqa: BLE001 — mapped to a status code
             ctx.kill()
             return _classify_error(exc)
+        if _timed_out_empty(full):
+            # deadline expired in the admission queue: zero tokens were
+            # produced and the response had not started streaming, so
+            # the caller gets a REAL 429 instead of a 200 with an empty
+            # "timeout" choice (docs/robustness.md "Deadlines")
+            return _error_response(
+                429, "request deadline expired in the admission queue",
+                headers={"Retry-After": "1"},
+            )
         guard.mark_ok()
         return web.json_response(full)
 
 
-def _error_response(status: int, message: str) -> web.Response:
-    return web.json_response(
-        {"error": {"message": message, "type": "invalid_request_error"}}, status=status
+def _error_response(
+    status: int, message: str, headers: Optional[dict] = None
+) -> web.Response:
+    kind = (
+        "invalid_request_error" if status < 500 and status != 429
+        else "rate_limit_error" if status == 429
+        else "server_error"
     )
+    return web.json_response(
+        {"error": {"message": message, "type": kind}},
+        status=status, headers=headers,
+    )
+
+
+def _timed_out_empty(full: dict) -> bool:
+    """Did every choice of an aggregated response end `timeout` with no
+    content? (= the deadline expired before the first token; eligible
+    for conversion to a real 429 since nothing has streamed yet)."""
+    choices = full.get("choices") or []
+    if not choices:
+        return False
+    for c in choices:
+        if c.get("finish_reason") != FINISH_REASON_TIMEOUT:
+            return False
+        text = c.get("text") or (c.get("message") or {}).get("content")
+        if text:
+            return False
+    return True
 
 
 def _classify_error(exc: Exception) -> web.Response:
     """One policy for mapping stream/admission exceptions to HTTP status:
-    ValueError (incl. RequestError) = the request was invalid -> 400;
-    anything else = server fault -> 502. Post-admission stream faults are
-    normalized to RuntimeError by the preprocessor, so they land in 502."""
+    DeadlineExceeded = the caller's budget expired before device work ->
+    429 + Retry-After; PoolExhausted = a capacity condition -> 503 +
+    Retry-After; ValueError (incl. RequestError) = the request was
+    invalid -> 400; anything else = server fault -> 502. Post-admission
+    stream faults are normalized to RuntimeError by the preprocessor, so
+    they land in 502."""
+    if isinstance(exc, DeadlineExceededError):
+        return _error_response(
+            429, str(exc),
+            headers={"Retry-After": str(max(1, int(exc.retry_after_s)))},
+        )
+    if isinstance(exc, PoolExhaustedError):
+        return _error_response(
+            503, str(exc),
+            headers={"Retry-After": str(max(1, int(exc.retry_after_s)))},
+        )
     if isinstance(exc, ValueError):
         return _error_response(400, str(exc))
     return _error_response(502, f"engine error: {exc}")

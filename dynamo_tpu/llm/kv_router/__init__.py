@@ -34,6 +34,7 @@ from dynamo_tpu.llm.kv_router.scheduler import (
 from dynamo_tpu.llm.tokens import compute_block_hashes
 from dynamo_tpu.runtime.client import Client, PushRouter
 from dynamo_tpu.runtime.pipeline.context import Context
+from dynamo_tpu.utils import tracing
 
 __all__ = [
     "KvRouter",
@@ -92,6 +93,33 @@ class KvRouter:
             f"{self.component.namespace.name}.{self.component.name}."
         ):
             self.indexer.remove_worker(worker_id)
+            self.aggregator.mark_gone(worker_id)
+
+    def _healthy_candidates(self, ids: list[int]) -> list[int]:
+        """Health-aware routing (docs/robustness.md): drop workers whose
+        heartbeat is stale (no stats reply within the aggregator horizon
+        — a wedged engine can keep a healthy lease) or whose data-plane
+        circuit breaker is open (recent transport failures). If that
+        empties the pool, fall back to every live instance: routing to a
+        suspect worker beats refusing service outright."""
+        stale = self.aggregator.stale_workers(ids)
+        open_brk = {
+            wid for wid in ids
+            if hasattr(self.client, "breaker_open")
+            and self.client.breaker_open(wid)
+        }
+        bad = stale | open_brk
+        if bad:
+            from dynamo_tpu.utils import counters
+
+            counters.inc("router_workers_excluded_total", len(bad))
+            if tracing.enabled():
+                tracing.instant(
+                    "kv_router.excluded", cat="router",
+                    stale=sorted(stale), breaker_open=sorted(open_brk),
+                )
+        healthy = [w for w in ids if w not in bad]
+        return healthy or ids
 
     async def schedule(self, token_ids: list[int]) -> SchedulingDecision:
         """Pick the worker for these tokens (reference:
@@ -99,7 +127,8 @@ class KvRouter:
         overlaps = self.indexer.find_matches(
             compute_block_hashes(token_ids, self.block_size)
         )
-        workers = self.aggregator.endpoints_for(self.client.instance_ids())
+        candidates = self._healthy_candidates(self.client.instance_ids())
+        workers = self.aggregator.endpoints_for(candidates)
         decision = await self.scheduler.schedule(
             workers, overlaps, isl_tokens=len(token_ids)
         )

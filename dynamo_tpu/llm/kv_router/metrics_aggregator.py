@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import asyncio
 import statistics
+import time
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -37,10 +38,25 @@ class ProcessedEndpoints:
 
 
 class KvMetricsAggregator:
-    def __init__(self, client, poll_interval: float = 1.0):
+    def __init__(
+        self,
+        client,
+        poll_interval: float = 1.0,
+        stale_after: Optional[float] = None,
+    ):
         self.client = client  # runtime Client of the workers' endpoint
         self.poll_interval = poll_interval
+        # heartbeat staleness horizon: a worker that has not answered a
+        # stats scrape for this long is excluded from routing (its lease
+        # may still be alive — a wedged worker keeps a healthy keepalive
+        # thread); default 3 poll intervals so one dropped scrape never
+        # flaps a healthy worker out
+        self.stale_after = (
+            stale_after if stale_after is not None else 3.0 * poll_interval
+        )
         self.current = ProcessedEndpoints()
+        # worker -> monotonic stamp of its last successful stats reply
+        self.last_seen: dict[int, float] = {}
         self._task: Optional[asyncio.Task] = None
 
     async def start(self) -> None:
@@ -63,11 +79,13 @@ class KvMetricsAggregator:
     async def _scrape_once(self) -> None:
         stats = await self.client.scrape_stats()
         endpoints = {}
+        now = time.monotonic()
         for wid, s in stats.items():
             try:
                 endpoints[wid] = ForwardPassMetrics.from_dict(s)
             except Exception:  # noqa: BLE001 — skip one worker's bad stats
                 continue
+            self.last_seen[wid] = now
         self.current = ProcessedEndpoints(endpoints=endpoints)
 
     def endpoints_for(self, worker_ids: list[int]) -> dict[int, ForwardPassMetrics]:
@@ -78,6 +96,29 @@ class KvMetricsAggregator:
             wid: self.current.endpoints.get(wid, ForwardPassMetrics())
             for wid in worker_ids
         }
+
+    def stale_workers(self, worker_ids: list[int]) -> set[int]:
+        """Workers whose heartbeat (last successful stats reply) is older
+        than `stale_after`. Workers never seen yet are NOT stale — a new
+        instance must be routable before its first scrape lands; its
+        first missed horizon starts at registration."""
+        now = time.monotonic()
+        out = set()
+        for wid in worker_ids:
+            seen = self.last_seen.get(wid)
+            if seen is None:
+                # start the horizon now so a worker that NEVER answers
+                # does eventually go stale
+                self.last_seen[wid] = now
+            elif now - seen > self.stale_after:
+                out.add(wid)
+        return out
+
+    def mark_gone(self, worker_id: int) -> None:
+        """Instance-down: drop the heartbeat record so a re-registered
+        worker id starts fresh."""
+        self.last_seen.pop(worker_id, None)
+        self.current.endpoints.pop(worker_id, None)
 
     async def close(self) -> None:
         if self._task:

@@ -21,6 +21,29 @@ FINISH_REASON_LENGTH = "length"
 FINISH_REASON_STOP = "stop"
 FINISH_REASON_CANCELLED = "cancelled"
 FINISH_REASON_ERROR = "error"
+# end-to-end deadline expired (admission queue or mid-flight); the HTTP
+# layer maps a zero-token timeout finish to 429 + Retry-After when the
+# response is not yet streaming (docs/robustness.md "Deadlines")
+FINISH_REASON_TIMEOUT = "timeout"
+
+
+class DeadlineExceededError(RuntimeError):
+    """Request deadline (x-request-timeout / EngineConfig.request_timeout_s)
+    expired before any device work — shed with HTTP 429 + Retry-After
+    instead of burning prefill compute on a caller that stopped waiting."""
+
+    def __init__(self, message: str, retry_after_s: float = 1.0):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
+
+
+class PoolExhaustedError(RuntimeError):
+    """KV page pool could not serve the request within its wait budget —
+    a capacity condition (HTTP 503 + Retry-After), not a server bug (500)."""
+
+    def __init__(self, message: str, retry_after_s: float = 1.0):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
 
 
 @dataclass

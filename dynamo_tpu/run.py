@@ -72,6 +72,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--request-template",
                    help="JSON file of request defaults (model/temperature/"
                         "max_completion_tokens), ref request_template.rs")
+    p.add_argument("--request-timeout", type=float, default=None,
+                   help="default end-to-end deadline per request, seconds "
+                        "(per-request x-request-timeout header overrides; "
+                        "expired requests shed with 429 — "
+                        "docs/robustness.md)")
     p.add_argument("--disagg-mode", choices=["agg", "decode", "prefill"],
                    default="agg", help="worker role in a disaggregated graph")
     p.add_argument("--max-local-prefill-length", type=int, default=128)
@@ -169,7 +174,15 @@ async def run_http(args, out: str) -> None:
         from dynamo_tpu.llm.request_template import RequestTemplate
 
         template = RequestTemplate.load(args.request_template)
-    svc = HttpService(request_template=template)
+    svc = HttpService(
+        request_template=template, request_timeout_s=args.request_timeout
+    )
+    # process-global health counters (hub reconnects, lease expiries,
+    # transport retries, breaker trips, injected faults) ride the same
+    # /metrics scrape as the service + engine series
+    from dynamo_tpu.utils.counters import PromCounters
+
+    svc.metrics.extra.append(PromCounters())
     if out.startswith("dyn://"):
         # ingress: discover models from the hub
         from dynamo_tpu.llm.http.discovery import ModelWatcher

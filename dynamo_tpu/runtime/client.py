@@ -23,6 +23,12 @@ from dynamo_tpu.runtime.component import (
     unpack_payload,
 )
 from dynamo_tpu.runtime.pipeline.context import Context
+from dynamo_tpu.runtime.resilience import (
+    TRANSIENT_ERRORS,
+    Backoff,
+    CircuitBreaker,
+)
+from dynamo_tpu.utils import counters
 from dynamo_tpu.utils.logging import get_logger
 
 log = get_logger("dynamo_tpu.client")
@@ -33,7 +39,19 @@ class NoInstancesError(RuntimeError):
 
 
 class Client:
-    """Tracks live instances of one endpoint via a hub prefix watch."""
+    """Tracks live instances of one endpoint via a hub prefix watch.
+
+    Transport resilience (docs/robustness.md): establishing a request
+    handle is idempotent (no engine work happens until the worker pops
+    the frame), so transient connection failures retry against a
+    DIFFERENT instance with jittered backoff, and every instance carries
+    a `CircuitBreaker` — `threshold` consecutive transport failures take
+    it out of the routing pick for `cooldown_s`, then one half-open
+    probe decides. Mid-stream failures are NOT retried (not idempotent);
+    they surface to the caller and count against the breaker."""
+
+    # transport-retry policy for handle establishment (idempotent)
+    max_attempts = 3
 
     def __init__(self, drt, endpoint_id: EndpointId):
         self._drt = drt
@@ -43,6 +61,8 @@ class Client:
         self._watch_task: Optional[asyncio.Task] = None
         self._changed = asyncio.Event()
         self._rr_index = 0
+        self._breakers: dict[int, CircuitBreaker] = {}
+        self._backoff = Backoff(base=0.05, cap=1.0)
 
     @classmethod
     async def new_dynamic(cls, drt, endpoint_id: EndpointId) -> "Client":
@@ -110,7 +130,26 @@ class Client:
 
     # ------------------------------------------------------------- routing
 
-    def _pick(self, mode: str, instance_id: Optional[int]) -> InstanceInfo:
+    def breaker(self, worker_id: int) -> CircuitBreaker:
+        """Per-instance circuit breaker (created on first use)."""
+        br = self._breakers.get(worker_id)
+        if br is None:
+            br = self._breakers[worker_id] = CircuitBreaker(
+                name=f"{self.endpoint_id.subject}/{worker_id:x}"
+            )
+        return br
+
+    def breaker_open(self, worker_id: int) -> bool:
+        """Non-mutating health read for routers: True while the breaker
+        is in cooldown (half-open probes are the data plane's business,
+        not the KV router's)."""
+        br = self._breakers.get(worker_id)
+        return br is not None and br.state != "closed"
+
+    def _pick(
+        self, mode: str, instance_id: Optional[int],
+        exclude: Optional[set] = None,
+    ) -> InstanceInfo:
         if not self.instances:
             raise NoInstancesError(f"no live instances of {self.endpoint_id.subject}")
         if mode == "direct":
@@ -123,10 +162,27 @@ class Client:
                 )
             return info
         ids = sorted(self.instances.keys())
-        if mode == "round_robin":
-            self._rr_index = (self._rr_index + 1) % len(ids)
-            return self.instances[ids[self._rr_index]]
-        return self.instances[_random.choice(ids)]  # "random"
+        if exclude:
+            ids = [i for i in ids if i not in exclude] or ids
+        # skip instances whose breaker is OPEN — a NON-mutating state
+        # read: allow() claims the single half-open probe slot, so it
+        # must run only for the instance actually chosen, never as a
+        # filter over the whole pool (that would burn every half-open
+        # worker's probe and strand them excluded). If every breaker is
+        # open, fall through with the full set — availability beats a
+        # wrongly-pessimistic breaker.
+        cand = [i for i in ids if self.breaker(i).state != "open"] or ids
+        while True:
+            if mode == "round_robin":
+                self._rr_index = (self._rr_index + 1) % len(cand)
+                chosen = cand[self._rr_index]
+            else:
+                chosen = _random.choice(cand)  # "random"
+            if self.breaker(chosen).allow() or len(cand) == 1:
+                # half-open refusal (another probe in flight): re-pick
+                # among the rest; a last candidate routes regardless
+                return self.instances[chosen]
+            cand = [i for i in cand if i != chosen]
 
     async def generate(
         self,
@@ -135,16 +191,41 @@ class Client:
         mode: str = "random",
         instance_id: Optional[int] = None,
     ) -> AsyncIterator[Any]:
-        """Route one request; returns a typed async response stream."""
-        info = self._pick(mode, instance_id)
+        """Route one request; returns a typed async response stream.
+
+        Handle establishment retries transient transport failures
+        against other instances (capped, jittered); see class docs."""
         ctx = context or Context(payload)
-        handle = await self._drt.data_plane_client.request(
-            info.address,
-            self.endpoint_id.subject,
-            pack_payload(payload),
-            request_id=ctx.id,
-            metadata=ctx.metadata,
-        )
+        tried: set[int] = set()
+        attempt = 0
+        while True:
+            info = self._pick(mode, instance_id, exclude=tried)
+            br = self.breaker(info.worker_id)
+            try:
+                handle = await self._drt.data_plane_client.request(
+                    info.address,
+                    self.endpoint_id.subject,
+                    pack_payload(payload),
+                    request_id=ctx.id,
+                    metadata=ctx.metadata,
+                )
+            except TRANSIENT_ERRORS as exc:
+                br.record_failure()
+                tried.add(info.worker_id)
+                attempt += 1
+                if mode == "direct" or attempt >= self.max_attempts:
+                    raise
+                counters.inc("client_retries_total")
+                delay = self._backoff.delay(attempt - 1)
+                log.warning(
+                    "request to %s %x failed (%s); retrying elsewhere "
+                    "in %.3fs", self.endpoint_id.subject, info.worker_id,
+                    exc, delay,
+                )
+                await asyncio.sleep(delay)
+                continue
+            br.record_success()
+            break
 
         async def _stream() -> AsyncIterator[Any]:
             monitor = asyncio.create_task(_propagate_cancel(ctx, handle))
@@ -177,7 +258,13 @@ class Client:
                 )
                 async for raw in handle:
                     results[worker_id] = unpack_payload(raw)
-            except Exception:  # noqa: BLE001 — a dead worker just drops out
+                self.breaker(worker_id).record_success()
+            except TRANSIENT_ERRORS:
+                # a dead worker just drops out of the snapshot — but its
+                # breaker learns, so routing stops picking it before the
+                # hub lease expires
+                self.breaker(worker_id).record_failure()
+            except Exception:  # noqa: BLE001 — malformed stats, etc.
                 pass
 
         tasks = [

@@ -14,6 +14,7 @@ import os
 from typing import Any, AsyncIterator, Optional
 
 from dynamo_tpu.runtime.hub import codec
+from dynamo_tpu.utils import counters, faults
 from dynamo_tpu.utils.logging import get_logger
 
 log = get_logger("dynamo_tpu.hub.client")
@@ -91,7 +92,11 @@ class KeepaliveThread:
             while not self._stop.is_set():
                 with self._lock:
                     leases = dict(self._leases)
-                tick = min([ttl / 3.0 for ttl in leases.values()] or [1.0])
+                # idle tick 0.25s, NOT 1.0: a lease add() can land just
+                # after the empty-leases read, and a short-TTL lease must
+                # not wait out a whole idle second before its first
+                # refresh (a ttl<=1s lease would expire unrefreshed)
+                tick = min([ttl / 3.0 for ttl in leases.values()] or [0.25])
                 for lease_id in leases:
                     try:
                         ok = await client.request(
@@ -99,6 +104,7 @@ class KeepaliveThread:
                         )
                         if not ok:
                             log.warning("lease %#x no longer valid", lease_id)
+                            counters.inc("lease_expired_total")
                             self.remove(lease_id)
                     except HubError:
                         log.warning("keepalive for %#x rejected", lease_id)
@@ -117,14 +123,20 @@ class KeepaliveThread:
             await client.close()
 
     async def _reconnect(self) -> "HubClient":
+        import random
+
         delay = 0.2
         while not self._stop.is_set():
             try:
                 client = await HubClient.connect(self.addr)
                 log.info("keepalive connection re-established to %s", self.addr)
+                counters.inc("hub_reconnects_total")
                 return client
             except (ConnectionError, OSError):
-                await asyncio.sleep(delay)
+                # full jitter: a hub restart must not see every worker's
+                # keepalive thread reconnect in lockstep (thundering
+                # herd) — same policy as runtime/resilience.Backoff
+                await asyncio.sleep(delay * random.uniform(0.5, 1.5))
                 delay = min(delay * 2, 2.0)
         raise ConnectionError("keepalive thread stopped during reconnect")
 
@@ -165,6 +177,7 @@ class Lease:
                 ok = await self.client.request("lease_keepalive", lease_id=self.lease_id)
                 if not ok:
                     log.warning("lease %#x no longer valid", self.lease_id)
+                    counters.inc("lease_expired_total")
                     return
         except (asyncio.CancelledError, ConnectionError):
             pass
@@ -278,6 +291,7 @@ class HubClient:
 
     @classmethod
     async def connect(cls, addr: str | None = None) -> "HubClient":
+        faults.load_env()  # arm DYN_FAULTS points (no-op when unset)
         self = cls()
         self.addr = addr or hub_addr_from_env()
         host, port = self.addr.rsplit(":", 1)
@@ -314,6 +328,11 @@ class HubClient:
                 msg = await codec.read_frame(self._reader)
                 if msg is None:
                     break
+                if faults.active():
+                    # chaos hook: a 'drop' here kills the recv loop the
+                    # way a severed TCP connection would — every pending
+                    # future fails with ConnectionError (see finally)
+                    await faults.afire("hub.recv")
                 if "push" in msg:
                     self._route_push(msg["push"], msg["ev"])
                     continue
@@ -341,6 +360,10 @@ class HubClient:
             q.put_nowait(ev)
 
     async def request(self, op: str, **args: Any) -> Any:
+        if faults.active():
+            # chaos hook: 'drop' raises ConnectionError exactly like a
+            # peer vanishing mid-conversation; 'delay' models a slow hub
+            await faults.afire("hub.send")
         if self._writer is None:
             raise ConnectionError("hub client not connected")
         req_id = next(self._req_ids)

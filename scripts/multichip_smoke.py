@@ -1,0 +1,116 @@
+"""8-device multichip smoke: the sharded-path hang guard.
+
+MULTICHIP_r05 hit rc=124 (timeout) and shipped silently because no
+pre-merge gate exercised the sharded path (ROADMAP open item 1). This
+script is that gate: it forces 8 virtual CPU devices, serves greedy
+requests through a tp=8 engine with the step pipeline ON (the r05
+suspect), and byte-compares against a single-device engine of the same
+config — a sharded-path hang reads as the CI job's own timeout (red),
+and a sharded-path divergence reads as the mismatch assert (red).
+
+Run:  python scripts/multichip_smoke.py        (~1-3 min on CPU)
+CI:   pre-merge.yml `multichip-smoke` job, wrapped in `timeout` so a
+      hang can never eat the runner.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import asyncio  # noqa: E402
+
+import jax  # noqa: E402
+
+from dynamo_tpu.engine import EngineConfig, JaxEngine  # noqa: E402
+from dynamo_tpu.llm.protocols.common import (  # noqa: E402
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.models import config as cfgmod  # noqa: E402
+from dynamo_tpu.parallel.mesh import MeshConfig  # noqa: E402
+from dynamo_tpu.runtime.pipeline.context import Context  # noqa: E402
+
+# tiny widened to 8 kv heads so tp=8 actually shards the attention
+CFG = cfgmod.get_config("tiny").with_(num_heads=8, num_kv_heads=8)
+
+PROMPTS = (
+    [5, 17, 42, 9, 88, 3],
+    [11, 3, 7, 29, 31],
+    [2, 44, 8, 19, 23, 61, 12],
+)
+MAX_TOKENS = 16
+
+
+def make_engine(tp: int) -> JaxEngine:
+    return JaxEngine(
+        EngineConfig(
+            model=CFG,
+            dtype="float32",
+            mesh=MeshConfig(tp=tp),
+            page_size=8,
+            num_pages=96,
+            max_batch_size=4,
+            max_model_len=128,
+            prefill_chunk=32,
+            # the r05 suspect paths stay ON: pipelined mixed steps over
+            # the sharded mesh are exactly what a smoke must cover
+            mixed_batching=True,
+            step_pipeline=True,
+            seed=0,
+        )
+    )
+
+
+async def serve(engine) -> list[list[int]]:
+    async def one(prompt):
+        pre = PreprocessedRequest(
+            token_ids=list(prompt),
+            stop_conditions=StopConditions(max_tokens=MAX_TOKENS),
+            sampling_options=SamplingOptions(greedy=True),
+        )
+        frames = [f async for f in await engine.generate(Context(pre.to_dict()))]
+        assert frames[-1].get("finish_reason") == "length", frames[-1]
+        return [t for f in frames for t in f.get("token_ids") or []]
+
+    return list(await asyncio.gather(*(one(p) for p in PROMPTS)))
+
+
+async def main() -> None:
+    n_dev = jax.device_count()
+    assert n_dev == 8, f"expected 8 virtual devices, got {n_dev}"
+
+    ref_engine = make_engine(tp=1)
+    want = await serve(ref_engine)
+    await ref_engine.close()
+
+    tp8 = make_engine(tp=8)
+    got = await serve(tp8)
+    # a second wave rides the prefix cache + warm compiled families —
+    # the steady-state sharded path, not just the compile path
+    got2 = await serve(tp8)
+    await tp8.close()
+
+    assert got == want, f"tp=8 diverged from tp=1:\n{got}\nvs\n{want}"
+    assert got2 == want, f"tp=8 second wave diverged:\n{got2}\nvs\n{want}"
+    print(
+        f"multichip smoke ok: {n_dev} devices, tp=8, "
+        f"{len(PROMPTS)} streams x {MAX_TOKENS} tokens byte-identical "
+        "to tp=1 (mixed+pipeline on)"
+    )
+
+
+if __name__ == "__main__":
+    try:
+        asyncio.run(asyncio.wait_for(main(), timeout=540))
+    except asyncio.TimeoutError:
+        print("multichip smoke TIMED OUT (sharded-path hang)", file=sys.stderr)
+        sys.exit(124)

@@ -1,0 +1,208 @@
+"""Deterministic chaos scenarios (DYN_FAULTS registry, utils/faults.py).
+
+Each test injects one fault class and asserts the acceptance contract
+from the fault-tolerance spine: every in-flight request RESOLVES
+(tokens, a typed error, or a timeout/429-class finish) within its
+budget, nothing hangs, and after the fault clears the engine serves
+byte-identical greedy streams. The CI chaos job runs this file (plus
+tests/test_robustness.py, which covers the slow-dispatch/watchdog and
+client-disconnect scenarios) — see .github/workflows/pre-merge.yml.
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from dynamo_tpu.engine import EngineConfig, JaxEngine
+from dynamo_tpu.llm.protocols.common import (
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.models import config as cfgmod
+from dynamo_tpu.runtime.pipeline.context import Context
+from dynamo_tpu.utils import counters, faults
+
+from .helpers import hub_pair
+
+CFG = cfgmod.get_config("tiny")
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    faults.reset()
+    counters.reset()
+    yield
+    faults.reset()
+    counters.reset()
+
+
+def make_engine(**kw) -> JaxEngine:
+    defaults = dict(
+        model=CFG,
+        dtype="float32",
+        page_size=8,
+        num_pages=64,
+        max_batch_size=4,
+        max_model_len=128,
+        prefill_chunk=32,
+        seed=0,
+    )
+    defaults.update(kw)
+    return JaxEngine(EngineConfig(**defaults))
+
+
+def greedy_request(prompt, max_tokens=8) -> PreprocessedRequest:
+    return PreprocessedRequest(
+        token_ids=list(prompt),
+        stop_conditions=StopConditions(max_tokens=max_tokens),
+        sampling_options=SamplingOptions(greedy=True),
+    )
+
+
+async def collect(engine, pre, deadline=None):
+    ctx = Context(pre.to_dict())
+    if deadline is not None:
+        ctx.metadata["deadline"] = deadline
+    frames = [f async for f in await engine.generate(ctx)]
+    tokens = [t for f in frames for t in f.get("token_ids") or []]
+    return tokens, frames[-1].get("finish_reason")
+
+
+PROMPTS = ([5, 17, 42, 9], [11, 3, 7, 29, 31], [2, 44, 8])
+
+
+async def _serve_wave(engine, max_tokens=8):
+    outs = await asyncio.gather(
+        *(collect(engine, greedy_request(p, max_tokens)) for p in PROMPTS)
+    )
+    return outs
+
+
+async def _baseline(max_tokens=8, **kw):
+    plain = make_engine(**kw)
+    want = await _serve_wave(plain, max_tokens)
+    await plain.close()
+    assert all(f == "length" for _, f in want)
+    return want
+
+
+# ---------------------------------------------------------------------
+# scenario: dispatch failure mid-wave (prefill group dispatch dies once)
+
+
+async def test_chaos_prefill_dispatch_failure_mid_wave():
+    want = await _baseline()
+    engine = make_engine()
+    # the FIRST prefill group dispatch fails; the engine must contain it
+    # (retry-singly path), finish every request, and match byte-for-byte
+    faults.configure("engine.prefill.fail@1x1")
+    got = await asyncio.wait_for(_serve_wave(engine), 120)
+    assert got == want, "recovery must be byte-identical"
+    assert faults.stats()["engine.prefill"]["fired"] == 1
+    # fault cleared: a fresh wave serves clean
+    got2 = await asyncio.wait_for(_serve_wave(engine), 120)
+    assert got2 == want
+    await engine.close()
+
+
+# ---------------------------------------------------------------------
+# scenario: mixed-step dispatch failure -> degrade ladder -> normal paths
+
+
+async def test_chaos_mixed_dispatch_failure_degrades_cleanly():
+    want = await _baseline(max_tokens=24, mixed_batching=True)
+
+    engine = make_engine(mixed_batching=True)
+    faults.configure("engine.mixed.fail")
+    # stagger arrivals so decode-ready rows and prefill chunks coexist
+    # (the mixed-step precondition); any mixed step then fails and the
+    # engine must degrade to the contained normal paths mid-serve
+
+    async def late(delay, p):
+        await asyncio.sleep(delay)
+        return await collect(engine, greedy_request(p, 24))
+
+    got = await asyncio.wait_for(
+        asyncio.gather(
+            *(late(0.4 * i, p) for i, p in enumerate(PROMPTS))
+        ),
+        180,
+    )
+    assert got == want, "degraded serve must stay byte-identical"
+    fired = faults.stats()["engine.mixed"]["fired"]
+    if fired:
+        # the one-way trip is loud on /metrics
+        assert engine.metrics()["mixed_disabled"] == 1
+        assert engine.phase_stats["mixed_disabled"] == 1
+    await engine.close()
+
+
+# ---------------------------------------------------------------------
+# scenario: KV-pool exhaustion (transient, then permanent + deadline)
+
+
+async def test_chaos_transient_pool_exhaustion_recovers():
+    want = await _baseline()
+    engine = make_engine()
+    # the first two page reservations fail as if the pool were empty;
+    # admission must retry and serve everything once pages "free up"
+    faults.configure("engine.reserve.failx2")
+    got = await asyncio.wait_for(_serve_wave(engine), 120)
+    assert got == want
+    assert faults.stats()["engine.reserve"]["fired"] == 2
+    await engine.close()
+
+
+async def test_chaos_sustained_pool_exhaustion_sheds_within_deadline():
+    engine = make_engine()
+    faults.configure("engine.reserve.fail")  # pool never recovers
+    t0 = time.perf_counter()
+    tokens, finish = await asyncio.wait_for(
+        collect(
+            engine, greedy_request([5, 17, 42]),
+            deadline=time.time() + 0.4,
+        ),
+        60,
+    )
+    assert finish == "timeout" and tokens == []
+    # resolved promptly once the deadline passed — not a hang
+    assert time.perf_counter() - t0 < 30
+    assert engine.phase_stats["deadline_shed"] == 1
+    await engine.close()
+
+
+# ---------------------------------------------------------------------
+# scenario: hub connection drop mid-lease (keepalive thread reconnects)
+
+
+async def test_chaos_hub_drop_mid_lease_keepalive_reconnects():
+    async with hub_pair() as (server, client):
+        lease = await client.lease_grant(ttl=1.5, keepalive="thread")
+        await client.kv_put("/chaos/worker", b"alive", lease=lease)
+        # let the first threaded keepalive land before arming the fault
+        await asyncio.sleep(0.3)
+        # ONE dropped hub round trip mid-lease: the keepalive thread
+        # must treat it as a dead connection, reconnect (jittered), and
+        # keep the lease alive — a silently-expired lease is the
+        # "worker vanishes while healthy" failure this exists to stop
+        faults.configure("hub.send.dropx1")
+        await asyncio.sleep(2.0)  # several keepalive periods of chaos
+        faults.reset()
+        assert await lease.is_valid(), "lease must survive the drop"
+        assert (await client.kv_get("/chaos/worker")) is not None
+        assert counters.get("hub_reconnects_total") >= 1.0
+        assert counters.get("lease_expired_total") == 0.0
+        assert faults.stats() == {}  # registry cleanly cleared
+        lease.client.keepalive_thread().stop()
+
+
+async def test_chaos_hub_recv_drop_fails_pending_cleanly():
+    """A severed recv loop must fail every pending request with
+    ConnectionError (the retryable class) — never hang a caller."""
+    async with hub_pair() as (server, client):
+        assert await client.ping() == "pong"
+        faults.configure("hub.recv.dropx1")
+        with pytest.raises(ConnectionError):
+            await asyncio.wait_for(client.ping(), 10)

@@ -1,0 +1,144 @@
+"""Fault-injection registry tests (utils/faults.py): grammar, hit
+gating, count caps, seeded probability, determinism, counters."""
+
+import asyncio
+import time
+
+import pytest
+
+from dynamo_tpu.utils import counters, faults
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    faults.reset()
+    counters.reset()
+    yield
+    faults.reset()
+    counters.reset()
+
+
+def test_unset_is_noop_and_cheap():
+    assert not faults.active()
+    faults.fire("engine.dispatch")  # must not raise or record anything
+    assert faults.stats() == {}
+
+
+def test_parse_issue_example_spec():
+    n = faults.configure(
+        "engine.dispatch.delay=0.5,hub.send.drop@3,kv_transfer.fail"
+    )
+    assert n == 3
+    st = faults.stats()
+    assert set(st) == {"engine.dispatch", "hub.send", "kv_transfer"}
+
+
+def test_parse_rejects_garbage():
+    for bad in ("nodot", "x.unknownaction", "p.delay=notafloat",
+                "p.fail@0", "p.fail~1.5"):
+        with pytest.raises(ValueError):
+            faults.configure(bad)
+    # a failed configure leaves the registry in a consistent state
+    assert faults.configure("a.fail") == 1
+
+
+def test_fail_action_raises_typed():
+    faults.configure("site.fail")
+    with pytest.raises(faults.FaultError):
+        faults.fire("site")
+    # other sites unaffected
+    faults.fire("elsewhere")
+
+
+def test_drop_action_raises_connection_error():
+    faults.configure("hub.send.drop")
+    with pytest.raises(ConnectionError):
+        faults.fire("hub.send")
+
+
+def test_delay_action_sleeps():
+    faults.configure("slow.delay=0.05")
+    t0 = time.perf_counter()
+    faults.fire("slow")
+    assert time.perf_counter() - t0 >= 0.04
+
+
+def test_at_hit_gating():
+    faults.configure("p.fail@3")
+    faults.fire("p")  # hit 1: armed from 3
+    faults.fire("p")  # hit 2
+    with pytest.raises(faults.FaultError):
+        faults.fire("p")  # hit 3 fires
+    st = faults.stats()["p"]
+    assert st["hits"] == 3 and st["fired"] == 1
+
+
+def test_count_cap_disarms():
+    faults.configure("p.failx2")
+    for _ in range(2):
+        with pytest.raises(faults.FaultError):
+            faults.fire("p")
+    faults.fire("p")  # third arrival: disarmed
+    assert faults.stats()["p"]["fired"] == 2
+
+
+def test_at_and_count_compose():
+    faults.configure("p.fail@2x1")
+    faults.fire("p")
+    with pytest.raises(faults.FaultError):
+        faults.fire("p")
+    faults.fire("p")
+    assert faults.stats()["p"] == {"hits": 3, "fired": 1}
+
+
+def test_probability_is_seeded_deterministic():
+    def run(seed):
+        faults.configure("p.fail~0.5", seed=seed)
+        pattern = []
+        for _ in range(32):
+            try:
+                faults.fire("p")
+                pattern.append(0)
+            except faults.FaultError:
+                pattern.append(1)
+        return pattern
+
+    a, b = run(7), run(7)
+    assert a == b, "same seed must replay the same fault sequence"
+    assert any(a) and not all(a), "p=0.5 over 32 draws should mix"
+    assert run(8) != a, "a different seed should differ"
+
+
+async def test_afire_delay_does_not_block_loop():
+    faults.configure("slow.delay=0.1")
+    ticks = []
+
+    async def ticker():
+        for _ in range(4):
+            ticks.append(time.perf_counter())
+            await asyncio.sleep(0.02)
+
+    t = asyncio.create_task(ticker())
+    await faults.afire("slow")
+    await t
+    # the ticker ran DURING the injected delay
+    assert len(ticks) == 4
+
+
+def test_fired_counter_feeds_global_registry():
+    faults.configure("p.failx1")
+    with pytest.raises(faults.FaultError):
+        faults.fire("p")
+    assert counters.get("faults_injected_total") == 1.0
+    assert faults.fired_total() == 1
+
+
+def test_multiple_points_same_site():
+    # delay AND fail on one site: the first eligible spec fires per
+    # arrival, both keep counting
+    faults.configure("p.fail@2,p.delay=0.0@1x1")
+    faults.fire("p")  # delay fires (0s)
+    with pytest.raises(faults.FaultError):
+        faults.fire("p")
+    st = faults.stats()["p"]
+    assert st["fired"] == 2

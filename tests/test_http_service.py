@@ -303,3 +303,157 @@ async def test_debug_trace_request_span():
     finally:
         tracing.disable()
         tracing.clear()
+
+
+# ------------------------------------------- deadlines & typed errors
+# (fault-tolerance spine, docs/robustness.md: x-request-timeout rides
+# Context metadata; DeadlineExceeded -> 429 + Retry-After; PoolExhausted
+# -> 503 + Retry-After)
+
+
+async def test_request_timeout_header_invalid_is_400():
+    async with http_service() as (svc, session):
+        r = await session.post(
+            "/v1/chat/completions",
+            json={"model": "echo", "messages": [{"role": "user", "content": "x"}]},
+            headers={"x-request-timeout": "soon"},
+        )
+        assert r.status == 400
+        assert "x-request-timeout" in (await r.json())["error"]["message"]
+
+
+async def test_request_timeout_zero_sheds_429_with_retry_after():
+    async with http_service() as (svc, session):
+        r = await session.post(
+            "/v1/chat/completions",
+            json={"model": "echo", "messages": [{"role": "user", "content": "x"}]},
+            headers={"x-request-timeout": "0"},
+        )
+        assert r.status == 429
+        assert r.headers.get("Retry-After") == "1"
+        assert (await r.json())["error"]["type"] == "rate_limit_error"
+
+
+async def test_request_timeout_header_rides_context_metadata():
+    from dynamo_tpu.runtime.pipeline.context import Context
+
+    seen = {}
+
+    class CapturingEngine:
+        async def generate(self, ctx: Context):
+            seen.update(ctx.metadata)
+
+            async def _gen():
+                yield {"id": "x", "choices": [], "object": "chat.completion.chunk"}
+
+            return _gen()
+
+    svc = HttpService()
+    svc.manager.add_chat_model("cap", CapturingEngine())
+    await svc.start("127.0.0.1", 0)
+    try:
+        import aiohttp
+        import time as _time
+
+        async with aiohttp.ClientSession(f"http://127.0.0.1:{svc.port}") as s:
+            t0 = _time.time()
+            r = await s.post(
+                "/v1/chat/completions",
+                json={"model": "cap", "messages": [{"role": "user", "content": "x"}]},
+                headers={"x-request-timeout": "12.5"},
+            )
+            assert r.status == 200
+        assert seen.get("timeout_s") == 12.5
+        assert abs(seen["deadline"] - (t0 + 12.5)) < 5.0
+    finally:
+        await svc.stop()
+
+
+async def test_typed_engine_errors_map_to_429_and_503():
+    from dynamo_tpu.llm.protocols.common import (
+        DeadlineExceededError,
+        PoolExhaustedError,
+    )
+
+    class ShedEngine:
+        async def generate(self, ctx):
+            raise DeadlineExceededError("budget spent", retry_after_s=2)
+
+    class FullEngine:
+        async def generate(self, ctx):
+            raise PoolExhaustedError("no pages", retry_after_s=3)
+
+    svc = HttpService()
+    svc.manager.add_chat_model("shed", ShedEngine())
+    svc.manager.add_chat_model("full", FullEngine())
+    await svc.start("127.0.0.1", 0)
+    try:
+        import aiohttp
+
+        async with aiohttp.ClientSession(f"http://127.0.0.1:{svc.port}") as s:
+            body = {"messages": [{"role": "user", "content": "x"}]}
+            r = await s.post(
+                "/v1/chat/completions", json={"model": "shed", **body}
+            )
+            assert r.status == 429
+            assert r.headers.get("Retry-After") == "2"
+            r = await s.post(
+                "/v1/chat/completions", json={"model": "full", **body}
+            )
+            assert r.status == 503
+            assert r.headers.get("Retry-After") == "3"
+            assert (await r.json())["error"]["type"] == "server_error"
+    finally:
+        await svc.stop()
+
+
+async def test_nonstreaming_queue_timeout_converts_to_429():
+    """A zero-token all-`timeout` aggregate (deadline died in the
+    admission queue) becomes a REAL 429 on the non-streaming path."""
+
+    class QueueTimeoutEngine:
+        async def generate(self, ctx):
+            async def _gen():
+                yield {
+                    "id": "x", "object": "chat.completion.chunk",
+                    "choices": [{
+                        "index": 0, "delta": {}, "finish_reason": "timeout",
+                    }],
+                }
+
+            return _gen()
+
+    svc = HttpService()
+    svc.manager.add_chat_model("q", QueueTimeoutEngine())
+    await svc.start("127.0.0.1", 0)
+    try:
+        import aiohttp
+
+        async with aiohttp.ClientSession(f"http://127.0.0.1:{svc.port}") as s:
+            r = await s.post(
+                "/v1/chat/completions",
+                json={"model": "q", "messages": [{"role": "user", "content": "x"}]},
+            )
+            assert r.status == 429
+            assert r.headers.get("Retry-After") == "1"
+    finally:
+        await svc.stop()
+
+
+async def test_global_health_counters_render_via_extra():
+    from dynamo_tpu.utils import counters
+    from dynamo_tpu.utils.counters import PromCounters
+
+    counters.reset()
+    try:
+        async with http_service() as (svc, session):
+            svc.metrics.extra.append(PromCounters())
+            counters.inc("hub_reconnects_total")
+            r = await session.get("/metrics")
+            text = await r.text()
+            assert "dynamo_tpu_hub_reconnects_total 1.0" in text
+            # known counters render 0 before first increment
+            assert "dynamo_tpu_lease_expired_total 0.0" in text
+            assert "dynamo_tpu_breaker_open_total 0.0" in text
+    finally:
+        counters.reset()

@@ -278,3 +278,93 @@ async def test_frontend_kv_mode_e2e():
             await engine.close()
             await worker.shutdown()
             await frontend.shutdown()
+
+
+# ------------------------------------- health-aware candidate filtering
+# (fault-tolerance spine: stale heartbeats / open breakers leave the
+# pick set; empty pool falls back to all — docs/robustness.md)
+
+
+def test_aggregator_stale_workers_horizon():
+    import time as _time
+
+    from dynamo_tpu.llm.kv_router.metrics_aggregator import (
+        KvMetricsAggregator,
+    )
+
+    agg = KvMetricsAggregator(client=None, poll_interval=1.0)
+    assert agg.stale_after == 3.0
+    # a never-seen worker is NOT stale on first sight (routable before
+    # its first scrape) but its horizon starts ticking
+    assert agg.stale_workers([1, 2]) == set()
+    assert set(agg.last_seen) == {1, 2}
+    # age one worker past the horizon
+    agg.last_seen[1] = _time.monotonic() - 10.0
+    agg.last_seen[2] = _time.monotonic()
+    assert agg.stale_workers([1, 2]) == {1}
+    # instance-down resets the record
+    agg.mark_gone(1)
+    assert agg.stale_workers([1]) == set()
+
+
+async def test_router_excludes_stale_and_open_breaker_workers():
+    from dynamo_tpu.llm.kv_router import KvRouter
+    from dynamo_tpu.utils import counters as _counters
+
+    class _NS:
+        name = "ns"
+
+    class _Comp:
+        namespace = _NS()
+        name = "comp"
+
+        async def publish(self, subject, data):
+            return 0
+
+    class _EID:
+        subject = "ns.comp.ep"
+
+    class _FakeClient:
+        endpoint_id = _EID()
+
+        def __init__(self):
+            self.open = set()
+
+        def instance_ids(self):
+            return [1, 2, 3]
+
+        def breaker_open(self, wid):
+            return wid in self.open
+
+    _counters.reset()
+    client = _FakeClient()
+    router = KvRouter(component=None, client=client, block_size=4)
+    router.component = _Comp()
+
+    # all healthy: nobody excluded
+    assert router._healthy_candidates([1, 2, 3]) == [1, 2, 3]
+
+    # stale heartbeat excludes worker 1
+    import time as _time
+
+    router.aggregator.last_seen.update(
+        {1: _time.monotonic() - 99.0, 2: _time.monotonic(),
+         3: _time.monotonic()}
+    )
+    assert router._healthy_candidates([1, 2, 3]) == [2, 3]
+    assert _counters.get("router_workers_excluded_total") == 1.0
+
+    # open breaker excludes worker 2 as well
+    client.open = {2}
+    assert router._healthy_candidates([1, 2, 3]) == [3]
+
+    # everything unhealthy: fall back to the full set (availability
+    # over a wrongly-pessimistic health view)
+    client.open = {2, 3}
+    assert router._healthy_candidates([1, 2, 3]) == [1, 2, 3]
+
+    # scheduling end-to-end picks only healthy workers
+    client.open = {2}
+    decision = await router.schedule([1, 2, 3, 4])
+    assert decision.worker_id == 3
+    _counters.reset()

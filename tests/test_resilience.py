@@ -1,0 +1,241 @@
+"""Transport resilience tests: backoff jitter, circuit-breaker state
+machine, retry_async, and the runtime Client's retry + breaker-aware
+instance picking (fake data plane — no sockets)."""
+
+import asyncio
+import random
+
+import pytest
+
+from dynamo_tpu.runtime.client import Client, NoInstancesError
+from dynamo_tpu.runtime.component import EndpointId, InstanceInfo
+from dynamo_tpu.runtime.resilience import Backoff, CircuitBreaker
+from dynamo_tpu.utils import counters
+
+
+@pytest.fixture(autouse=True)
+def _clean_counters():
+    counters.reset()
+    yield
+    counters.reset()
+
+
+# ------------------------------------------------------------- Backoff
+
+def test_backoff_jitter_bounds_and_cap():
+    b = Backoff(base=0.1, cap=0.5, factor=2.0, rng=random.Random(1))
+    for attempt in range(8):
+        cap = min(0.5, 0.1 * 2.0 ** attempt)
+        for _ in range(20):
+            d = b.delay(attempt)
+            assert 0.0 <= d <= cap
+
+
+def test_backoff_jitter_spreads():
+    b = Backoff(base=1.0, cap=10.0, rng=random.Random(2))
+    ds = {round(b.delay(0), 6) for _ in range(16)}
+    assert len(ds) > 8, "full jitter must not produce lockstep delays"
+
+
+# ------------------------------------------------------- CircuitBreaker
+
+def test_breaker_state_machine():
+    t = [0.0]
+    br = CircuitBreaker(threshold=3, cooldown_s=5.0, clock=lambda: t[0])
+    assert br.state == "closed" and br.allow()
+    for _ in range(2):
+        br.record_failure()
+    assert br.state == "closed", "below threshold stays closed"
+    br.record_failure()
+    assert br.state == "open"
+    assert not br.allow()
+    assert counters.get("breaker_open_total") == 1.0
+
+    t[0] = 5.0  # cooldown elapsed -> half-open, exactly one probe
+    assert br.state == "half_open"
+    assert br.allow()
+    assert not br.allow(), "half-open admits ONE probe"
+
+    br.record_failure()  # probe failed -> open again, cooldown restarts
+    assert br.state == "open"
+    t[0] = 9.0
+    assert br.state == "open", "cooldown restarted at the failed probe"
+    t[0] = 10.0
+    assert br.allow()
+    br.record_success()  # probe succeeded -> closed, counters reset
+    assert br.state == "closed"
+    br.record_failure()
+    assert br.state == "closed", "failure count restarted after close"
+
+
+def test_breaker_success_resets_failure_streak():
+    br = CircuitBreaker(threshold=3)
+    br.record_failure()
+    br.record_failure()
+    br.record_success()
+    br.record_failure()
+    br.record_failure()
+    assert br.state == "closed", "non-consecutive failures must not trip"
+
+
+def test_breaker_probe_claim_expires():
+    """A claimed half-open probe that never reports back must not wedge
+    the breaker: the claim expires after cooldown_s and the next caller
+    gets the probe slot."""
+    t = [0.0]
+    br = CircuitBreaker(threshold=1, cooldown_s=5.0, clock=lambda: t[0])
+    br.record_failure()
+    t[0] = 5.0
+    assert br.allow()          # probe claimed... and then lost
+    assert not br.allow()
+    t[0] = 10.0
+    assert br.allow(), "stale probe claim must expire"
+
+
+# ------------------------------------------------- Client integration
+
+class _FakeHandle:
+    def __init__(self, items):
+        self._items = list(items)
+
+    def __aiter__(self):
+        async def _it():
+            for x in self._items:
+                yield x
+        return _it()
+
+    async def stop(self):
+        pass
+
+    async def kill(self):
+        pass
+
+
+class _FakeDataPlane:
+    """request() fails with ConnectionError for addresses in `down`."""
+
+    def __init__(self, down=()):
+        self.down = set(down)
+        self.calls = []
+
+    async def request(self, address, subject, payload, request_id=None,
+                      metadata=None):
+        self.calls.append(address)
+        if address in self.down:
+            raise ConnectionError(f"{address} unreachable")
+        from dynamo_tpu.runtime.component import pack_payload
+
+        return _FakeHandle([pack_payload({"from": address})])
+
+
+class _FakeDrt:
+    def __init__(self, down=()):
+        self.data_plane_client = _FakeDataPlane(down)
+
+    def notify_instance_down(self, endpoint_id, worker_id):
+        pass
+
+
+def _client(drt, n_instances=2) -> Client:
+    eid = EndpointId("ns", "comp", "ep")
+    c = Client(drt, eid)
+    for wid in range(n_instances):
+        c.instances[wid] = InstanceInfo(
+            endpoint=eid.subject, address=f"addr-{wid}", worker_id=wid,
+            lease_id=0,
+        )
+    return c
+
+
+async def test_client_retries_on_other_instance():
+    drt = _FakeDrt(down={"addr-0"})
+    c = _client(drt)
+    c._backoff = Backoff(base=0.0, cap=0.0)
+    # force the first pick onto the dead instance (round_robin from
+    # _rr_index=1 picks ids[0] first... make it deterministic: random
+    # mode with both instances; retry must EXCLUDE the failed one)
+    outs = []
+    for _ in range(4):
+        stream = await c.generate({"x": 1}, mode="round_robin")
+        async for item in stream:
+            outs.append(item)
+    assert all(o == {"from": "addr-1"} for o in outs)
+    assert counters.get("client_retries_total") >= 1.0
+    assert c.breaker(0)._failures >= 1 or c.breaker(0).state != "closed"
+
+
+async def test_client_open_breaker_excluded_from_pick():
+    drt = _FakeDrt()
+    c = _client(drt)
+    br = c.breaker(0)
+    for _ in range(br.threshold):
+        br.record_failure()
+    assert br.state == "open"
+    for _ in range(6):
+        info = c._pick("random", None)
+        assert info.worker_id == 1, "open breaker must leave the pick set"
+
+
+async def test_client_pick_does_not_burn_unpicked_half_open_probes():
+    """Regression: _pick must not call allow() as a pool-wide filter —
+    that claims every half-open instance's single probe slot, stranding
+    recovered-but-unpicked workers out of rotation forever."""
+    drt = _FakeDrt()
+    c = _client(drt)
+    t = [0.0]
+    br0 = CircuitBreaker(threshold=1, cooldown_s=5.0, clock=lambda: t[0],
+                         name="w0")
+    c._breakers[0] = br0
+    br0.record_failure()       # open
+    t[0] = 5.0                 # half-open: one probe available
+    # many picks that all land on the healthy worker 1 must leave
+    # worker 0's probe slot unclaimed
+    for _ in range(8):
+        info = c._pick("round_robin", None)
+        if info.worker_id == 0:
+            break
+    assert not br0._probing or info.worker_id == 0, (
+        "unpicked half-open worker lost its probe slot"
+    )
+    # and once worker 0 IS picked, its probe claim + success closes it
+    br0._probing = False
+    for _ in range(8):
+        info = c._pick("random", None)
+        if info.worker_id == 0:
+            br0.record_success()
+            break
+    assert br0.state in ("closed", "half_open")
+
+
+async def test_client_all_breakers_open_falls_back():
+    drt = _FakeDrt()
+    c = _client(drt)
+    for wid in (0, 1):
+        br = c.breaker(wid)
+        for _ in range(br.threshold):
+            br.record_failure()
+    info = c._pick("random", None)  # availability over pessimism
+    assert info.worker_id in (0, 1)
+
+
+async def test_client_direct_mode_does_not_retry():
+    drt = _FakeDrt(down={"addr-0"})
+    c = _client(drt)
+    with pytest.raises(ConnectionError):
+        await c.generate({"x": 1}, mode="direct", instance_id=0)
+    assert drt.data_plane_client.calls == ["addr-0"], "no silent failover"
+
+
+async def test_client_exhausted_retries_raise():
+    drt = _FakeDrt(down={"addr-0", "addr-1"})
+    c = _client(drt)
+    c._backoff = Backoff(base=0.0, cap=0.0)
+    with pytest.raises(ConnectionError):
+        await c.generate({"x": 1}, mode="round_robin")
+    assert len(drt.data_plane_client.calls) == c.max_attempts
+
+
+async def test_client_no_instances():
+    c = _client(_FakeDrt(), n_instances=0)
+    with pytest.raises(NoInstancesError):
+        await c.generate({"x": 1})
