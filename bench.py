@@ -72,6 +72,11 @@ PIPE = MIXED or os.environ.get("BENCH_PIPELINE", "") not in ("", "0")
 # the stdout line stays the one-line headline artifact. Downstream
 # trajectory tooling parses the file, not stdout.
 BENCH_OUT = os.environ.get("BENCH_OUT", "")
+# SLO target for the goodput section: tokens only count as "good" when
+# their request's client TTFT met this budget — throughput that blows
+# the latency target is not serving capacity (goodput accounting,
+# docs/observability.md "Fleet plane")
+SLO_TTFT = float(os.environ.get("BENCH_SLO_TTFT", "2.0"))
 # BENCH_TRACE=path: arm the span recorder (dynamo_tpu/utils/tracing.py)
 # for the whole run and dump Chrome/Perfetto trace-event JSON there at
 # exit — request spans (submit->finish) plus the engine step timeline
@@ -115,13 +120,19 @@ ENV_HELP = """bench.py — serving benchmark; configuration via env vars:
   BENCH_OUT                    path: write a machine-readable JSON file
                                with every section's numbers keyed as
                                {headline, spec, mixed, mixed_spec,
-                               pipeline_ab} (sections not run are
-                               null); stdout keeps the one-line
-                               headline artifact
+                               pipeline_ab, goodput} (sections not run
+                               are null; goodput always present:
+                               SLO-gated throughput + the per-request
+                               prefix/offload ledgers of the probes);
+                               stdout keeps the one-line headline
+                               artifact
   BENCH_TRACE                  path: record the whole run with the span
                                recorder (utils/tracing.py) and dump
                                Perfetto-loadable trace-event JSON there
                                (request spans + engine step timeline)
+  BENCH_SLO_TTFT               goodput TTFT budget in seconds (2.0):
+                               the goodput section counts a request's
+                               tokens only when its TTFT met this
   (BENCH_MIXED=1 BENCH_SPEC=1 together add the COMPOSED spec x mixed
   A/B: repetitive held streams + an admission wave, mixed-only vs
   mixed+spec — ragged verify rows inside the mixed steps)
@@ -215,6 +226,40 @@ def main() -> None:
     # the HEADLINE wave and muddy the baseline numbers
     engine.config.spec_decode = False
     n_params = engine.param_count
+
+    # goodput accounting: every finished request's summary (latency +
+    # the per-request prefix/offload ledger stamped at page
+    # reservation) collects here; the probes below snapshot index
+    # ranges to attribute ledgers to their wave — the data that finally
+    # EXPLAINS a prefix-hit ratio instead of just reporting it
+    summaries: list = []
+    engine.subscribe_requests(summaries.append)
+    goodput: dict = {}
+
+    def ledger_agg(batch):
+        pf = [s.get("prefix") or {} for s in batch]
+        reasons: dict = {}
+        for p in pf:
+            r = p.get("gate_reason")
+            if r:
+                reasons[r] = reasons.get(r, 0) + 1
+        return {
+            "requests": len(batch),
+            "reused_blocks": sum(p.get("reused_blocks", 0) for p in pf),
+            "restored_blocks": sum(p.get("restored_blocks", 0) for p in pf),
+            "declined_blocks": sum(p.get("declined_blocks", 0) for p in pf),
+            "gate_reasons": reasons,
+            # per-request rows (capped): which requests reused/restored
+            # how many blocks — the request-level ledger
+            "per_request": [
+                {
+                    "request": (s.get("request_id") or "")[:8],
+                    "prompt_tokens": s.get("prompt_tokens"),
+                    **(s.get("prefix") or {}),
+                }
+                for s in batch[:32]
+            ],
+        }
 
     rng = np.random.RandomState(0)
 
@@ -629,8 +674,17 @@ def main() -> None:
         if FAST:
             probe = rng.randint(1, cfg.vocab_size, size=ISL).tolist()
             cold, warm = {}, {}
+            i0 = len(summaries)
             await one(probe, cold)
+            i1 = len(summaries)
             await one(probe, warm)
+            goodput["prefix_probe"] = {
+                "cold": {**ledger_agg(summaries[i0:i1]),
+                         "ttft_p50_s": round(cold["ttft"], 4)},
+                "warm": {**ledger_agg(summaries[i1:]),
+                         "ttft_p50_s": round(warm["ttft"], 4)},
+                "ttft_speedup": round(_probe_ratio(cold, warm), 3),
+            }
             return (
                 records, wall, wall_spread, phase_delta,
                 None, None,
@@ -668,21 +722,37 @@ def main() -> None:
         await asyncio.gather(*(one(p, {}) for p in set_a))
         set_b = probe_prompts()
         cold_recs = [dict() for _ in range(n_probe)]
+        i_cold = len(summaries)
         tpx = time.perf_counter()
         await asyncio.gather(
             *(one(p, r) for p, r in zip(set_b, cold_recs))
         )
         prefix_cold_wall = time.perf_counter() - tpx
         warm_recs = [dict() for _ in range(n_probe)]
+        i_warm = len(summaries)
         tpx = time.perf_counter()
         await asyncio.gather(
             *(one(p, r) for p, r in zip(set_b, warm_recs))
         )
         prefix_warm_wall = time.perf_counter() - tpx
+        i_end = len(summaries)
         cold = {"ttft": float(np.percentile(
             [r["ttft"] for r in cold_recs], 50))}
         warm = {"ttft": float(np.percentile(
             [r["ttft"] for r in warm_recs], 50))}
+        # per-request ledger of the probe waves: the warm wave's
+        # reused_blocks tell exactly how much prefill the prefix cache
+        # actually skipped — a 0.68x "speedup" with full reuse points at
+        # dispatch/queue overhead, with zero reuse at eviction
+        goodput["prefix_probe"] = {
+            "cold": {**ledger_agg(summaries[i_cold:i_warm]),
+                     "ttft_p50_s": round(cold["ttft"], 4),
+                     "wall_s": round(prefix_cold_wall, 4)},
+            "warm": {**ledger_agg(summaries[i_warm:i_end]),
+                     "ttft_p50_s": round(warm["ttft"], 4),
+                     "wall_s": round(prefix_warm_wall, 4)},
+            "ttft_speedup": round(_probe_ratio(cold, warm), 3),
+        }
 
         # ---- host-tier offload probe (BASELINE.md's +40% TTFT claim):
         # serve a fresh prompt, wait for its pages to write-through to
@@ -726,9 +796,20 @@ def main() -> None:
         offloaded = await await_offloaded(oprobe)
         # evict every evictable HBM page (incl. the probe's)
         evict_all()
+        i_ow = len(summaries)
         await one(oprobe, owarm)
         engine.offload_paused = True
         offload_speedup = _probe_ratio(ocold, owarm) if offloaded else None
+        # the re-serve's ledger says whether the tier RESTORED or the
+        # gate declined (and why) — the "restored: 0, declined: 0"
+        # blindness of BENCH_r06 becomes an attributed decision
+        goodput["offload_probe"] = {
+            "offloaded": bool(offloaded),
+            "warm": ledger_agg(summaries[i_ow:]),
+            "ttft_speedup": (
+                round(offload_speedup, 3) if offload_speedup else None
+            ),
+        }
 
         # ---- paced (Poisson) arrivals: the reference benches with
         # genai-perf's paced load (perf.sh:22-46); closed-loop-burst TTFT
@@ -792,6 +873,21 @@ def main() -> None:
     ttft_p50 = float(np.percentile([r["ttft"] for r in records], 50))
     itls = [r["itl"] for r in records if r["itl"] is not None]
     itl_p50 = float(np.percentile(itls, 50)) if itls else 0.0
+
+    # SLO goodput over the measured wave: a request's tokens count only
+    # when its client TTFT met the budget (exactly-at attains) — the
+    # number the SLO-driven planner should defend, as opposed to raw
+    # throughput which can look healthy while every request breaches
+    good = [r for r in records if r["ttft"] <= SLO_TTFT]
+    goodput["slo"] = {
+        "ttft_target_s": SLO_TTFT,
+        "attained_frac": round(len(good) / len(records), 4),
+        "goodput_toks_per_sec_chip": round(
+            sum(r["tokens"] for r in good) / wall / n_chips, 2
+        ),
+        "throughput_toks_per_sec_chip": round(toks_per_sec_chip, 2),
+    }
+    goodput["offload_gate"] = dict(engine.offload_gate_stats)
 
     def p50(recs, key):
         vals = [r[key] for r in recs if r.get(key) is not None]
@@ -886,6 +982,9 @@ def main() -> None:
                     }),
                     # wave-based cold/warm p50 TTFT + wall on identical
                     # prompt sets (prefix cache under real queuing)
+                    # SLO-gated goodput (BENCH_SLO_TTFT budget): tokens
+                    # from requests whose TTFT met the target
+                    "slo_goodput": goodput.get("slo"),
                     "prefix_hit_ttft_speedup": round(prefix_speedup["ttft"], 2),
                     "prefix_hit_wall_speedup": (
                         round(prefix_speedup["wall"], 2)
@@ -936,6 +1035,10 @@ def main() -> None:
                     "mixed": mixed_result,
                     "mixed_spec": mixed_spec_result,
                     "pipeline_ab": pipeline_result,
+                    # goodput accounting (always present): SLO-gated
+                    # throughput over the measured wave + the
+                    # per-request prefix/offload ledgers of the probes
+                    "goodput": goodput,
                 },
                 f,
                 indent=2,

@@ -75,6 +75,9 @@ class PageAllocator:
         # counters for metrics / hit-rate
         self.lookups = 0
         self.hits = 0
+        # high-water mark of referenced (refs>0) pages — the telemetry
+        # plane's "how close did this pool ever get to exhaustion"
+        self.peak_used = 0
 
     # ---- queries ------------------------------------------------------
 
@@ -86,6 +89,31 @@ class PageAllocator:
     @property
     def num_active(self) -> int:
         return len(self._meta)
+
+    @property
+    def pages_free(self) -> int:
+        """Pages on the free list proper (contents dead); `num_free`
+        additionally counts evictable cached pages."""
+        return len(self._free)
+
+    @property
+    def pages_cached(self) -> int:
+        """Hashed pages at refs==0: reusable by prefix match, evictable
+        under pressure — occupied-but-reclaimable capacity."""
+        return len(self._lru)
+
+    @property
+    def pages_used(self) -> int:
+        """Pages referenced by live sequences (refs > 0)."""
+        return len(self._meta) - len(self._lru)
+
+    def fragmentation(self) -> float:
+        """Fraction of occupied pages that are cached rather than live:
+        0.0 = every occupied page serves a running sequence, 1.0 = the
+        pool is all cold cache. High fragmentation + allocation failures
+        means eviction churn, not true capacity exhaustion."""
+        occupied = len(self._meta)
+        return len(self._lru) / occupied if occupied else 0.0
 
     def usage(self) -> float:
         usable = self.num_pages - 1
@@ -120,6 +148,7 @@ class PageAllocator:
         if meta.refs == 0:
             self._lru.pop(sequence_hash, None)
         meta.refs += 1
+        self.peak_used = max(self.peak_used, self.pages_used)
         return pid
 
     def peek_prefix_tokens(
@@ -162,6 +191,7 @@ class PageAllocator:
         pages = [self._free.popleft() for _ in range(n)]
         for pid in pages:
             self._meta[pid] = PageMeta(refs=1)
+        self.peak_used = max(self.peak_used, self.pages_used)
         return pages
 
     def register(

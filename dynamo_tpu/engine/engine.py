@@ -46,10 +46,8 @@ from __future__ import annotations
 
 import asyncio
 import itertools
-import json
 import logging
 import os
-import tempfile
 import threading
 import time
 from collections import deque
@@ -82,9 +80,10 @@ from dynamo_tpu.ops.sampling import (
     sample_tokens,
     verify_draft_tokens,
 )
+from dynamo_tpu.engine import telemetry
 from dynamo_tpu.parallel import mesh as meshmod
 from dynamo_tpu.runtime.pipeline.context import Context
-from dynamo_tpu.utils import faults, tracing
+from dynamo_tpu.utils import artifacts, faults, instance, tracing
 
 log = logging.getLogger("dynamo_tpu.engine")
 
@@ -151,6 +150,17 @@ class JaxEngine:
         self.config = config
         self.model_cfg = config.model_config()
         self._dtype = jnp.bfloat16 if config.dtype == "bfloat16" else jnp.float32
+
+        # fleet observability (docs/observability.md "Fleet plane"):
+        # mint the process's stable instance label (it stamps JSONL
+        # logs, Prometheus series and the hub registration), claim the
+        # trace process label unless the run mode already did, and arm
+        # the process-wide compile-event listener so every jit cache
+        # miss lands as an `engine.compile` span + counter instead of a
+        # silent multi-second stall.
+        self.worker_label = instance.worker_id()
+        tracing.set_process_default(f"worker-{self.worker_label}")
+        telemetry.install_compile_listener()
 
         meshmod.validate_model_mesh(self.model_cfg, config.mesh)
         self.mesh = meshmod.build_mesh(config.mesh, devices)
@@ -452,7 +462,7 @@ class JaxEngine:
         # from real traffic (first restore always runs).
         self._ema_restore_bps: Optional[float] = None
         self._ema_prefill_tps: Optional[float] = None
-        self.offload_gate_stats = {"restored": 0, "declined": 0}
+        self.offload_gate_stats = {"restored": 0, "declined": 0, "failed": 0}
         # strong refs to fire-and-forget calibration tasks (the loop
         # holds tasks only weakly; an unreferenced one can be GC'd
         # mid-flight and silently drop its EMA update)
@@ -879,6 +889,17 @@ class JaxEngine:
         active = sum(1 for s in self.slots if s is not None)
         usable = self.num_pages - 1
         ps = self._phase_stats
+        # device-time vs host-wall split (telemetry plane): dispatch
+        # walls serialize against the device tunnel, sync walls are true
+        # host stalls waiting on results — their sum over the total step
+        # wall approximates device occupancy vs host-side build time
+        device_s = (
+            ps["prefill_dispatch_s"] + ps["decode_dispatch_s"]
+            + ps["spec_dispatch_s"] + ps["mixed_dispatch_s"]
+        )
+        stall_s = (
+            ps["decode_sync_s"] + ps["spec_sync_s"] + ps["mixed_sync_s"]
+        )
         return {
             "request_active_slots": active,
             "request_total_slots": len(self.slots),
@@ -887,6 +908,36 @@ class JaxEngine:
             "num_requests_waiting": len(self.waiting),
             "gpu_cache_usage_perc": self.allocator.usage(),
             "gpu_prefix_cache_hit_rate": self.allocator.hit_rate(),
+            # KV pool telemetry (engine/allocator.py): live vs cached vs
+            # free pages, the pool's high-water mark, slot occupancy and
+            # fragmentation (cached share of occupied pages — high here
+            # plus allocation failures = eviction churn, not capacity)
+            "kv_pages_used": self.allocator.pages_used,
+            "kv_pages_cached": self.allocator.pages_cached,
+            "kv_pages_free": self.allocator.pages_free,
+            "kv_pages_peak_used": self.allocator.peak_used,
+            "kv_fragmentation": round(self.allocator.fragmentation(), 4),
+            "slot_occupancy": (
+                round(active / len(self.slots), 4) if self.slots else 0.0
+            ),
+            # host offload tier + restore gate (engine/offload.py):
+            # request-level detail rides the finish summaries' ledger
+            "offload_host_pages": (
+                len(self.host_pool) if self.host_pool is not None else 0
+            ),
+            "offload_restored": self.offload_gate_stats["restored"],
+            "offload_declined": self.offload_gate_stats["declined"],
+            "offload_restore_failed": self.offload_gate_stats["failed"],
+            # jit compile telemetry (engine/telemetry.py, process-wide):
+            # cache misses and the wall they burned — the silent
+            # multi-second stalls, now countable and traceable
+            **telemetry.compile_stats(),
+            # HBM gauges from device memory_stats(); absent on backends
+            # that expose none (CPU)
+            **telemetry.device_memory_stats(),
+            # device-time vs host-stall split per step walls
+            "step_device_s": round(device_s, 4),
+            "step_stall_s": round(stall_s, 4),
             # speculative decode health (ForwardPassMetrics.from_dict
             # drops unknown keys, so the router wire stays compatible)
             "spec_acceptance_rate": (
@@ -1754,17 +1805,9 @@ class JaxEngine:
         """Write the PR-4 trace ring + phase stats + metrics snapshot
         next to the hang, so the postmortem does not depend on the
         process surviving to serve /debug/trace. Best-effort: artifact
-        IO must never take the watchdog down."""
+        IO must never take the watchdog down (the shared writer,
+        utils/artifacts.py, swallows IO failures)."""
         try:
-            crash_dir = (
-                self.config.crash_dir
-                or os.environ.get("DYN_CRASH_DIR")
-                or tempfile.gettempdir()
-            )
-            os.makedirs(crash_dir, exist_ok=True)
-            path = os.path.join(
-                crash_dir, f"engine_watchdog_{int(time.time() * 1000)}.json"
-            )
             artifact = {
                 "op": label,
                 "stalled_s": round(stalled_s, 3),
@@ -1779,13 +1822,15 @@ class JaxEngine:
                 ],
                 "trace": tracing.export(),
             }
-            with open(path, "w") as f:
-                json.dump(artifact, f)
-            self.last_crash_artifact = path
-            return path
         except Exception:  # noqa: BLE001 — the dump is best-effort
             log.exception("watchdog crash-artifact dump failed")
             return None
+        path = artifacts.write_crash_artifact(
+            "engine_watchdog", artifact, directory=self.config.crash_dir
+        )
+        if path is not None:
+            self.last_crash_artifact = path
+        return path
 
     def _shed_expired_waiting(self) -> bool:
         """Reject admission-queue requests whose deadline has passed —
@@ -2127,6 +2172,12 @@ class JaxEngine:
         except faults.FaultError:
             return False
         t = seq.total_tokens
+        # fresh reservation, fresh ledger: a preemption-resume must not
+        # carry a previous attempt's decline into the summary next to
+        # this reservation's reuse numbers (the reused/restored fields
+        # are restamped below; the decline branches may never run again)
+        seq.blocks_declined = 0
+        seq.gate_reason = ""
         hashes = seq.blocks.sequence_hashes()
         cap = seq.cacheable_pages(self.page_size)
         if cap is not None and hashes:
@@ -2154,18 +2205,47 @@ class JaxEngine:
             # than recomputing the prefix — the tier must never make
             # TTFT worse (pages stay host-side for a cheaper future hit)
             self.offload_gate_stats["declined"] += 1
+            seq.blocks_declined = len(host_run)
+            seq.gate_reason = "restore_slower_than_recompute"
+            if tracing.enabled():
+                tracing.instant(
+                    "offload.gate", cat="kv", req=seq.ctx.id,
+                    decision="declined", blocks=len(host_run),
+                    reason=seq.gate_reason,
+                )
             host_run = []
         if host_run:
             try:
                 self._restore_from_host(seq, fresh[: len(host_run)], len(matched))
             except Exception:
-                # restore is an optimization; fall back to recompute
+                # restore is an optimization; fall back to recompute —
+                # counted and traced like a gate decline so the
+                # aggregate gauges agree with the per-request ledgers
                 log.exception("host-tier restore failed; recomputing")
+                self.offload_gate_stats["failed"] += 1
+                seq.blocks_declined = len(host_run)
+                seq.gate_reason = "restore_failed"
+                if tracing.enabled():
+                    tracing.instant(
+                        "offload.gate", cat="kv", req=seq.ctx.id,
+                        decision="failed", blocks=len(host_run),
+                        reason=seq.gate_reason,
+                    )
                 host_run = []
         seq.page_ids = matched + fresh
         seq.num_cached = (len(matched) + len(host_run)) * self.page_size
         seq.num_computed = seq.num_cached
         seq.registered_pages = len(matched) + len(host_run)
+        # per-request ledger (finish-summary `prefix` section): reflects
+        # the LAST reservation — a preemption-resume restamps it with
+        # what the re-admission actually reused
+        seq.blocks_reused = len(matched)
+        seq.blocks_restored = len(host_run)
+        if host_run and tracing.enabled():
+            tracing.instant(
+                "offload.gate", cat="kv", req=seq.ctx.id,
+                decision="restored", blocks=len(host_run),
+            )
         return True
 
     # ---- prefill ------------------------------------------------------
@@ -4278,6 +4358,17 @@ class JaxEngine:
             "finish_reason": reason,
             "prompt_tokens": seq.prompt_len,
             "tokens": seq.generated,
+            "tenant": seq.tenant,
+            # prefix/offload ledger (stamped at page reservation): HBM
+            # prefix blocks reused, host-tier blocks restored, host hits
+            # the restore gate declined (+ why) — per-request truth the
+            # bench goodput section and dashboards aggregate
+            "prefix": {
+                "reused_blocks": seq.blocks_reused,
+                "restored_blocks": seq.blocks_restored,
+                "declined_blocks": seq.blocks_declined,
+                "gate_reason": seq.gate_reason,
+            },
             "queue_wait_s": (
                 seq.t_admit - seq.t_submit
                 if seq.t_admit and seq.t_submit else None

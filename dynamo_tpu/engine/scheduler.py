@@ -90,6 +90,18 @@ class Sequence:
     # request_timeout_s default; checked by the admission shed and the
     # cancellation sweep (docs/robustness.md "Deadlines").
     deadline: float = 0.0
+    # per-request prefix/offload ledger (stamped at page reservation,
+    # reported in the finish summary): HBM prefix pages reused, host-tier
+    # pages restored, host-tier hits the restore cost gate declined (and
+    # why) — the request-level explanation behind the aggregate
+    # prefix-hit / offload-gate numbers (docs/observability.md).
+    blocks_reused: int = 0
+    blocks_restored: int = 0
+    blocks_declined: int = 0
+    gate_reason: str = ""
+    # tenant label for per-tenant SLO attainment (Context metadata
+    # "tenant", stamped by the HTTP frontend from x-tenant-id)
+    tenant: str = "default"
 
     # per-request sampling (resolved once at admission)
     temperature: float = 0.0
@@ -187,6 +199,9 @@ class Sequence:
 
             seq.prompt_embeds = np.asarray(pre.prompt_embeds, np.float32)
             seq.embeds_offset = int(pre.embeds_offset)
+        tenant = ctx.metadata.get("tenant")
+        if tenant:
+            seq.tenant = str(tenant)
         # deadline rides Context metadata across hops (the HTTP frontend
         # stamps it from x-request-timeout; see llm/http/service.py)
         try:

@@ -1,0 +1,107 @@
+"""Engine-side device telemetry: HBM usage + jit compile events.
+
+The two silent killers of TPU serving latency are invisible in the PR-4
+spine: HBM pressure (an auto-sized KV pool can sit a few percent from
+OOM with nothing exported) and jit cache misses (a cold shape family is
+a multi-second stall that reads as one mysteriously slow request). This
+module surfaces both:
+
+- **`device_memory_stats()`** wraps `jax` device ``memory_stats()`` into
+  flat gauges (``hbm_bytes_in_use`` / ``hbm_bytes_limit`` /
+  ``hbm_utilization``). CPU backends return no stats — the dict is empty
+  there, and `Engine.metrics()` simply omits the series (the Prometheus
+  checker treats absent-on-CPU as fine, zero-series rules apply to
+  registered counters, not platform-gated gauges).
+- **`install_compile_listener()`** registers a process-wide
+  `jax.monitoring` duration listener counting XLA backend compiles and
+  their wall time, and — when tracing is armed — records each one as an
+  ``engine.compile`` complete event on its own track, so the
+  multi-second gaps in a step timeline finally carry a name. Idempotent;
+  the listener is process-global because compilation is (one jit cache
+  per process, however many engines).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+import jax
+
+from dynamo_tpu.utils import tracing
+
+# jax monitoring event key for an XLA backend compile (jit cache miss).
+# The other /jax/core/compile/* keys (jaxpr trace, MLIR lowering) are
+# host-side and cheap; backend_compile is the multi-second one.
+_COMPILE_KEY = "/jax/core/compile/backend_compile_duration"
+
+_lock = threading.Lock()
+_installed = False
+_compile_events = 0
+_compile_time_s = 0.0
+
+
+def _on_event_duration(name: str, duration_s: float, **_kw) -> None:
+    global _compile_events, _compile_time_s
+    if name != _COMPILE_KEY:
+        return
+    with _lock:
+        _compile_events += 1
+        _compile_time_s += duration_s
+    if tracing.enabled():
+        t1 = time.perf_counter()
+        tracing.complete(
+            "engine.compile", t1 - duration_s, t1, cat="compile",
+            track="engine.compile", duration_s=round(duration_s, 4),
+        )
+
+
+def install_compile_listener() -> None:
+    """Register the compile listener once per process (idempotent)."""
+    global _installed
+    with _lock:
+        if _installed:
+            return
+        _installed = True
+    try:
+        jax.monitoring.register_event_duration_secs_listener(
+            _on_event_duration
+        )
+    except Exception:  # noqa: BLE001 — telemetry must never block init
+        pass
+
+
+def compile_stats() -> dict:
+    """Cumulative compile gauges for `Engine.metrics()`."""
+    with _lock:
+        return {
+            "compile_events": _compile_events,
+            "compile_time_s": round(_compile_time_s, 4),
+        }
+
+
+def device_memory_stats(device=None) -> dict:
+    """Flat HBM gauges from the device's ``memory_stats()``; empty when
+    the backend exposes none (CPU) or the probe fails (a scrape must
+    never 500 on telemetry)."""
+    try:
+        dev = device if device is not None else jax.local_devices()[0]
+        stats = dev.memory_stats()
+    except Exception:  # noqa: BLE001
+        return {}
+    if not stats:
+        return {}
+    out = {}
+    in_use = stats.get("bytes_in_use")
+    limit = stats.get("bytes_limit")
+    if in_use is not None:
+        out["hbm_bytes_in_use"] = int(in_use)
+    if limit:
+        out["hbm_bytes_limit"] = int(limit)
+        if in_use is not None:
+            out["hbm_utilization"] = round(in_use / limit, 4)
+    peak = stats.get("peak_bytes_in_use")
+    if peak is not None:
+        out["hbm_peak_bytes_in_use"] = int(peak)
+    return out

@@ -29,6 +29,7 @@ import numpy as np
 
 from dynamo_tpu.llm.protocols.common import PreprocessedRequest
 from dynamo_tpu.runtime.pipeline.context import Context
+from dynamo_tpu.utils import tracing
 from dynamo_tpu.utils.logging import get_logger
 
 log = get_logger("dynamo_tpu.disagg")
@@ -205,7 +206,18 @@ class PrefillHandler:
 
     async def _handle(self, req: RemotePrefillRequest) -> None:
         pre = PreprocessedRequest.from_dict(req.pre)
-        first_token, k, v, ks, vs = await self.engine.prefill_only(pre)
+        # trace plane: serve under the ORIGINAL request id so this
+        # prefill worker's spans (prefill dispatches, the request span)
+        # land on the same merged timeline as the frontend's and the
+        # decode worker's (docs/observability.md "Fleet plane")
+        tracing.set_request(req.request_id)
+        if tracing.enabled():
+            tracing.instant(
+                "prefill_queue.pop", cat="rpc", req=req.request_id
+            )
+        first_token, k, v, ks, vs = await self.engine.prefill_only(
+            pre, ctx=Context(req.pre, request_id=req.request_id)
+        )
         num_layers = k.shape[0]
         parts = [
             (i, min(i + LAYERS_PER_PART, num_layers))

@@ -10,8 +10,8 @@ lib/llm/src/http/service/metrics.rs:36-201): `{prefix}_requests_total`
 from __future__ import annotations
 
 import time
-from collections import defaultdict
-from typing import Iterable
+from collections import defaultdict, deque
+from typing import Iterable, Optional
 
 DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
 
@@ -40,6 +40,13 @@ class Counter:
         self.help = help_
         self._values: dict[tuple, float] = defaultdict(float)
 
+    def declare(self, **labels: str) -> None:
+        """Materialize a labeled series at 0 BEFORE its first increment
+        (the Histogram zero-series rule applied to counters): rate()
+        queries and dashboards need the series present from the first
+        scrape, and a counter that appears mid-flight reads as a reset."""
+        self._values.setdefault(tuple(sorted(labels.items())), 0.0)
+
     def inc(self, amount: float = 1.0, **labels: str) -> None:
         self._values[tuple(sorted(labels.items()))] += amount
 
@@ -48,8 +55,10 @@ class Counter:
         yield f"# TYPE {self.name} counter"
         if not self._values:
             yield f"{self.name} 0"
-        for key, val in self._values.items():
-            yield f"{self.name}{_fmt_labels(dict(key))} {val}"
+        # sorted keys: consecutive scrapes diff cleanly whatever order
+        # the series were first touched in
+        for key in sorted(self._values):
+            yield f"{self.name}{_fmt_labels(dict(key))} {self._values[key]}"
 
 
 class Gauge:
@@ -57,6 +66,11 @@ class Gauge:
         self.name = name
         self.help = help_
         self._values: dict[tuple, float] = defaultdict(float)
+
+    def declare(self, **labels: str) -> None:
+        """Materialize a labeled series at 0 before its first set/add
+        (see Counter.declare)."""
+        self._values.setdefault(tuple(sorted(labels.items())), 0.0)
 
     def set(self, value: float, **labels: str) -> None:
         self._values[tuple(sorted(labels.items()))] = value
@@ -69,8 +83,8 @@ class Gauge:
         yield f"# TYPE {self.name} gauge"
         if not self._values:
             yield f"{self.name} 0"
-        for key, val in self._values.items():
-            yield f"{self.name}{_fmt_labels(dict(key))} {val}"
+        for key in sorted(self._values):
+            yield f"{self.name}{_fmt_labels(dict(key))} {self._values[key]}"
 
 
 class Histogram:
@@ -118,6 +132,7 @@ class Histogram:
 
 class ServiceMetrics:
     def __init__(self, prefix: str = "dynamo_tpu"):
+        self._prefix = prefix
         self.requests_total = Counter(
             f"{prefix}_http_service_requests_total", "Total HTTP LLM requests"
         )
@@ -134,7 +149,17 @@ class ServiceMetrics:
         return InflightGuard(self, model, endpoint)
 
     def render(self) -> str:
-        lines: list[str] = []
+        # leading instance-info series (build_info convention): the ONE
+        # place a scrape names the emitting process, joinable in PromQL
+        # against every other series of this endpoint — multi-worker
+        # fleets attribute scrapes without labeling every series
+        from dynamo_tpu.utils import instance
+
+        lines: list[str] = [
+            f"# TYPE {self._prefix}_instance_info gauge",
+            f'{self._prefix}_instance_info'
+            f'{{worker_id="{instance.worker_id()}"}} 1',
+        ]
         for metric in (self.requests_total, self.inflight, self.duration, *self.extra):
             lines.extend(metric.render())
         return "\n".join(lines) + "\n"
@@ -163,9 +188,25 @@ class EngineMetrics:
     submit → decode-slot admission. Gauges re-read `engine.metrics()` at
     every render, so they are scrape-time fresh without a poll loop."""
 
-    def __init__(self, engine=None, prefix: str = "dynamo_tpu"):
+    def __init__(
+        self,
+        engine=None,
+        prefix: str = "dynamo_tpu",
+        slo: Optional["SloTracker"] = None,
+        worker_id: Optional[str] = None,
+    ):
         self.engine = engine
         self._prefix = prefix
+        # optional SLO attainment tracker: fed from the same finish
+        # summaries, rendered through the same scrape
+        self.slo = slo
+        # optional stable instance label (utils/instance.worker_id):
+        # when set, every engine gauge carries worker_id="..." so a
+        # fleet Prometheus can tell multi-worker scrapes apart. Default
+        # None keeps single-process scrapes label-free.
+        self._worker_label = (
+            f'{{worker_id="{worker_id}"}}' if worker_id else ""
+        )
         self.ttft = Histogram(
             f"{prefix}_engine_ttft_seconds",
             "Engine TTFT: request submit to first token emitted",
@@ -197,6 +238,8 @@ class EngineMetrics:
             self.queue_wait.observe(summary["queue_wait_s"])
         if summary.get("tokens"):
             self.tokens.observe(float(summary["tokens"]))
+        if self.slo is not None:
+            self.slo.observe(summary)
 
     def render(self) -> Iterable[str]:
         if self.engine is not None:
@@ -207,9 +250,158 @@ class EngineMetrics:
             for key, val in gauges.items():
                 name = f"{self._prefix}_engine_{key}"
                 yield f"# TYPE {name} gauge"
-                yield f"{name} {float(val)}"
+                yield f"{name}{self._worker_label} {float(val)}"
         for h in (self.ttft, self.itl, self.queue_wait, self.tokens):
             yield from h.render()
+        if self.slo is not None:
+            yield from self.slo.render()
+
+
+# ---------------------------------------------------------------------- SLO
+
+# the request-summary fields an SLO can target (engine _note_finished
+# keys), with the Prometheus-facing metric slug they render under
+SLO_METRICS = {
+    "ttft_s": "ttft",
+    "itl_s": "itl",
+    "queue_wait_s": "queue_wait",
+}
+
+
+class SloTracker:
+    """Rolling-window SLO attainment accounting (docs/observability.md
+    "Fleet plane").
+
+    Targets come from config as ``{tenant: {ttft_s|itl_s|queue_wait_s:
+    seconds}}``; the ``"default"`` tenant covers requests with no tenant
+    label (the HTTP frontend stamps ``x-tenant-id`` into Context
+    metadata). Fed per finished request from the engine's summaries
+    (`JaxEngine.subscribe_requests`), it keeps a bounded rolling window
+    per (tenant, metric) and renders:
+
+    - ``slo_attainment{tenant,metric}`` — attained fraction over the
+      window (1.0 with no samples: an idle tenant is not in breach).
+      A value exactly AT the target attains (<=) — the boundary rule.
+    - ``slo_breaches_total{tenant,metric}`` / ``slo_requests_total`` —
+      monotonic burn-rate counters (zero-series declared at
+      registration so dashboards see them from the first scrape).
+
+    The attained fractions also feed the worker's stats handler
+    (`KvMetricsPublisher`), making every worker's attainment visible to
+    `KvMetricsAggregator` — the fleet signal the SLO-driven planner
+    scales on."""
+
+    def __init__(
+        self,
+        targets: Optional[dict] = None,
+        window_s: float = 300.0,
+        max_samples: int = 4096,
+        prefix: str = "dynamo_tpu",
+    ):
+        self.targets: dict = targets or {}
+        self.window_s = window_s
+        self.max_samples = max_samples
+        # (tenant, metric) -> deque[(monotonic_ts, attained_bool)]
+        self._windows: dict[tuple, deque] = {}
+        self.breaches = Counter(
+            f"{prefix}_slo_breaches_total",
+            "Requests that missed their SLO target (burn rate numerator)",
+        )
+        self.requests = Counter(
+            f"{prefix}_slo_requests_total",
+            "Requests evaluated against an SLO target",
+        )
+        self.attainment = Gauge(
+            f"{prefix}_slo_attainment",
+            "Attained fraction over the rolling window (1.0 = all within "
+            "target)",
+        )
+        # zero-series at registration: every configured (tenant, metric)
+        # renders from the first scrape, before any request finishes
+        for tenant, tspec in self.targets.items():
+            for field_name, slug in SLO_METRICS.items():
+                if (tspec or {}).get(field_name) is None:
+                    continue
+                self.breaches.declare(tenant=tenant, metric=slug)
+                self.requests.declare(tenant=tenant, metric=slug)
+                self.attainment.set(1.0, tenant=tenant, metric=slug)
+
+    def _resolve(self, tenant: str) -> tuple[str, dict]:
+        """(row, targets) for a request's tenant: a CONFIGURED tenant
+        uses its own spec under its own row — an explicitly empty spec
+        means exempt, not fall-through — while unknown tenants ride the
+        default target and aggregate under the "default" row (the row
+        always matches the spec that judged the request)."""
+        if tenant in self.targets:
+            return tenant, self.targets[tenant] or {}
+        return "default", self.targets.get("default") or {}
+
+    def observe(self, summary: dict, now: Optional[float] = None) -> None:
+        """Request-finish hook (wire into `JaxEngine.subscribe_requests`
+        or call from `EngineMetrics.observe`)."""
+        tenant = str(summary.get("tenant") or "default")
+        row, tspec = self._resolve(tenant)
+        if not tspec:
+            return
+        now = time.monotonic() if now is None else now
+        for field_name, slug in SLO_METRICS.items():
+            target = tspec.get(field_name)
+            value = summary.get(field_name)
+            if target is None or value is None:
+                continue
+            attained = value <= target  # AT the target attains
+            win = self._windows.setdefault(
+                (row, slug), deque(maxlen=self.max_samples)
+            )
+            win.append((now, attained))
+            self.requests.inc(tenant=row, metric=slug)
+            if not attained:
+                self.breaches.inc(tenant=row, metric=slug)
+            self._refresh(row, slug, now)
+
+    def _refresh(self, tenant: str, slug: str, now: float) -> None:
+        win = self._windows.get((tenant, slug))
+        if win is None:
+            return
+        horizon = now - self.window_s
+        while win and win[0][0] < horizon:
+            win.popleft()
+        if win:
+            frac = sum(1 for _, ok in win if ok) / len(win)
+        else:
+            frac = 1.0  # idle window: vacuously attaining
+        self.attainment.set(round(frac, 4), tenant=tenant, metric=slug)
+
+    def attained_fraction(
+        self, tenant: str, metric: str, now: Optional[float] = None
+    ) -> float:
+        """Window fraction for one (tenant, metric slug); 1.0 when idle."""
+        now = time.monotonic() if now is None else now
+        self._refresh(tenant, metric, now)
+        win = self._windows.get((tenant, metric))
+        if not win:
+            return 1.0
+        return sum(1 for _, ok in win if ok) / len(win)
+
+    def snapshot(self, now: Optional[float] = None) -> dict:
+        """``{"tenant/metric": fraction}`` for every tracked window —
+        the compact form that rides worker stats replies
+        (ForwardPassMetrics.slo_attainment)."""
+        now = time.monotonic() if now is None else now
+        out = {}
+        for (tenant, slug) in list(self._windows):
+            out[f"{tenant}/{slug}"] = round(
+                self.attained_fraction(tenant, slug, now), 4
+            )
+        return out
+
+    def render(self) -> Iterable[str]:
+        now = time.monotonic()
+        for (tenant, slug) in list(self._windows):
+            self._refresh(tenant, slug, now)
+        yield from self.attainment.render()
+        yield from self.breaches.render()
+        yield from self.requests.render()
 
 
 class InflightGuard:

@@ -145,11 +145,15 @@ class HttpService:
         )
 
     async def _debug_trace(self, request: web.Request) -> web.Response:
-        """Chrome/Perfetto trace-event JSON of the in-process span ring
-        (utils/tracing.py). Empty unless tracing is armed (DYN_TRACE=1);
-        load the body at https://ui.perfetto.dev — see
-        docs/observability.md."""
-        return web.json_response(tracing.export())
+        """Chrome/Perfetto trace-event JSON of the span ring
+        (utils/tracing.py) MERGED with spans shipped from other
+        processes (runtime/trace_plane.py) — a request that crossed
+        frontend → router → worker renders each process as its own
+        named track group. `?request_id=<id>` filters to one request.
+        Empty unless tracing is armed (DYN_TRACE=1); load the body at
+        https://ui.perfetto.dev — see docs/observability.md."""
+        rid = request.query.get("request_id")
+        return web.json_response(tracing.export(request_id=rid))
 
     async def _chat_completions(self, request: web.Request) -> web.StreamResponse:
         return await self._serve_llm(
@@ -242,6 +246,12 @@ class HttpService:
 
         guard = self.metrics.inflight_guard(req.model, kind)
         ctx = Context(req, request_id=rid)
+        # tenant label for per-tenant SLO attainment: rides Context
+        # metadata across process hops like the deadline; the engine
+        # stamps it into the finish summary (docs/observability.md)
+        tenant = request.headers.get("x-tenant-id")
+        if tenant:
+            ctx.metadata["tenant"] = tenant
         if timeout_s is not None:
             ctx.metadata["timeout_s"] = timeout_s
             ctx.metadata["deadline"] = time.time() + timeout_s

@@ -36,6 +36,28 @@ class ProcessedEndpoints:
         loads = [m.kv_active_blocks for m in self.endpoints.values()]
         return statistics.pstdev(loads) if len(loads) > 1 else 0.0
 
+    def attainment(self) -> dict:
+        """Fleet SLO attainment, folded from every worker's reported
+        windows: ``{"tenant/metric": {"mean": f, "min": f, "workers": n}}``.
+        `min` is the planner's scale-up trigger (the worst worker is the
+        one breaching); `mean` is the fleet health headline. Workers
+        that report no tracker simply don't vote."""
+        merged: dict[str, list[float]] = {}
+        for m in self.endpoints.values():
+            for key, frac in (m.slo_attainment or {}).items():
+                try:
+                    merged.setdefault(key, []).append(float(frac))
+                except (TypeError, ValueError):
+                    continue
+        return {
+            key: {
+                "mean": round(statistics.fmean(vals), 4),
+                "min": round(min(vals), 4),
+                "workers": len(vals),
+            }
+            for key, vals in merged.items()
+        }
+
 
 class KvMetricsAggregator:
     def __init__(
@@ -87,6 +109,12 @@ class KvMetricsAggregator:
                 continue
             self.last_seen[wid] = now
         self.current = ProcessedEndpoints(endpoints=endpoints)
+
+    def attainment(self) -> dict:
+        """Fleet SLO attainment from the latest snapshot (see
+        `ProcessedEndpoints.attainment`) — the input the SLO-driven
+        planner roadmap item scales on."""
+        return self.current.attainment()
 
     def endpoints_for(self, worker_ids: list[int]) -> dict[int, ForwardPassMetrics]:
         """Metrics for the given live workers; workers missing from the last

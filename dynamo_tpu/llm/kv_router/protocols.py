@@ -82,7 +82,7 @@ class RouterEvent:
 
 @dataclass
 class ForwardPassMetrics:
-    """reference: protocols.rs:43-54."""
+    """reference: protocols.rs:43-54 (+ the TPU port's SLO extension)."""
 
     request_active_slots: int = 0
     request_total_slots: int = 0
@@ -92,6 +92,12 @@ class ForwardPassMetrics:
     gpu_cache_usage_perc: float = 0.0
     gpu_prefix_cache_hit_rate: float = 0.0
     data_parallel_rank: int = 0
+    # per-worker SLO attainment, {"tenant/metric": fraction} over the
+    # worker's rolling window (llm/http/metrics.SloTracker.snapshot) —
+    # folded through the stats scrape into KvMetricsAggregator so fleet
+    # attainment is one aggregator read (the planner's scale signal).
+    # Workers without a tracker send nothing; from_dict tolerates both.
+    slo_attainment: dict = field(default_factory=dict)
 
     def to_dict(self) -> dict:
         return asdict(self)
@@ -99,6 +105,8 @@ class ForwardPassMetrics:
     @classmethod
     def from_dict(cls, d: dict) -> "ForwardPassMetrics":
         known = {f: d.get(f) for f in cls.__dataclass_fields__ if f in d}
+        if known.get("slo_attainment") is None:
+            known.pop("slo_attainment", None)
         return cls(**known)
 
 

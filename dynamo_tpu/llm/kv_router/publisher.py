@@ -73,13 +73,22 @@ class KvEventPublisher:
 class KvMetricsPublisher:
     """Latest ForwardPassMetrics snapshot + stats handler for scrapes."""
 
-    def __init__(self, source: Optional[Callable[[], dict]] = None):
+    def __init__(
+        self,
+        source: Optional[Callable[[], dict]] = None,
+        slo: Optional[object] = None,
+    ):
         self._source = source
+        # llm/http/metrics.SloTracker (duck-typed: anything with a
+        # snapshot() -> dict): its attained fractions ride every stats
+        # reply so the aggregator sees fleet attainment without a
+        # second scrape plane
+        self._slo = slo
         self.current = ForwardPassMetrics()
 
     @classmethod
-    def for_engine(cls, engine) -> "KvMetricsPublisher":
-        return cls(source=engine.metrics)
+    def for_engine(cls, engine, slo: Optional[object] = None) -> "KvMetricsPublisher":
+        return cls(source=engine.metrics, slo=slo)
 
     def publish(self, metrics: ForwardPassMetrics) -> None:
         self.current = metrics
@@ -89,4 +98,9 @@ class KvMetricsPublisher:
         data plane (reference: NATS $SRV.STATS)."""
         if self._source is not None:
             self.current = ForwardPassMetrics.from_dict(self._source())
+        if self._slo is not None:
+            try:
+                self.current.slo_attainment = dict(self._slo.snapshot())
+            except Exception:  # noqa: BLE001 — stats must never fail on SLO
+                log.exception("slo snapshot failed; sending without it")
         return self.current.to_dict()

@@ -87,9 +87,15 @@ class MetricsExporter:
         snap = self.aggregator.current if self.aggregator else None
         eps = snap.endpoints if snap else {}
         lines = []
+        declared: set[str] = set()
 
         def gauge(name: str, value, labels: str = "") -> None:
-            lines.append(f"# TYPE {PREFIX}_{name} gauge")
+            # ONE TYPE line per family, however many labeled series the
+            # worker loops emit — the Prometheus text parser hard-fails
+            # a scrape on a second TYPE line for the same name
+            if name not in declared:
+                declared.add(name)
+                lines.append(f"# TYPE {PREFIX}_{name} gauge")
             lines.append(f"{PREFIX}_{name}{labels} {value}")
 
         gauge("worker_count", len(eps))
@@ -101,9 +107,26 @@ class MetricsExporter:
             gauge("requests_total_slots", m.request_total_slots, lab)
             gauge("gpu_cache_usage_percent", m.gpu_cache_usage_perc, lab)
             gauge("requests_waiting", m.num_requests_waiting, lab)
+            # per-worker SLO attainment (rolling-window fractions the
+            # worker's SloTracker reported on the stats plane)
+            for key, frac in sorted((m.slo_attainment or {}).items()):
+                tenant, _, metric = key.partition("/")
+                gauge(
+                    "slo_attainment", frac,
+                    f'{{worker_id="{wid:x}",tenant="{tenant}",'
+                    f'metric="{metric}"}}',
+                )
         loads = [m.kv_active_blocks for m in eps.values()]
         gauge("load_avg", statistics.fmean(loads) if loads else 0.0)
         gauge("load_std", statistics.pstdev(loads) if len(loads) > 1 else 0.0)
+        # fleet fold: min is the planner's scale-up trigger (the worst
+        # worker is the one breaching), mean the fleet headline
+        if self.aggregator is not None:
+            for key, agg in sorted(self.aggregator.attainment().items()):
+                tenant, _, metric = key.partition("/")
+                lab = f'{{tenant="{tenant}",metric="{metric}"}}'
+                gauge("slo_attainment_fleet_mean", agg["mean"], lab)
+                gauge("slo_attainment_fleet_min", agg["min"], lab)
         lines.append(f"# TYPE {PREFIX}_kv_hit_rate_events counter")
         lines.append(f"{PREFIX}_kv_hit_rate_events {self.hit_events}")
         lines.append(f"# TYPE {PREFIX}_kv_hit_tokens counter")

@@ -77,6 +77,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "(per-request x-request-timeout header overrides; "
                         "expired requests shed with 429 — "
                         "docs/robustness.md)")
+    p.add_argument("--slo-targets",
+                   help="JSON file of per-tenant SLO targets "
+                        '({"default": {"ttft_s": 2.0, "itl_s": 0.05, '
+                        '"queue_wait_s": 1.0}, "<tenant>": {...}}; the '
+                        "DYN_SLO_TARGETS env var takes inline JSON) — "
+                        "renders slo_attainment/slo_breaches_total on "
+                        "/metrics and rides worker stats replies "
+                        "(docs/observability.md)")
     p.add_argument("--disagg-mode", choices=["agg", "decode", "prefill"],
                    default="agg", help="worker role in a disaggregated graph")
     p.add_argument("--max-local-prefill-length", type=int, default=128)
@@ -101,6 +109,27 @@ def parse_io(tokens: list[str]) -> tuple[str, str]:
         else:
             raise SystemExit(f"unrecognized positional {t!r} (want in=/out=)")
     return inp, out
+
+
+def load_slo_targets(args):
+    """Per-tenant SLO targets: --slo-targets file > DYN_SLO_TARGETS
+    inline JSON > None (no tracker)."""
+    import os
+
+    if getattr(args, "slo_targets", None):
+        with open(args.slo_targets) as f:
+            return json.load(f)
+    inline = os.environ.get("DYN_SLO_TARGETS")
+    if inline:
+        return json.loads(inline)
+    return None
+
+
+def build_slo_tracker(args):
+    from dynamo_tpu.llm.http.metrics import SloTracker
+
+    targets = load_slo_targets(args)
+    return SloTracker(targets) if targets else None
 
 
 def build_engine_config_kwargs(args) -> dict:
@@ -168,7 +197,12 @@ async def build_output(args, out: str, drt=None):
 
 async def run_http(args, out: str) -> None:
     from dynamo_tpu.llm.http.service import HttpService
+    from dynamo_tpu.utils import instance, tracing
 
+    # frontend process label for the merged trace (workers name
+    # themselves at engine start; DYN_TRACE_PROCESS and earlier callers
+    # win — first-wins lives in set_process_default)
+    tracing.set_process_default("frontend")
     template = None
     if args.request_template:
         from dynamo_tpu.llm.request_template import RequestTemplate
@@ -191,6 +225,20 @@ async def run_http(args, out: str) -> None:
         drt = await DistributedRuntime.from_settings(hub_addr=args.hub)
         watcher = ModelWatcher(drt, svc.manager, router_mode=args.router_mode)
         await watcher.start()
+        if tracing.enabled():
+            # fleet trace plane: collect spans shipped by workers so
+            # /debug/trace renders ONE merged timeline across processes
+            # (held on the service: the loop references tasks weakly, a
+            # fire-and-forget aggregator could be GC'd mid-serve)
+            from dynamo_tpu.runtime.trace_plane import TraceAggregator
+
+            svc.trace_aggregator = await TraceAggregator(drt.hub).start()
+        # NOTE: no SloTracker on the ingress scrape — attainment is
+        # measured where requests finish (the workers), rides their
+        # stats replies, and aggregates via KvMetricsAggregator /
+        # metrics_export. Rendering an unfed tracker here would pin
+        # every series at 1.0 and read "all SLOs attained" during a
+        # fleet-wide breach.
     else:
         pipeline, card, engine = await build_output(args, out)
         name = args.model_name or (card.display_name if card else "echo")
@@ -199,10 +247,17 @@ async def run_http(args, out: str) -> None:
         if engine is not None:
             # one scrape covers service + engine: Engine.metrics() gauges
             # and the TTFT/ITL/queue-wait/tokens histograms render through
-            # the /metrics endpoint via the ServiceMetrics.extra hook
+            # the /metrics endpoint via the ServiceMetrics.extra hook,
+            # labeled with the stable instance id and feeding the SLO
+            # attainment tracker when targets are configured
             from dynamo_tpu.llm.http.metrics import EngineMetrics
 
-            svc.metrics.extra.append(EngineMetrics(engine))
+            svc.metrics.extra.append(
+                EngineMetrics(
+                    engine, slo=build_slo_tracker(args),
+                    worker_id=instance.worker_id(),
+                )
+            )
     await svc.start(args.http_host, args.http_port)
     log.info("serving OpenAI HTTP on %s:%d", args.http_host, svc.port)
     await asyncio.Event().wait()
@@ -221,6 +276,17 @@ async def run_worker(args, inp: str, out: str) -> None:
     drt = await DistributedRuntime.from_settings(hub_addr=args.hub)
     eid = EndpointId.parse(inp)
 
+    from dynamo_tpu.runtime import trace_plane
+    from dynamo_tpu.utils import instance
+
+    if trace_plane.export_enabled():
+        # ship this worker's spans to the hub trace subject so the
+        # frontend's /debug/trace merges them (docs/observability.md
+        # "Fleet plane"); no-op unless DYN_TRACE armed recording. Held
+        # on the runtime: the loop references tasks weakly, and a
+        # fire-and-forget shipper could be GC'd mid-serve.
+        drt.trace_shipper = trace_plane.SpanShipper(drt.hub).start()
+
     if out.startswith("echo"):
         from dynamo_tpu.llm.engines import EchoEngineCore
 
@@ -234,7 +300,13 @@ async def run_worker(args, inp: str, out: str) -> None:
     engine = lm.build_engine(**build_engine_config_kwargs(args))
     lm.card.kv_cache_block_size = args.page_size
     component = drt.namespace(eid.namespace).component(eid.component)
-    metrics = KvMetricsPublisher.for_engine(engine)
+    # SLO attainment (per-tenant targets): the tracker feeds off the
+    # engine's finish summaries and its window fractions ride every
+    # stats reply, so the aggregator sees fleet attainment
+    slo = build_slo_tracker(args)
+    if slo is not None:
+        engine.subscribe_requests(slo.observe)
+    metrics = KvMetricsPublisher.for_engine(engine, slo=slo)
 
     if args.disagg_mode == "prefill":
         from dynamo_tpu.llm.disagg import PrefillHandler
@@ -269,7 +341,10 @@ async def run_worker(args, inp: str, out: str) -> None:
     # indexer has no replay)
     KvEventPublisher(component, drt.primary_lease.lease_id).attach(engine).start()
     await register_llm(
-        drt, serving_engine, lm.card, inp, stats_handler=metrics.stats_handler
+        drt, serving_engine, lm.card, inp, stats_handler=metrics.stats_handler,
+        # echo the stable instance label minted at engine start so hub
+        # consumers join InstanceInfo to logs/Prometheus/trace tracks
+        metadata={"instance": instance.worker_id()},
     )
     log.info("worker (%s) serving %s", args.disagg_mode, inp)
     await asyncio.Event().wait()

@@ -28,7 +28,7 @@ from dynamo_tpu.runtime.resilience import (
     Backoff,
     CircuitBreaker,
 )
-from dynamo_tpu.utils import counters
+from dynamo_tpu.utils import counters, tracing
 from dynamo_tpu.utils.logging import get_logger
 
 log = get_logger("dynamo_tpu.client")
@@ -196,6 +196,15 @@ class Client:
         Handle establishment retries transient transport failures
         against other instances (capped, jittered); see class docs."""
         ctx = context or Context(payload)
+        # distributed tracing: the traceparent (request id + a fresh
+        # parent span id for THIS hop) rides Context metadata across the
+        # data plane; the worker's Ingress binds it so its spans join
+        # the same request id on the merged trace (docs/observability.md
+        # "Fleet plane"). Stamped even with local recording off — the
+        # receiving worker may be the one tracing.
+        tp = ctx.metadata.setdefault(
+            "traceparent", tracing.make_traceparent(ctx.id)
+        )
         tried: set[int] = set()
         attempt = 0
         while True:
@@ -225,6 +234,12 @@ class Client:
                 await asyncio.sleep(delay)
                 continue
             br.record_success()
+            if tracing.enabled():
+                tracing.instant(
+                    "rpc.send", cat="rpc", req=ctx.id,
+                    endpoint=self.endpoint_id.subject,
+                    worker=f"{info.worker_id:x}", traceparent=tp,
+                )
             break
 
         async def _stream() -> AsyncIterator[Any]:

@@ -26,6 +26,7 @@ import msgpack
 
 from dynamo_tpu.runtime.pipeline.context import Context
 from dynamo_tpu.runtime.pipeline.engine import AsyncEngine
+from dynamo_tpu.utils import tracing
 from dynamo_tpu.utils.logging import get_logger
 
 if TYPE_CHECKING:
@@ -210,12 +211,28 @@ def unpack_payload(raw: bytes) -> Any:
 
 class Ingress:
     """Adapts a typed engine into the data plane's bytes handler
-    (reference: lib/runtime/src/pipeline/network.rs:279 `Ingress`)."""
+    (reference: lib/runtime/src/pipeline/network.rs:279 `Ingress`).
+
+    Trace plane: the caller's traceparent (stamped into Context metadata
+    by `runtime/client.py`) is bound here — the request id joins this
+    process's contextvar so worker-side spans and JSONL logs carry the
+    SAME id as the frontend's, and an `rpc.recv` instant marks the hop
+    on the merged timeline (docs/observability.md "Fleet plane")."""
 
     def __init__(self, engine: AsyncEngine):
         self._engine = engine
 
     async def __call__(self, ctx: Context) -> AsyncIterator[bytes]:
+        tracing.set_request(ctx.id)
+        if tracing.enabled():
+            parent = None
+            tp = (ctx.metadata or {}).get("traceparent")
+            if isinstance(tp, str):
+                _, parent = tracing.parse_traceparent(tp)
+            tracing.instant(
+                "rpc.recv", cat="rpc", req=ctx.id,
+                parent_span=parent or "",
+            )
         typed = ctx.map(unpack_payload(ctx.payload))
         stream = await self._engine.generate(typed)
 

@@ -1,0 +1,149 @@
+"""Span shipping over the hub: the cross-process leg of the trace plane.
+
+`utils/tracing.py` records spans per process; this module moves them so
+ONE `/debug/trace` scrape can answer "what happened to this request"
+when the request crossed frontend → router → worker (and prefill worker)
+process boundaries — the reference ships the same story through its
+OTLP exporter layers (lib/runtime/src/logging.rs); here the existing hub
+pub/sub is the wire, so no new dependency and no new port.
+
+- **`SpanShipper`** (worker side): registers a tracing sink, buffers
+  completed wire events in a thread-safe deque (engine dispatch threads
+  record off the event loop), and a background task flushes batches to
+  the ``_dyn.trace`` subject. Only active while recording is armed —
+  the sink fires nothing when `DYN_TRACE` is off.
+- **`TraceAggregator`** (frontend side): subscribes ``_dyn.trace`` and
+  `tracing.ingest`s each batch under the sender's process label, so the
+  frontend's `export()` renders every process as its own named track
+  group of one merged timeline.
+
+Enable with ``DYN_TRACE=1`` on both sides; ``DYN_TRACE_EXPORT=0`` opts a
+worker out of shipping while keeping local recording (see
+docs/observability.md "Fleet plane").
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+from collections import deque
+from typing import Optional
+
+import msgpack
+
+from dynamo_tpu.utils import tracing
+from dynamo_tpu.utils.logging import get_logger
+
+log = get_logger("dynamo_tpu.trace_plane")
+
+TRACE_SUBJECT = "_dyn.trace"
+
+
+def export_enabled() -> bool:
+    """Ship worker spans? Defaults to the recording toggle; set
+    ``DYN_TRACE_EXPORT=0`` to record locally without shipping."""
+    flag = os.environ.get("DYN_TRACE_EXPORT")
+    if flag is not None:
+        return flag not in ("", "0")
+    return tracing.enabled()
+
+
+class SpanShipper:
+    """Forward this process's completed spans to the hub trace subject."""
+
+    def __init__(
+        self,
+        hub,
+        flush_interval_s: float = 0.5,
+        max_buffer: int = 8192,
+        max_batch: int = 1024,
+    ):
+        self.hub = hub
+        self.flush_interval_s = flush_interval_s
+        self.max_batch = max_batch
+        # deque.append is atomic — dispatch worker threads feed the sink
+        # without a lock; newest win like the recording ring itself
+        self._buf: deque = deque(maxlen=max_buffer)
+        self._task: Optional[asyncio.Task] = None
+        self.shipped = 0
+
+    def _sink(self, wire_event: dict) -> None:
+        self._buf.append(wire_event)
+
+    def start(self) -> "SpanShipper":
+        tracing.add_sink(self._sink)
+        self._task = asyncio.get_running_loop().create_task(self._flush_loop())
+        return self
+
+    async def _flush_loop(self) -> None:
+        try:
+            while True:
+                await asyncio.sleep(self.flush_interval_s)
+                await self.flush()
+        except asyncio.CancelledError:
+            raise
+
+    async def flush(self) -> int:
+        """Drain the buffer into (possibly several) publishes; returns
+        events shipped. Publish failures drop the batch — tracing is
+        diagnostics, never a liability on the serving path."""
+        total = 0
+        while self._buf:
+            batch = []
+            while self._buf and len(batch) < self.max_batch:
+                batch.append(self._buf.popleft())
+            try:
+                await self.hub.publish(
+                    TRACE_SUBJECT,
+                    msgpack.packb(
+                        {"process": tracing.process_label(), "events": batch},
+                        use_bin_type=True,
+                    ),
+                )
+                total += len(batch)
+            except Exception:  # noqa: BLE001 — hub hiccup: drop + move on
+                log.debug("span batch publish failed (%d events dropped)",
+                          len(batch))
+                break
+        self.shipped += total
+        return total
+
+    async def close(self) -> None:
+        tracing.remove_sink(self._sink)
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+        await self.flush()
+
+
+class TraceAggregator:
+    """Collect shipped spans from every process into the local merge."""
+
+    def __init__(self, hub):
+        self.hub = hub
+        self._sub = None
+        self._task: Optional[asyncio.Task] = None
+        self.ingested = 0
+
+    async def start(self) -> "TraceAggregator":
+        self._sub = await self.hub.subscribe(TRACE_SUBJECT)
+        self._task = asyncio.get_running_loop().create_task(self._pump())
+        return self
+
+    async def _pump(self) -> None:
+        async for ev in self._sub:
+            try:
+                d = msgpack.unpackb(ev["data"], raw=False)
+                self.ingested += tracing.ingest(
+                    d.get("events") or [], process=str(d.get("process"))
+                )
+            except Exception:  # noqa: BLE001 — one bad batch must not
+                log.exception("dropping malformed span batch")
+
+    async def close(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+        if self._sub is not None:
+            await self._sub.unsubscribe()
+            self._sub = None

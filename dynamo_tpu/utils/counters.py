@@ -30,6 +30,16 @@ from typing import Iterable
 
 _lock = threading.Lock()
 _values: dict[str, float] = {}
+_declared: set[str] = set()
+
+
+def declare(name: str) -> None:
+    """Register a counter so it renders a zero-valued series BEFORE its
+    first increment (the PR-4 Histogram zero-series rule, applied to the
+    registry): dashboards and rate() queries need the series to exist
+    from the first scrape, not from the first event."""
+    with _lock:
+        _declared.add(name)
 
 
 def inc(name: str, amount: float = 1.0) -> None:
@@ -52,6 +62,7 @@ def reset() -> None:
     production, resets break rate() queries)."""
     with _lock:
         _values.clear()
+        _declared.clear()
 
 
 class PromCounters:
@@ -73,8 +84,10 @@ class PromCounters:
         self._prefix = prefix
 
     def render(self) -> Iterable[str]:
-        vals = snapshot()
-        for name in sorted(set(self.KNOWN) | set(vals)):
+        with _lock:
+            vals = dict(_values)
+            declared = set(_declared)
+        for name in sorted(set(self.KNOWN) | declared | set(vals)):
             full = f"{self._prefix}_{name}"
             yield f"# TYPE {full} counter"
             yield f"{full} {float(vals.get(name, 0.0))}"

@@ -31,11 +31,17 @@ logging.addLevelName(5, "TRACE")
 
 class JsonlFormatter(logging.Formatter):
     def format(self, record: logging.LogRecord) -> str:
+        from dynamo_tpu.utils import instance
+
         out = {
             "ts": time.strftime("%Y-%m-%dT%H:%M:%S", time.gmtime(record.created)),
             "level": record.levelname,
             "target": record.name,
             "message": record.getMessage(),
+            # stable instance label (utils/instance.py): multi-worker
+            # log aggregation joins records to the emitting process the
+            # same way Prometheus joins on the worker_id label
+            "worker_id": instance.worker_id(),
         }
         # join key against the trace plane: the active request id (bound
         # by the HTTP frontend for the handler's task tree, see

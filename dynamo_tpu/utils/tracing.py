@@ -33,6 +33,20 @@ Design:
   Events are sorted so ``ts`` is monotonic. Tracks: one row per request id
   plus named engine rows (e.g. ``engine.steps`` for the dispatch
   timeline).
+- **Cross-process merge (the fleet plane).** Each process carries a
+  label (`set_process`, default from ``DYN_TRACE_PROCESS`` or
+  ``proc-<pid>``). `wire_events()` snapshots the ring in a
+  process-independent wire form (track NAMES instead of local tids,
+  absolute unix-epoch timestamps instead of the local perf_counter
+  epoch); `ingest()` on the receiving side rebases those stamps into its
+  own clock domain and stores them as *foreign* events. `export()` then
+  renders ONE merged trace: the local process is pid 0, every ingested
+  process gets its own pid + ``process_name`` metadata, and every
+  (process, track) pair its own named row — a request that crossed
+  frontend → router → worker reads as parallel tracks of one timeline.
+  `add_sink()` registers a callable fed each completed wire event, the
+  hook the span shipper (`runtime/trace_plane.py`) uses to forward
+  worker-side spans over the hub without scanning the ring.
 
 See docs/observability.md for the trace model and a Perfetto walkthrough.
 """
@@ -45,8 +59,9 @@ import json
 import os
 import threading
 import time
+import uuid
 from collections import deque
-from typing import Iterator, Optional
+from typing import Callable, Iterator, Optional
 
 __all__ = [
     "enabled",
@@ -57,6 +72,15 @@ __all__ = [
     "reset_request",
     "current_request",
     "request_scope",
+    "set_process",
+    "set_process_default",
+    "process_label",
+    "make_traceparent",
+    "parse_traceparent",
+    "add_sink",
+    "remove_sink",
+    "wire_events",
+    "ingest",
     "span",
     "instant",
     "complete",
@@ -71,8 +95,13 @@ _events: deque = deque(
     maxlen=int(os.environ.get("DYN_TRACE_BUFFER", str(_DEFAULT_BUFFER)))
 )
 # perf_counter epoch: every ts is microseconds since module import, so
-# exported timestamps are small, positive and comparable across threads
+# exported timestamps are small, positive and comparable across threads.
+# _T0_UNIX is the SAME instant on the wall clock — the bridge that lets
+# wire_events/ingest rebase timestamps between processes (NTP-class skew
+# between hosts is the error bar; export() sorts, so the merged trace
+# stays monotonic regardless).
 _T0 = time.perf_counter()
+_T0_UNIX = time.time()
 
 # active request id for this task tree (None outside a request)
 _request_var: contextvars.ContextVar[Optional[str]] = contextvars.ContextVar(
@@ -95,6 +124,31 @@ _tracks: dict[str, int] = {}
 _pinned: set = set()
 _next_tid = 0
 _tracks_lock = threading.Lock()
+
+# process identity for the cross-process merge: the local process label
+# (None until set; resolved lazily so an engine/run-mode can claim it
+# first), plus the foreign-event store — events ingested from OTHER
+# processes, kept in their own bounded ring with per-(process, track)
+# tid assignment at export time. Local events stay pid 0; each foreign
+# process gets a fresh pid in ingestion order. Both registries are
+# BOUNDED like the local track table: a frontend that outlives weeks of
+# worker churn (every restart mints a new worker-<...> label) must not
+# leak registry entries, or emit metadata for processes whose events
+# the ring expired long ago. Past the caps the oldest entries drop —
+# their surviving events keep numeric pids/tids, they just lose the
+# pretty labels; ids come from counters so reuse can never collide.
+_FOREIGN_PIDS_MAX = 256
+_process: Optional[str] = os.environ.get("DYN_TRACE_PROCESS") or None
+_foreign: deque = deque(maxlen=_events.maxlen)
+_foreign_pids: dict[str, int] = {}
+_foreign_tracks: dict[tuple, int] = {}  # (process, track) -> tid
+_next_fpid = 0
+
+# span-export sinks: callables fed each completed wire event (dict with
+# a track NAME and absolute unix-us ts — process-independent). Only
+# consulted when recording is armed; with no sinks the hot path pays one
+# falsy check.
+_sinks: list = []
 
 _NOOP_CM = contextlib.nullcontext()
 
@@ -120,9 +174,12 @@ def disable() -> None:
 
 def clear() -> None:
     _events.clear()
+    _foreign.clear()
     with _tracks_lock:
         _tracks.clear()
         _pinned.clear()
+        _foreign_pids.clear()
+        _foreign_tracks.clear()
 
 
 # ------------------------------------------------------------------ context
@@ -152,12 +209,103 @@ def request_scope(request_id: Optional[str]) -> Iterator[None]:
         _request_var.reset(token)
 
 
+# ------------------------------------------------------- process identity
+
+
+def set_process(name: Optional[str]) -> None:
+    """Label THIS process for merged exports (worker id, "frontend", …).
+    Unconditional; pass None to unset (tests). Run modes and engines
+    should use `set_process_default` so an explicit label — including
+    ``DYN_TRACE_PROCESS`` — is never clobbered."""
+    global _process
+    _process = name
+
+
+def set_process_default(name: str) -> None:
+    """Claim the process label only if nothing has set one yet (env var
+    or an earlier caller wins) — the first-wins entry point for run
+    modes and engine init."""
+    global _process
+    if _process is None:
+        _process = name
+
+
+def process_label() -> str:
+    """The local process label, defaulting to ``proc-<pid>``."""
+    return _process or f"proc-{os.getpid()}"
+
+
+def make_traceparent(request_id: str) -> str:
+    """Mint a traceparent for an outbound hop: W3C-shaped
+    ``00-<request_id>-<parent_span_hex16>-01``. The request id doubles as
+    the trace id (it already joins spans, logs and headers everywhere);
+    the span id names this hop so the receiver can record which caller
+    handed it the request."""
+    return f"00-{request_id}-{uuid.uuid4().hex[:16]}-01"
+
+
+def parse_traceparent(tp: str) -> tuple[Optional[str], Optional[str]]:
+    """(request_id, parent_span_id) from a traceparent string; (None,
+    None) when malformed. Request ids may contain dashes (forked
+    contexts), so the span id is taken from the fixed tail."""
+    parts = tp.split("-")
+    if len(parts) < 4:
+        return None, None
+    return "-".join(parts[1:-2]) or None, parts[-2] or None
+
+
+# ------------------------------------------------------------------- sinks
+
+
+def add_sink(fn: Callable[[dict], None]) -> None:
+    """Register a span-export sink: called inline with each completed
+    WIRE event (see `wire_events` for the shape) while recording is
+    armed. Sinks must be cheap and non-blocking — buffer and flush
+    elsewhere (runtime/trace_plane.SpanShipper)."""
+    if fn not in _sinks:
+        _sinks.append(fn)
+
+
+def remove_sink(fn: Callable[[dict], None]) -> None:
+    with contextlib.suppress(ValueError):
+        _sinks.remove(fn)
+
+
+def _wire(ev: dict, tname: str) -> dict:
+    """Local ring event -> process-independent wire form: the track NAME
+    instead of the local tid, absolute unix-epoch microseconds instead
+    of the local perf_counter epoch."""
+    w = {
+        "name": ev["name"],
+        "ph": ev["ph"],
+        "ts_unix_us": round(ev["ts"] + _T0_UNIX * 1e6, 1),
+        "cat": ev["cat"],
+        "track": tname,
+        "args": ev["args"],
+    }
+    if "dur" in ev:
+        w["dur"] = ev["dur"]
+    return w
+
+
+def _feed_sinks(ev: dict, tname: str) -> None:
+    w = _wire(ev, tname)
+    for fn in _sinks:
+        try:
+            fn(w)
+        except Exception:  # noqa: BLE001 — a broken sink must not take
+            pass           # down the traced code path
+
+
 # ---------------------------------------------------------------- recording
 
 
-def _tid(track: Optional[str], req: Optional[str]) -> int:
+def _track_name(track: Optional[str], req: Optional[str]) -> str:
+    return track or req or _request_var.get() or "main"
+
+
+def _tid_for(name: str, pin: bool) -> int:
     global _next_tid
-    name = track or req or _request_var.get() or "main"
     tid = _tracks.get(name)
     if tid is None:
         with _tracks_lock:
@@ -172,9 +320,13 @@ def _tid(track: Optional[str], req: Optional[str]) -> int:
                     _tracks.pop(victim)
                 _next_tid += 1
                 tid = _tracks[name] = _next_tid
-                if track is not None:
+                if pin:
                     _pinned.add(name)
     return tid
+
+
+def _tid(track: Optional[str], req: Optional[str]) -> int:
+    return _tid_for(_track_name(track, req), track is not None)
 
 
 def _us(t: float) -> float:
@@ -199,18 +351,20 @@ def complete(
         req = _request_var.get()
     if req is not None:
         args.setdefault("request_id", req)
-    _events.append(
-        {
-            "name": name,
-            "ph": "X",
-            "ts": _us(t0),
-            "dur": max(round((t1 - t0) * 1e6, 1), 0.0),
-            "pid": 0,
-            "tid": _tid(track, req),
-            "cat": cat or "span",
-            "args": args,
-        }
-    )
+    tname = _track_name(track, req)
+    ev = {
+        "name": name,
+        "ph": "X",
+        "ts": _us(t0),
+        "dur": max(round((t1 - t0) * 1e6, 1), 0.0),
+        "pid": 0,
+        "tid": _tid_for(tname, track is not None),
+        "cat": cat or "span",
+        "args": args,
+    }
+    _events.append(ev)
+    if _sinks:
+        _feed_sinks(ev, tname)
 
 
 def instant(
@@ -229,18 +383,20 @@ def instant(
         req = _request_var.get()
     if req is not None:
         args.setdefault("request_id", req)
-    _events.append(
-        {
-            "name": name,
-            "ph": "i",
-            "s": "t",
-            "ts": _us(ts if ts is not None else time.perf_counter()),
-            "pid": 0,
-            "tid": _tid(track, req),
-            "cat": cat or "event",
-            "args": args,
-        }
-    )
+    tname = _track_name(track, req)
+    ev = {
+        "name": name,
+        "ph": "i",
+        "s": "t",
+        "ts": _us(ts if ts is not None else time.perf_counter()),
+        "pid": 0,
+        "tid": _tid_for(tname, track is not None),
+        "cat": cat or "event",
+        "args": args,
+    }
+    _events.append(ev)
+    if _sinks:
+        _feed_sinks(ev, tname)
 
 
 def span(
@@ -290,20 +446,130 @@ class _Span:
         )
 
 
+# ------------------------------------------------- cross-process wire/ingest
+
+
+def wire_events(request_id: Optional[str] = None) -> dict:
+    """Snapshot the local ring in wire form for another process to
+    `ingest`: ``{"process": label, "events": [...]}`` where each event
+    carries its track NAME and an absolute unix-us timestamp instead of
+    local tid / local epoch. `request_id` filters to one request's
+    events (matched on the ``request_id`` arg every request-scoped
+    event carries)."""
+    with _tracks_lock:
+        names = {tid: name for name, tid in _tracks.items()}
+    out = []
+    for ev in _events.copy():
+        if request_id is not None and (
+            ev["args"].get("request_id") != request_id
+        ):
+            continue
+        out.append(_wire(ev, names.get(ev["tid"], "main")))
+    return {"process": process_label(), "events": out}
+
+
+def ingest(events: list, process: str) -> int:
+    """Store wire events from another process for merged export. Their
+    absolute timestamps are rebased into this process's clock domain;
+    returns the number of events accepted (malformed ones are dropped —
+    a bad batch from one worker must not poison the merge)."""
+    base = _T0_UNIX * 1e6
+    n = 0
+    for w in events:
+        try:
+            ev = {
+                "name": w["name"],
+                "ph": w["ph"],
+                "ts": round(float(w["ts_unix_us"]) - base, 1),
+                "cat": w.get("cat") or "span",
+                "args": dict(w.get("args") or {}),
+                "process": process,
+                "track": str(w.get("track") or "main"),
+            }
+            if "dur" in w:
+                ev["dur"] = max(float(w["dur"]), 0.0)
+            if w["ph"] == "i":
+                ev["s"] = "t"
+        except (KeyError, TypeError, ValueError):
+            continue
+        _foreign.append(ev)
+        n += 1
+    return n
+
+
+def _foreign_pid(process: str) -> int:
+    global _next_fpid
+    pid = _foreign_pids.get(process)
+    if pid is None:
+        while len(_foreign_pids) >= _FOREIGN_PIDS_MAX:
+            victim = next(iter(_foreign_pids))
+            _foreign_pids.pop(victim)
+            for key in [k for k in _foreign_tracks if k[0] == victim]:
+                _foreign_tracks.pop(key)
+        _next_fpid += 1
+        pid = _foreign_pids[process] = _next_fpid
+    return pid
+
+
+def _foreign_tid(process: str, track: str) -> int:
+    global _next_tid
+    key = (process, track)
+    tid = _foreign_tracks.get(key)
+    if tid is None:
+        while len(_foreign_tracks) >= _TRACKS_MAX:
+            _foreign_tracks.pop(next(iter(_foreign_tracks)))
+        _next_tid += 1
+        tid = _foreign_tracks[key] = _next_tid
+    return tid
+
+
 # ------------------------------------------------------------------- export
 
 
-def export() -> dict:
+def export(request_id: Optional[str] = None) -> dict:
     """Snapshot the ring as a Chrome trace-event JSON object: events
-    sorted by ts (monotonic), one thread_name metadata record per track."""
+    sorted by ts (monotonic), one thread_name metadata record per track.
+    Foreign events ingested from other processes merge in on their own
+    pid with ``process_name`` metadata — each process a named track
+    group of ONE timeline. `request_id` filters the export (metadata
+    records for the surviving tracks are kept) — the /debug/trace
+    per-request view."""
     # copy() is a single C call that never runs Python code mid-loop, so
     # it cannot observe a concurrent worker-thread append mid-iteration —
     # sorting the live deque directly could raise "mutated during
     # iteration" under a /debug/trace scrape during serving
-    events = sorted(_events.copy(), key=lambda e: e["ts"])
+    local = list(_events.copy())
+    foreign = list(_foreign.copy())
+    if request_id is not None:
+        local = [
+            e for e in local if e["args"].get("request_id") == request_id
+        ]
+        foreign = [
+            e for e in foreign if e["args"].get("request_id") == request_id
+        ]
+    remote = []
     with _tracks_lock:
         tracks = dict(_tracks)
+        for ev in foreign:
+            ev = dict(ev)
+            process = ev.pop("process")
+            track = ev.pop("track")
+            ev["pid"] = _foreign_pid(process)
+            ev["tid"] = _foreign_tid(process, track)
+            remote.append(ev)
+        proc_pids = dict(_foreign_pids)
+        foreign_tracks = dict(_foreign_tracks)
+    events = sorted(local + remote, key=lambda e: e["ts"])
     meta = [
+        {
+            "name": "process_name",
+            "ph": "M",
+            "pid": 0,
+            "tid": 0,
+            "args": {"name": process_label()},
+        }
+    ]
+    meta += [
         {
             "name": "thread_name",
             "ph": "M",
@@ -312,6 +578,29 @@ def export() -> dict:
             "args": {"name": name},
         }
         for name, tid in sorted(tracks.items(), key=lambda kv: kv[1])
+    ]
+    meta += [
+        {
+            "name": "process_name",
+            "ph": "M",
+            "pid": pid,
+            "tid": 0,
+            "args": {"name": name},
+        }
+        for name, pid in sorted(proc_pids.items(), key=lambda kv: kv[1])
+    ]
+    meta += [
+        {
+            "name": "thread_name",
+            "ph": "M",
+            "pid": proc_pids[process],
+            "tid": tid,
+            "args": {"name": track},
+        }
+        for (process, track), tid in sorted(
+            foreign_tracks.items(), key=lambda kv: kv[1]
+        )
+        if process in proc_pids
     ]
     return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
 

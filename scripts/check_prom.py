@@ -1,0 +1,190 @@
+"""Prometheus text-format checker for the /metrics exposition.
+
+CI's answer to "the scrape regressed silently": validates that
+
+- every sample's metric family has a ``# TYPE`` declaration (histogram
+  samples resolve through their ``_bucket``/``_sum``/``_count`` suffixes);
+- no series (name + label set) appears twice — duplicate series make
+  Prometheus drop the scrape;
+- declared families actually expose at least one sample (the zero-series
+  rule: a family that is declared but renders nothing is invisible to
+  rate() from the first scrape);
+- histogram families carry ``+Inf`` bucket, ``_sum`` and ``_count``.
+
+Usage:
+    python scripts/check_prom.py <file>     # validate a saved scrape
+    python scripts/check_prom.py --spawn    # start a real HttpService
+                                            # (echo model + tiny engine
+                                            # metrics + SLO tracker +
+                                            # health counters), GET
+                                            # /metrics over HTTP, then
+                                            # validate the body
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?P<labels>\{[^}]*\})?\s+(?P<value>[^\s]+)$"
+)
+_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
+def _family(name: str, types: dict) -> str:
+    """Resolve a sample name to its declared family (histogram samples
+    carry suffixes the TYPE line does not)."""
+    if name in types:
+        return name
+    for suf in _SUFFIXES:
+        if name.endswith(suf) and name[: -len(suf)] in types:
+            return name[: -len(suf)]
+    return name
+
+
+def validate(text: str) -> list[str]:
+    """Returns a list of problems (empty = clean)."""
+    errors: list[str] = []
+    types: dict[str, str] = {}
+    seen_series: set[str] = set()
+    samples_per_family: dict[str, int] = {}
+    hist_parts: dict[str, set] = {}
+
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split()
+            if len(parts) != 4 or parts[3] not in (
+                "counter", "gauge", "histogram", "summary", "untyped"
+            ):
+                errors.append(f"line {lineno}: malformed TYPE line: {line!r}")
+                continue
+            if parts[2] in types:
+                # the real Prometheus text parser rejects ANY second
+                # TYPE line for a name (even a consistent one) and
+                # drops the whole scrape — so do we
+                errors.append(
+                    f"line {lineno}: duplicate TYPE for {parts[2]} "
+                    f"(Prometheus rejects re-declared families)"
+                )
+                continue
+            types[parts[2]] = parts[3]
+            continue
+        if line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            errors.append(f"line {lineno}: unparseable sample: {line!r}")
+            continue
+        name = m.group("name")
+        fam = _family(name, types)
+        if fam not in types:
+            errors.append(f"line {lineno}: sample {name} has no # TYPE")
+        series = name + (m.group("labels") or "")
+        if series in seen_series:
+            errors.append(f"line {lineno}: duplicate series: {series}")
+        seen_series.add(series)
+        samples_per_family[fam] = samples_per_family.get(fam, 0) + 1
+        if types.get(fam) == "histogram":
+            parts = hist_parts.setdefault(fam, set())
+            if name.endswith("_sum"):
+                parts.add("sum")
+            elif name.endswith("_count"):
+                parts.add("count")
+            elif name.endswith("_bucket") and 'le="+Inf"' in (
+                m.group("labels") or ""
+            ):
+                parts.add("inf")
+        try:
+            float(m.group("value"))
+        except ValueError:
+            errors.append(f"line {lineno}: non-numeric value: {line!r}")
+
+    # zero-series rule: every declared family exposes >= 1 sample
+    for fam in types:
+        if samples_per_family.get(fam, 0) == 0:
+            errors.append(f"family {fam} declared but renders no samples")
+    for fam, parts in hist_parts.items():
+        missing = {"sum", "count", "inf"} - parts
+        if missing:
+            errors.append(f"histogram {fam} missing {sorted(missing)}")
+    return errors
+
+
+async def _spawn_and_scrape() -> str:
+    """Serve a real /metrics (HttpService + engine metrics + SLO tracker
+    + health counters), scrape it over HTTP, return the body."""
+    import aiohttp
+
+    from dynamo_tpu.engine import EngineConfig, JaxEngine
+    from dynamo_tpu.llm.engines import EchoEngineFull
+    from dynamo_tpu.llm.http.metrics import EngineMetrics, SloTracker
+    from dynamo_tpu.llm.http.service import HttpService
+    from dynamo_tpu.models import config as cfgmod
+    from dynamo_tpu.utils import instance
+    from dynamo_tpu.utils.counters import PromCounters
+
+    engine = JaxEngine(
+        EngineConfig(
+            model=cfgmod.get_config("tiny"), dtype="float32",
+            page_size=8, num_pages=64, max_batch_size=4,
+            max_model_len=128, prefill_chunk=32, seed=0,
+        )
+    )
+    slo = SloTracker({"default": {"ttft_s": 2.0, "itl_s": 0.1,
+                                  "queue_wait_s": 1.0}})
+    # one synthetic finished request so attainment windows carry samples
+    slo.observe({"tenant": "default", "ttft_s": 0.5, "itl_s": 0.01,
+                 "queue_wait_s": 0.2})
+    svc = HttpService()
+    svc.manager.add_chat_model("echo", EchoEngineFull())
+    svc.metrics.extra.append(PromCounters())
+    svc.metrics.extra.append(
+        EngineMetrics(engine, slo=slo, worker_id=instance.worker_id())
+    )
+    await svc.start("127.0.0.1", 0)
+    try:
+        async with aiohttp.ClientSession() as session:
+            async with session.get(
+                f"http://127.0.0.1:{svc.port}/metrics"
+            ) as resp:
+                assert resp.status == 200, resp.status
+                return await resp.text()
+    finally:
+        await svc.stop()
+        await engine.close()
+
+
+def main(argv: list[str]) -> int:
+    if argv and argv[0] == "--spawn":
+        import asyncio
+
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        text = asyncio.run(_spawn_and_scrape())
+    elif argv:
+        with open(argv[0]) as f:
+            text = f.read()
+    else:
+        text = sys.stdin.read()
+    errors = validate(text)
+    families = len([ln for ln in text.splitlines()
+                    if ln.startswith("# TYPE ")])
+    if errors:
+        for e in errors:
+            print(f"check_prom: {e}", file=sys.stderr)
+        print(f"check_prom: FAILED ({len(errors)} problems, "
+              f"{families} families)", file=sys.stderr)
+        return 1
+    print(f"check_prom ok: {families} families, "
+          f"{len(text.splitlines())} lines")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

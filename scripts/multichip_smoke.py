@@ -49,9 +49,13 @@ PROMPTS = (
 )
 MAX_TOKENS = 16
 
+# live engines, so the timeout path can still read their phase stats —
+# the MULTICHIP_r05 hang left a bare rc=124 with nothing to bisect on
+_ENGINES: list = []
+
 
 def make_engine(tp: int) -> JaxEngine:
-    return JaxEngine(
+    engine = JaxEngine(
         EngineConfig(
             model=CFG,
             dtype="float32",
@@ -68,6 +72,35 @@ def make_engine(tp: int) -> JaxEngine:
             seed=0,
         )
     )
+    _ENGINES.append(engine)
+    return engine
+
+
+def dump_timeout_artifact() -> str | None:
+    """rc=124 evidence: trace ring + every engine's phase stats/metrics
+    via the shared watchdog artifact writer (utils/artifacts.py)."""
+    from dynamo_tpu.utils import artifacts, tracing
+
+    payload = {
+        "op": "multichip_smoke.timeout",
+        "engines": [
+            {
+                "mesh_tp": e.config.mesh.tp,
+                "phase_stats": e.phase_stats,
+                "metrics": _safe_metrics(e),
+            }
+            for e in _ENGINES
+        ],
+        "trace": tracing.export(),
+    }
+    return artifacts.write_crash_artifact("multichip_smoke", payload)
+
+
+def _safe_metrics(engine) -> dict:
+    try:
+        return engine.metrics()
+    except Exception:  # noqa: BLE001 — artifact beats perfection here
+        return {}
 
 
 async def serve(engine) -> list[list[int]]:
@@ -109,8 +142,20 @@ async def main() -> None:
 
 
 if __name__ == "__main__":
+    # arm the span recorder for the whole run: on the happy path it
+    # costs a ring buffer; on the timeout path it is the step timeline
+    # the crash artifact preserves
+    from dynamo_tpu.utils import tracing as _tracing
+
+    _tracing.enable()
+    _tracing.set_process("multichip-smoke")
     try:
         asyncio.run(asyncio.wait_for(main(), timeout=540))
     except asyncio.TimeoutError:
-        print("multichip smoke TIMED OUT (sharded-path hang)", file=sys.stderr)
+        path = dump_timeout_artifact()
+        print(
+            "multichip smoke TIMED OUT (sharded-path hang); "
+            f"crash artifact: {path or 'write failed'}",
+            file=sys.stderr,
+        )
         sys.exit(124)
