@@ -67,6 +67,13 @@ MIXED_OSL = int(os.environ.get("BENCH_MIXED_OSL", str(max(OSL, 128))))
 # wall (`mixed_sync_s + decode_sync_s`) as a fraction of the total
 # dispatch+sync step wall. Also runs whenever BENCH_MIXED=1 is set.
 PIPE = MIXED or os.environ.get("BENCH_PIPELINE", "") not in ("", "0")
+# BENCH_CONTROL=1: chaos-controller scenario (scripts/control_chaos.py)
+# — spawn a real hub + supervisor-managed worker pool, inject a load
+# spike + DYN_FAULTS worker death, and score the SLO-driven planner on
+# the attainment recovery curve (time-to-recover, goodput retained,
+# graceful lease-revoke drain). Pure control-plane: no model, runs the
+# same at any BENCH_MODEL. Emits the `control` BENCH_OUT section.
+CONTROL = os.environ.get("BENCH_CONTROL", "") not in ("", "0")
 # BENCH_OUT=path: ALSO write a machine-readable JSON results file with
 # every section keyed separately (headline, spec, mixed, mixed_spec) —
 # the stdout line stays the one-line headline artifact. Downstream
@@ -126,6 +133,10 @@ ENV_HELP = """bench.py — serving benchmark; configuration via env vars:
                                prefix/offload ledgers of the probes);
                                stdout keeps the one-line headline
                                artifact
+  BENCH_CONTROL=1              chaos-controller scenario: worker death +
+                               load spike scored on SLO-attainment
+                               recovery (adds the `control` BENCH_OUT
+                               section; scripts/control_chaos.py)
   BENCH_TRACE                  path: record the whole run with the span
                                recorder (utils/tracing.py) and dump
                                Perfetto-loadable trace-event JSON there
@@ -1023,6 +1034,32 @@ def main() -> None:
                     }),
                 },
             }
+    # chaos-controller scenario LAST (it spawns its own hub + worker
+    # processes; the engine above is done by now, so nothing contends)
+    control_result = None
+    if CONTROL:
+        import sys as _sys
+
+        _sys.path.insert(
+            0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "scripts")
+        )
+        import control_chaos
+
+        control_result = control_chaos.run()
+        # the sampler timeline is diagnostic; cap it so BENCH_OUT stays
+        # a small trajectory artifact
+        control_result["timeline"] = control_result["timeline"][:200]
+        print(
+            "control: ttr={} goodput_retained={} ups={} drain_clean={}".format(
+                control_result["time_to_recover_s"],
+                control_result["goodput"]["retained"],
+                control_result["scaling"]["ups"],
+                control_result["drain"]["clean"],
+            ),
+            file=_sys.stderr,
+        )
+
     print(json.dumps(headline))
     if BENCH_OUT:
         # machine-readable trajectory artifact: one file, every section
@@ -1035,6 +1072,9 @@ def main() -> None:
                     "mixed": mixed_result,
                     "mixed_spec": mixed_spec_result,
                     "pipeline_ab": pipeline_result,
+                    # BENCH_CONTROL=1: chaos-controller recovery curve
+                    # (worker death + spike vs the SLO-driven planner)
+                    "control": control_result,
                     # goodput accounting (always present): SLO-gated
                     # throughput over the measured wave + the
                     # per-request prefix/offload ledgers of the probes

@@ -157,6 +157,16 @@ class EngineConfig:
     # crash-artifact directory for watchdog dumps (trace ring + phase
     # stats JSON); None = DYN_CRASH_DIR env or /tmp.
     crash_dir: Optional[str] = None
+    # ---- fleet control plane (docs/control.md) ----
+    # tenant-priority scheduling: admission picks the highest-priority
+    # waiting class (FIFO within a class) and preemption evicts the
+    # lowest-priority, most-recently-admitted sequence first
+    # (Sequence.priority, stamped from Context metadata by the frontend
+    # admission gate). With no priorities in flight both policies reduce
+    # to the pre-priority FIFO/recency behavior, byte-identical; False
+    # forces that reduction even when priority metadata is present
+    # (serialized-baseline comparisons).
+    priority_scheduling: bool = True
     seed: int = 0
 
     def model_config(self) -> ModelConfig:

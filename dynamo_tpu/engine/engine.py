@@ -61,7 +61,11 @@ from dynamo_tpu import compat
 from dynamo_tpu.engine.allocator import PageAllocator
 from dynamo_tpu.engine.config import EngineConfig
 from dynamo_tpu.engine.degrade import DegradeLadder
-from dynamo_tpu.engine.scheduler import Sequence
+from dynamo_tpu.engine.scheduler import (
+    Sequence,
+    pick_admission_index,
+    pick_preemption_victim,
+)
 from dynamo_tpu.llm.protocols.common import (
     FINISH_REASON_CANCELLED,
     FINISH_REASON_ERROR,
@@ -2030,9 +2034,17 @@ class JaxEngine:
             slot = self._free_slot()
             if slot is None:
                 break
-            seq = self.waiting[0]
+            # priority-aware pick: highest class first, FIFO within a
+            # class (scheduler.pick_admission_index) — index 0 whenever
+            # no priorities are in flight, i.e. plain FIFO
+            idx = (
+                pick_admission_index(self.waiting)
+                if self.config.priority_scheduling and len(self.waiting) > 1
+                else 0
+            )
+            seq = self.waiting[idx]
             if seq.ctx.is_stopped():
-                self.waiting.popleft()
+                del self.waiting[idx]
                 # observability parity with _finish: requests that die in
                 # the waiting queue still count in histograms/trace spans
                 self._note_finished(seq, FINISH_REASON_CANCELLED)
@@ -2042,7 +2054,7 @@ class JaxEngine:
                 progressed = True
                 continue
             if seq.max_new_tokens <= 0:
-                self.waiting.popleft()
+                del self.waiting[idx]
                 self._note_finished(seq, FINISH_REASON_LENGTH)
                 seq.out_queue.put_nowait(
                     EngineOutput.final(FINISH_REASON_LENGTH).to_dict()
@@ -2051,7 +2063,7 @@ class JaxEngine:
                 continue
             if not self._reserve_pages(seq):
                 break  # out of pages; wait for something to finish
-            self.waiting.popleft()
+            del self.waiting[idx]
             seq.slot = slot
             seq.prefilling = True
             seq.t_admit = time.perf_counter()
@@ -4027,9 +4039,15 @@ class JaxEngine:
                 seq.page_ids.extend(got)
                 grew = True
                 continue
-            victim = max(
-                (s for s in self.slots if s is not None), key=lambda s: s.seq_id
-            )
+            live = [s for s in self.slots if s is not None]
+            if self.config.priority_scheduling:
+                # lowest priority class first, most-recent within it —
+                # batch traffic yields pages before interactive tenants
+                # (scheduler.pick_preemption_victim; reduces to
+                # max(seq_id) when no priorities are in flight)
+                victim = pick_preemption_victim(live)
+            else:
+                victim = max(live, key=lambda s: s.seq_id)
             self._preempt(victim)
             if victim is seq:
                 return False

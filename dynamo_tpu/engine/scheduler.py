@@ -102,6 +102,14 @@ class Sequence:
     # tenant label for per-tenant SLO attainment (Context metadata
     # "tenant", stamped by the HTTP frontend from x-tenant-id)
     tenant: str = "default"
+    # tenant priority class (Context metadata "priority", stamped by the
+    # frontend admission gate from the --slo-targets config; higher =
+    # more important). Orders admission picks and preemption-victim
+    # selection (pick_admission_index / pick_preemption_victim below) so
+    # a batch-traffic burst cannot starve interactive tenants. 0 (the
+    # default class) everywhere keeps both policies exactly FIFO /
+    # most-recent — byte-identical to the pre-priority engine.
+    priority: int = 0
 
     # per-request sampling (resolved once at admission)
     temperature: float = 0.0
@@ -202,6 +210,10 @@ class Sequence:
         tenant = ctx.metadata.get("tenant")
         if tenant:
             seq.tenant = str(tenant)
+        try:
+            seq.priority = int(ctx.metadata.get("priority") or 0)
+        except (TypeError, ValueError):
+            seq.priority = 0
         # deadline rides Context metadata across hops (the HTTP frontend
         # stamps it from x-request-timeout; see llm/http/service.py)
         try:
@@ -254,3 +266,34 @@ class Sequence:
         if self.generated >= self.max_new_tokens:
             return FINISH_REASON_LENGTH
         return None
+
+
+# ---------------------------------------------------------------- priority
+# Pure scheduling policy over Sequence.priority (docs/control.md): kept
+# here, next to the state they order, so the engine's two call sites
+# (admission pick in _admit_new, victim pick in _ensure_pages_through)
+# cannot drift apart and both are unit-testable without an engine.
+
+
+def pick_admission_index(waiting) -> int:
+    """Index of the next sequence to admit: highest priority class
+    first, FIFO within a class. With uniform priorities this is index 0
+    — exactly the pre-priority FIFO admission, byte-identical. One
+    enumerate pass: `waiting` is a deque, where positional indexing is
+    O(i) and an index-loop scan would go quadratic exactly in the long-
+    queue overload case priorities exist for."""
+    best, best_prio = 0, None
+    for i, seq in enumerate(waiting):
+        if best_prio is None or seq.priority > best_prio:
+            best, best_prio = i, seq.priority
+    return best
+
+
+def pick_preemption_victim(seqs: list) -> "Sequence":
+    """The sequence to preempt when a page allocation fails: lowest
+    priority class first, most-recently-admitted (highest seq_id) within
+    the class — interactive tenants keep their pages while the newest
+    batch work re-queues (its re-prefill usually rides the prefix
+    cache). With uniform priorities this is max(seq_id) — exactly the
+    pre-priority recency policy."""
+    return max(seqs, key=lambda s: (-s.priority, s.seq_id))

@@ -20,6 +20,7 @@ vLLM patch remote_prefill.py + nixl.py):
 from __future__ import annotations
 
 import asyncio
+import time
 import uuid
 from dataclasses import dataclass, field
 from typing import Any, AsyncIterator, Optional
@@ -27,7 +28,10 @@ from typing import Any, AsyncIterator, Optional
 import msgpack
 import numpy as np
 
-from dynamo_tpu.llm.protocols.common import PreprocessedRequest
+from dynamo_tpu.llm.protocols.common import (
+    DeadlineExceededError,
+    PreprocessedRequest,
+)
 from dynamo_tpu.runtime.pipeline.context import Context
 from dynamo_tpu.utils import tracing
 from dynamo_tpu.utils.logging import get_logger
@@ -288,13 +292,39 @@ class DisaggDecodeWorker:
         # remote-prefill stats for planner/metrics
         self.remote_prefills = 0
         self.local_prefills = 0
+        self.remote_timeouts = 0  # waits that expired (fallback or shed)
+        # last observed prefill-queue depth (refreshed by the sampler
+        # task and by decision-path peeks) — the controller's queue
+        # signal, made scrape-visible via ForwardPassMetrics.disagg
+        self.queue_depth = 0
+        self._sampler: Optional[asyncio.Task] = None
 
     async def attach(self) -> "DisaggDecodeWorker":
         """Register the KV ingest endpoint (raw handler, same component)."""
         await self.drt.ensure_data_plane()
         self.drt.data_plane.register(self._ingest_subject, self._ingest)
         await self.router.start()
+        # keep the queue-depth gauge live even when no remote-eligible
+        # request has peeked recently (the stats handler is sync, so the
+        # scrape cannot ask the hub itself)
+        self._sampler = asyncio.get_running_loop().create_task(
+            self._sample_queue()
+        )
         return self
+
+    async def _sample_queue(self, interval_s: float = 1.0) -> None:
+        while True:
+            await asyncio.sleep(interval_s)
+            try:
+                self.queue_depth = int(await self.queue.size())
+            except Exception:  # noqa: BLE001 — hub hiccup: keep the
+                # last observation, never kill the sampler
+                continue
+
+    async def close(self) -> None:
+        if self._sampler is not None:
+            self._sampler.cancel()
+        await self.router.close()
 
     async def _ingest(self, ctx: Context) -> AsyncIterator[bytes]:
         d = msgpack.unpackb(ctx.payload, raw=False)
@@ -360,7 +390,8 @@ class DisaggDecodeWorker:
             # RTT for the queue-depth check
             if self.router.prefill_remote(len(pre.token_ids), prefix_hit, 0):
                 try:
-                    qsize = await self.queue.size()
+                    qsize = int(await self.queue.size())
+                    self.queue_depth = qsize
                 except Exception:  # noqa: BLE001
                     qsize = 0
                 decision = self.router.prefill_remote(
@@ -376,7 +407,6 @@ class DisaggDecodeWorker:
     async def _generate_remote(
         self, request: Context, pre: PreprocessedRequest, blocks=None
     ) -> AsyncIterator[dict]:
-        self.remote_prefills += 1
         rid = f"{request.id}-{uuid.uuid4().hex[:8]}"
         pending = self._pending[rid] = _PendingTransfer()
         req = RemotePrefillRequest(
@@ -385,11 +415,42 @@ class DisaggDecodeWorker:
             decode_address=self.drt.data_plane.address,
             ingest_subject=self._ingest_subject,
         )
+        # clamp the remote-KV wait to the request's end-to-end deadline
+        # (Context metadata, stamped by the HTTP frontend — the PR-6
+        # contract): a wait that outlives the caller's budget only
+        # delays the inevitable 429, and a post-deadline local-prefill
+        # fallback is doomed work the pool can't spare under overload
+        wait_s = 120.0
+        deadline = 0.0
+        try:
+            deadline = float(request.metadata.get("deadline") or 0.0)
+        except (TypeError, ValueError):
+            deadline = 0.0
+        if deadline:
+            remaining = deadline - time.time()
+            if remaining <= 0:
+                self._pending.pop(rid, None)
+                raise DeadlineExceededError(
+                    "request deadline expired before remote prefill"
+                )
+            wait_s = min(wait_s, remaining)
+        # counted only once the request actually goes remote: a shed at
+        # the pre-push deadline check above must not read as a phantom
+        # remote prefill in the scrape-visible ledger
+        self.remote_prefills += 1
         await self.queue.push(req)
         try:
-            await asyncio.wait_for(pending.ready.wait(), timeout=120.0)
+            await asyncio.wait_for(pending.ready.wait(), timeout=wait_s)
         except asyncio.TimeoutError:
             self._pending.pop(rid, None)
+            self.remote_timeouts += 1
+            if deadline and time.time() >= deadline:
+                # the wait consumed the whole budget: shed with the
+                # timeout ladder (429 + Retry-After at the frontend)
+                # instead of silently starting a doomed local prefill
+                raise DeadlineExceededError(
+                    f"remote prefill {rid} timed out at the request deadline"
+                )
             log.warning("remote prefill %s timed out; falling back local", rid)
             return await self.engine.generate(
                 request.map(pre.to_dict()), _blocks=blocks
@@ -412,7 +473,13 @@ class DisaggDecodeWorker:
         )
 
     def stats(self) -> dict[str, Any]:
+        """Disagg decision counters + live queue depth — merged into the
+        worker's ForwardPassMetrics (``disagg`` field) by
+        KvMetricsPublisher so the controller's inputs are scrape-visible
+        on /metrics (metrics_export labeled gauges)."""
         return {
             "remote_prefills": self.remote_prefills,
             "local_prefills": self.local_prefills,
+            "remote_timeouts": self.remote_timeouts,
+            "prefill_queue_depth": self.queue_depth,
         }

@@ -81,9 +81,19 @@ class HttpService:
         metrics: Optional[ServiceMetrics] = None,
         request_template=None,
         request_timeout_s: Optional[float] = None,
+        admission=None,
     ):
         self.manager = manager or ModelManager()
         self.metrics = metrics or ServiceMetrics()
+        # llm.http.admission.AdmissionController: front-door overload
+        # gate — sheds lowest-priority tenants with the typed 429/503 +
+        # Retry-After ladder BEFORE any engine work, and stamps the
+        # tenant's priority class into Context metadata so the engine's
+        # admission/preemption see the same ordering (docs/control.md).
+        # None = every request admitted (the gate idle is a no-op).
+        self.admission = admission
+        if admission is not None:
+            self.metrics.extra.append(admission)
         # llm.request_template.RequestTemplate: deployment defaults filled
         # into bodies that omit model/temperature/max tokens (reference:
         # request_template.rs applied by dynamo-run)
@@ -244,14 +254,34 @@ class HttpService:
                     headers={"Retry-After": "1"},
                 )
 
-        guard = self.metrics.inflight_guard(req.model, kind)
-        ctx = Context(req, request_id=rid)
         # tenant label for per-tenant SLO attainment: rides Context
         # metadata across process hops like the deadline; the engine
         # stamps it into the finish summary (docs/observability.md)
         tenant = request.headers.get("x-tenant-id")
+
+        # front-door admission ladder: under overload (attainment burn +
+        # queue over watermark) the lowest-priority classes shed HERE,
+        # before tokenization or engine admission, with the same typed
+        # 429/503 + Retry-After responses as the deadline/pool ladder
+        if self.admission is not None:
+            verdict = self.admission.check(tenant or "default")
+            if verdict is not None:
+                return _error_response(
+                    verdict.status, verdict.message,
+                    headers={"Retry-After": str(max(1, verdict.retry_after_s))},
+                )
+
+        guard = self.metrics.inflight_guard(req.model, kind)
+        ctx = Context(req, request_id=rid)
         if tenant:
             ctx.metadata["tenant"] = tenant
+        if self.admission is not None:
+            # the admitted request's priority class rides to the engine:
+            # Sequence.priority orders admission picks and preemption
+            # victims (engine/scheduler.py)
+            ctx.metadata["priority"] = self.admission.priority_of(
+                tenant or "default"
+            )
         if timeout_s is not None:
             ctx.metadata["timeout_s"] = timeout_s
             ctx.metadata["deadline"] = time.time() + timeout_s

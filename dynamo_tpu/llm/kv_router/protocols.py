@@ -98,6 +98,11 @@ class ForwardPassMetrics:
     # attainment is one aggregator read (the planner's scale signal).
     # Workers without a tracker send nothing; from_dict tolerates both.
     slo_attainment: dict = field(default_factory=dict)
+    # disaggregated-serving counters from DisaggDecodeWorker.stats()
+    # (remote/local prefill counts, remote-wait timeouts, last observed
+    # prefill-queue depth) — empty on aggregated workers; from_dict
+    # tolerates both (metrics_export renders them as labeled gauges)
+    disagg: dict = field(default_factory=dict)
 
     def to_dict(self) -> dict:
         return asdict(self)
@@ -105,8 +110,9 @@ class ForwardPassMetrics:
     @classmethod
     def from_dict(cls, d: dict) -> "ForwardPassMetrics":
         known = {f: d.get(f) for f in cls.__dataclass_fields__ if f in d}
-        if known.get("slo_attainment") is None:
-            known.pop("slo_attainment", None)
+        for optional in ("slo_attainment", "disagg"):
+            if known.get(optional) is None:
+                known.pop(optional, None)
         return cls(**known)
 
 

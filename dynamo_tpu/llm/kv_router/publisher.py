@@ -77,6 +77,7 @@ class KvMetricsPublisher:
         self,
         source: Optional[Callable[[], dict]] = None,
         slo: Optional[object] = None,
+        disagg_source: Optional[Callable[[], dict]] = None,
     ):
         self._source = source
         # llm/http/metrics.SloTracker (duck-typed: anything with a
@@ -84,11 +85,20 @@ class KvMetricsPublisher:
         # reply so the aggregator sees fleet attainment without a
         # second scrape plane
         self._slo = slo
+        # llm/disagg.DisaggDecodeWorker.stats (duck-typed callable):
+        # remote/local prefill counts + live queue depth ride the same
+        # reply so the disagg decision plane is scrape-visible too
+        self._disagg = disagg_source
         self.current = ForwardPassMetrics()
 
     @classmethod
-    def for_engine(cls, engine, slo: Optional[object] = None) -> "KvMetricsPublisher":
-        return cls(source=engine.metrics, slo=slo)
+    def for_engine(
+        cls,
+        engine,
+        slo: Optional[object] = None,
+        disagg_source: Optional[Callable[[], dict]] = None,
+    ) -> "KvMetricsPublisher":
+        return cls(source=engine.metrics, slo=slo, disagg_source=disagg_source)
 
     def publish(self, metrics: ForwardPassMetrics) -> None:
         self.current = metrics
@@ -103,4 +113,10 @@ class KvMetricsPublisher:
                 self.current.slo_attainment = dict(self._slo.snapshot())
             except Exception:  # noqa: BLE001 — stats must never fail on SLO
                 log.exception("slo snapshot failed; sending without it")
+        if self._disagg is not None:
+            try:
+                self.current.disagg = dict(self._disagg())
+            except Exception:  # noqa: BLE001 — stats must never fail on
+                # disagg counters either
+                log.exception("disagg stats failed; sending without them")
         return self.current.to_dict()

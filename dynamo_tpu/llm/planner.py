@@ -1,9 +1,11 @@
-"""Planner: the serving autoscaler.
+"""Planner: the SLO-driven serving autoscaler (the fleet control loop).
 
-Watches the two load signals of a (possibly disaggregated) deployment —
-prefill queue depth and decode KV-cache utilization — and scales each
-worker pool up or down one replica at a time under a chip budget
-(reference: examples/llm/components/planner.py:51-359 Planner.collect_
+Watches three signals of a (possibly disaggregated) deployment —
+prefill queue depth, decode KV-cache utilization, and **fleet SLO
+attainment** (per-tenant rolling fractions folded through
+`KvMetricsAggregator.attainment()`) — and scales each worker pool up or
+down one replica at a time under a chip budget (reference:
+examples/llm/components/planner.py:51-359 Planner.collect_
 metrics/make_adjustments; components/planner/src/dynamo/planner/
 local_connector.py:105-322 LocalConnector add/remove_component).
 
@@ -12,22 +14,44 @@ Design deltas from the reference, on purpose:
   rescale + lease-revoke drain) instead of circus state files;
 - metrics ride the existing stats plane (`Client.scrape_stats` via
   KvMetricsAggregator) and the hub prefill queue — no extra transport;
-- decisions are pure functions of a metrics window (`PlannerDecision`),
-  so the policy is unit-testable without processes.
+- decisions are pure functions of a metrics window (`decide()` raw
+  eligibility, `GraceGate` per-direction debounce), so the policy is
+  unit-testable without processes;
+- the reference scales on load thresholds only; here attainment burn
+  (worst tenant below target) forces scale-UP and attainment headroom
+  gates scale-DOWN, so low instantaneous load while a tenant is
+  breaching reads as a conflicting signal and HOLDS (docs/control.md).
+
+Every adjustment round publishes a desired-replica status document to
+the hub (`PLANNER_STATUS_PREFIX + namespace`) — the k8s CRD controller
+mirrors it into CR status and `metrics_export` renders it as gauges, so
+the operator path and the scrape plane show the same truth.
 """
 
 from __future__ import annotations
 
 import asyncio
+import json
 import logging
 import statistics
+import time
 from dataclasses import dataclass, field
 from typing import Optional, Protocol
 
 from dynamo_tpu.llm.disagg import PrefillQueue
 from dynamo_tpu.llm.kv_router.metrics_aggregator import KvMetricsAggregator
+from dynamo_tpu.utils import counters, tracing
 
 log = logging.getLogger("dynamo_tpu.planner")
+
+# hub KV key (per dynamo namespace) the planner publishes its desired
+# state to after every adjustment round; consumed by sdk/k8s_controller
+# (CR status mirror) and metrics_export (planner_* gauges)
+PLANNER_STATUS_PREFIX = "/public/planner/"
+
+
+def planner_status_key(namespace: str) -> str:
+    return f"{PLANNER_STATUS_PREFIX}{namespace}"
 
 
 @dataclass
@@ -40,20 +64,45 @@ class PlannerConfig:
     metric_pull_interval_s: float = 1.0
     adjustment_interval_s: float = 10.0
 
-    # thresholds (reference planner.py defaults)
+    # load thresholds (reference planner.py defaults)
     prefill_queue_scale_up_threshold: float = 5.0
     prefill_queue_scale_down_threshold: float = 0.2
     decode_kv_scale_up_threshold: float = 0.9
     decode_kv_scale_down_threshold: float = 0.2
+
+    # SLO attainment policy (PR 7 built the input; this consumes it):
+    # the fleet fold's worst (tenant, metric) window fraction, averaged
+    # over the adjustment window. Below `slo_attainment_target` the
+    # fleet is BURNING -> scale decode up even if load thresholds read
+    # calm (latency SLOs miss before KV fills). Scale-down additionally
+    # requires `slo_headroom` above the target — attainment exactly AT
+    # target has no margin for losing a replica, so low load + at-target
+    # attainment is a conflicting signal and holds. Deployments with no
+    # SLO targets report no attainment and fall back to pure load
+    # thresholds (attainment None = vacuous headroom).
+    slo_attainment_target: float = 0.99
+    slo_headroom: float = 0.005
 
     min_endpoint: int = 1
     max_chip_budget: int = 8
     prefill_engine_num_chips: int = 1
     decode_engine_num_chips: int = 1
 
-    # scale-down needs this many consecutive eligible rounds (grace, so a
-    # fresh scale-up isn't immediately reverted by a transient lull)
+    # per-direction grace: a raw eligibility must hold this many
+    # consecutive rounds before it becomes an action (scale-up acts
+    # fast by default; scale-down is debounced so a transient lull
+    # cannot revert a fresh scale-up)
+    scale_up_grace_rounds: int = 0
     scale_down_grace_rounds: int = 1
+
+    # desired-count decay: budget accounting uses desired (actuated)
+    # counts because booting replicas lag the stats scrape — but a
+    # replica that NEVER shows up (crashed permanently, restarts
+    # exhausted) must not hold phantom budget forever, or a later burn
+    # could read "budget full" and never replace the lost capacity.
+    # After this many consecutive idle rounds of desired > observed,
+    # desired snaps back to observed (chips reclaimed).
+    desired_decay_rounds: int = 3
 
     disagg: bool = True  # False: aggregated serving, no prefill pool
 
@@ -69,8 +118,12 @@ class ScaleConnector(Protocol):
 class SupervisorConnector:
     """Scale via the SDK Supervisor's watchers (in-process equivalent of
     the reference's circus-arbiter state-file dance,
-    local_connector.py:105-322). Removal is graceful: the worker gets
-    SIGTERM, drains its endpoints and revokes its lease."""
+    local_connector.py:105-322). Removal is graceful: the watcher
+    revokes the victim worker's hub lease FIRST (the worker stops
+    pulling, drains in-flight work and exits on its own — the
+    PrefillHandler lease-validity gate pattern), and only escalates to
+    SIGTERM if the drain grace expires (sdk/supervisor.py
+    Watcher._stop_worker)."""
 
     def __init__(self, supervisor, component_to_watcher: dict[str, str]):
         self.supervisor = supervisor
@@ -101,8 +154,23 @@ class MetricsWindow:
 
     prefill_queue: list[float] = field(default_factory=list)
     kv_load: list[float] = field(default_factory=list)
+    # fleet SLO attainment samples (one per poll, when any worker
+    # reports a tracker): worst (tenant, metric) fraction and the mean
+    # across (tenant, metric) keys of per-key means
+    attain_min: list[float] = field(default_factory=list)
+    attain_mean: list[float] = field(default_factory=list)
     num_prefill: int = 0
     num_decode: int = 0
+    # replica counts for BUDGET accounting (None = use the observed
+    # counts above): the planner feeds its own desired state here, since
+    # observation lags actuation — a replica still booting (or dead but
+    # still owning its watcher slot's chips) is invisible to the stats
+    # scrape yet already holds chips, and budget-clamping on the lagging
+    # observation would overshoot the budget during a burn. Floors
+    # (min_endpoint) always use the OBSERVED counts: removing a replica
+    # that only exists on paper could empty the live pool.
+    num_prefill_desired: Optional[int] = None
+    num_decode_desired: Optional[int] = None
 
     @property
     def avg_queue(self) -> float:
@@ -112,6 +180,16 @@ class MetricsWindow:
     def avg_kv_load(self) -> float:
         return statistics.fmean(self.kv_load) if self.kv_load else 0.0
 
+    @property
+    def avg_attain_min(self) -> Optional[float]:
+        """Window-averaged worst-tenant attainment; None when no worker
+        reported attainment (no SLO targets configured anywhere)."""
+        return statistics.fmean(self.attain_min) if self.attain_min else None
+
+    @property
+    def avg_attain_mean(self) -> Optional[float]:
+        return statistics.fmean(self.attain_mean) if self.attain_mean else None
+
 
 @dataclass
 class PlannerDecision:
@@ -119,6 +197,8 @@ class PlannerDecision:
     remove_prefill: bool = False
     add_decode: bool = False
     remove_decode: bool = False
+    # why (observability): "burn", "kv", "queue", "idle+headroom", "hold"
+    reason: str = ""
 
     def __bool__(self) -> bool:
         return any(
@@ -126,46 +206,145 @@ class PlannerDecision:
         )
 
 
+class GraceGate:
+    """Per-direction debounce over raw eligibilities (pure state
+    machine, no clock): an action fires only after its eligibility held
+    `grace + 1` consecutive rounds; any round it does not hold resets
+    that streak. A FIRED scale-up additionally resets the same pool's
+    down-streak — the post-scale-up cooldown that keeps a fresh replica
+    from being reverted by the lull its own arrival creates.
+
+    `decide()` drives the gate INLINE (one `step` per direction per
+    round, removals before adds) so the chip-budget accounting credits
+    only removals that will actually fire this round — a grace-
+    suppressed removal must not lend its chips to a scale-up."""
+
+    _DIRS = ("prefill.up", "prefill.down", "decode.up", "decode.down")
+
+    def __init__(self, up_rounds: int = 0, down_rounds: int = 1):
+        self.up_rounds = max(0, up_rounds)
+        self.down_rounds = max(0, down_rounds)
+        self._streak: dict[str, int] = {d: 0 for d in self._DIRS}
+
+    def _need(self, direction: str) -> int:
+        return self.up_rounds if direction.endswith(".up") else self.down_rounds
+
+    def step(self, direction: str, eligible: bool) -> bool:
+        """Advance one direction's streak for this round; True when the
+        action fires (eligibility held grace+1 consecutive rounds)."""
+        self._streak[direction] = self._streak[direction] + 1 if eligible else 0
+        return eligible and self._streak[direction] >= self._need(direction) + 1
+
+    def fired_up(self, pool: str) -> None:
+        """Cooldown: an executed scale-up restarts the pool's
+        scale-down debounce from zero."""
+        self._streak[f"{pool}.down"] = 0
+
+
 def decide(
-    cfg: PlannerConfig, win: MetricsWindow, decode_grace_left: int
+    cfg: PlannerConfig, win: MetricsWindow, grace: Optional[GraceGate] = None
 ) -> PlannerDecision:
-    """Pure scaling policy over one window (reference:
-    make_adjustments, planner.py:202-320): scale down idle pools first,
-    then scale up the bottleneck — prefill before decode, since a backed-up
-    prefill queue also inflates decode KV load."""
+    """Scaling policy over one window (reference: make_adjustments,
+    planner.py:202-320), now attainment-fed. Raw eligibility rules:
+
+    - scale DOWN an idle pool only when fleet attainment has headroom
+      (avg worst-tenant fraction >= target + headroom, or no attainment
+      reported at all) — low load during a burn is a conflicting signal
+      and HOLDS;
+    - scale UP prefill on queue pressure; scale UP decode on KV
+      pressure OR attainment burn (worst tenant below target) — prefill
+      first, since a backed-up prefill queue also inflates decode KV
+      load; the chip budget clamps both.
+
+    Pass a `GraceGate` to apply per-direction grace debounce (the
+    planner's stateful wrapper); the gate is stepped INLINE — removals
+    before adds — so the chip budget credits only removals that
+    actually fire this round. Without a gate the raw eligibility is
+    returned — the unit-testable decision matrix."""
     d = PlannerDecision()
-    chips_used = (
-        win.num_prefill * cfg.prefill_engine_num_chips
-        + win.num_decode * cfg.decode_engine_num_chips
+    reasons: list[str] = []
+    gated = False  # some eligibility existed but grace suppressed it
+    attain = win.avg_attain_min
+    burning = attain is not None and attain < cfg.slo_attainment_target
+    headroom = attain is None or (
+        attain >= cfg.slo_attainment_target + cfg.slo_headroom
     )
-    if (
+    dp = (
+        win.num_prefill_desired
+        if win.num_prefill_desired is not None else win.num_prefill
+    )
+    dd = (
+        win.num_decode_desired
+        if win.num_decode_desired is not None else win.num_decode
+    )
+    chips_used = (
+        dp * cfg.prefill_engine_num_chips + dd * cfg.decode_engine_num_chips
+    )
+
+    def gate(direction: str, eligible: bool) -> bool:
+        nonlocal gated
+        if grace is None:
+            return eligible
+        fired = grace.step(direction, eligible)
+        gated |= eligible and not fired
+        return fired
+
+    rp_eligible = (
         cfg.disagg
         and win.avg_queue < cfg.prefill_queue_scale_down_threshold
         and win.num_prefill > cfg.min_endpoint
-    ):
+        and headroom
+    )
+    if gate("prefill.down", rp_eligible):
         d.remove_prefill = True
         chips_used -= cfg.prefill_engine_num_chips
-    if (
+        reasons.append("prefill-idle")
+    rd_eligible = (
         win.avg_kv_load < cfg.decode_kv_scale_down_threshold
         and win.num_decode > cfg.min_endpoint
-        and decode_grace_left <= 0
-    ):
+        and headroom
+        and not burning
+    )
+    if gate("decode.down", rd_eligible):
         d.remove_decode = True
         chips_used -= cfg.decode_engine_num_chips
-    if (
-        cfg.disagg
-        and win.avg_queue > cfg.prefill_queue_scale_up_threshold
-        and chips_used + cfg.prefill_engine_num_chips <= cfg.max_chip_budget
-    ):
-        d.add_prefill = True
-        d.remove_prefill = False
-        chips_used += cfg.prefill_engine_num_chips
-    if (
-        win.avg_kv_load > cfg.decode_kv_scale_up_threshold
-        and chips_used + cfg.decode_engine_num_chips <= cfg.max_chip_budget
-    ):
-        d.add_decode = True
-        d.remove_decode = False
+        reasons.append("decode-idle")
+    if cfg.disagg and win.avg_queue > cfg.prefill_queue_scale_up_threshold:
+        if chips_used + cfg.prefill_engine_num_chips <= cfg.max_chip_budget:
+            if gate("prefill.up", True):
+                d.add_prefill = True
+                d.remove_prefill = False
+                chips_used += cfg.prefill_engine_num_chips
+                reasons.append("queue")
+                if grace is not None:
+                    grace.fired_up("prefill")
+        else:
+            gate("prefill.up", False)
+            reasons.append("queue+budget")
+    else:
+        gate("prefill.up", False)
+    if win.avg_kv_load > cfg.decode_kv_scale_up_threshold or burning:
+        if chips_used + cfg.decode_engine_num_chips <= cfg.max_chip_budget:
+            if gate("decode.up", True):
+                d.add_decode = True
+                d.remove_decode = False
+                reasons.append(
+                    "burn" if burning
+                    and win.avg_kv_load <= cfg.decode_kv_scale_up_threshold
+                    else "kv"
+                )
+                if grace is not None:
+                    grace.fired_up("decode")
+        else:
+            gate("decode.up", False)
+            reasons.append(("burn" if burning else "kv") + "+budget")
+    else:
+        gate("decode.up", False)
+    if not d and not reasons and not headroom and attain is not None:
+        reasons.append("hold-no-headroom")
+    d.reason = "+".join(reasons) if reasons else "hold"
+    if gated and not d:
+        d.reason = (d.reason + "+grace") if d.reason != "hold" else "hold+grace"
     return d
 
 
@@ -180,9 +359,24 @@ class Planner:
         self._decode_client = None
         self.aggregator: Optional[KvMetricsAggregator] = None
         self._win = MetricsWindow()
-        self._decode_grace_left = 0
+        self.gate = GraceGate(cfg.scale_up_grace_rounds, cfg.scale_down_grace_rounds)
         self._task: Optional[asyncio.Task] = None
+        # in-flight actuation: connector calls can block for a full
+        # drain grace (lease revoke -> worker finishes in-flight ->
+        # exit), so they run OFF the adjust loop — decision rounds keep
+        # their cadence and a spike arriving mid-drain still gets a
+        # scale-up decision next round (one actuation in flight at a
+        # time; rounds that decide while one runs skip actuating)
+        self._actuation: Optional[asyncio.Task] = None
+        # consecutive rounds each pool's desired count exceeded its
+        # observed count with no actuation in flight (desired decay)
+        self._lag_rounds: dict[str, int] = {}
         self.adjustments: int = 0  # decision rounds taken (observability)
+        self.last_decision: Optional[PlannerDecision] = None
+        self.last_window: Optional[MetricsWindow] = None
+        # desired replica counts per pool, as of the last actuation —
+        # published to the hub status key and mirrored into CR status
+        self.desired: dict[str, int] = {}
 
     async def start(self) -> None:
         ep = (
@@ -204,6 +398,13 @@ class Planner:
                 await self._task
             except asyncio.CancelledError:
                 pass
+        if self._actuation is not None and not self._actuation.done():
+            # let an in-flight drain finish rather than orphaning a
+            # half-rescaled watcher
+            try:
+                await self._actuation
+            except Exception:  # noqa: BLE001
+                pass
         if self.aggregator is not None:
             await self.aggregator.close()
 
@@ -221,32 +422,163 @@ class Planner:
                     for m in snap.endpoints.values()
                 )
             )
+        att = snap.attainment()
+        if att:
+            self._win.attain_min.append(min(v["min"] for v in att.values()))
+            self._win.attain_mean.append(
+                statistics.fmean(v["mean"] for v in att.values())
+            )
         self._win.num_decode = len(snap.endpoints)
 
     async def _adjust(self) -> None:
         win, self._win = self._win, MetricsWindow()
         win.num_prefill = await self._count_prefill()
         win.num_decode = len(self.aggregator.current.endpoints)
-        decision = decide(self.cfg, win, self._decode_grace_left)
+        if self.desired:
+            # budget accounting against the running max of actuated vs
+            # observed: replicas still booting hold chips before they
+            # show up in the stats scrape (see MetricsWindow) — but a
+            # persistent gap with nothing actuating means the replica is
+            # GONE (permanent crash), and its phantom chips decay back
+            # so a burn can still replace the lost capacity
+            self._decay_desired(win)
+            win.num_prefill_desired = max(
+                win.num_prefill,
+                self.desired.get(self.cfg.prefill_component, 0),
+            )
+            win.num_decode_desired = max(
+                win.num_decode,
+                self.desired.get(self.cfg.decode_component, 0),
+            )
+        decision = decide(self.cfg, win, self.gate)
         self.adjustments += 1
-        self._decode_grace_left = max(0, self._decode_grace_left - 1)
-        if not decision:
-            return
-        log.info(
-            "planner: queue=%.2f kv=%.2f p=%d d=%d -> %s",
-            win.avg_queue, win.avg_kv_load, win.num_prefill, win.num_decode,
-            decision,
-        )
+        self.last_decision = decision
+        self.last_window = win
+        if tracing.enabled():
+            tracing.instant(
+                "planner.decide", cat="control",
+                queue=round(win.avg_queue, 3),
+                kv=round(win.avg_kv_load, 3),
+                attain_min=win.avg_attain_min,
+                decision=decision.reason,
+            )
+        if decision:
+            log.info(
+                "planner: queue=%.2f kv=%.2f attain_min=%s p=%d d=%d -> %s",
+                win.avg_queue, win.avg_kv_load,
+                f"{win.avg_attain_min:.4f}" if win.avg_attain_min is not None
+                else "n/a",
+                win.num_prefill, win.num_decode, decision,
+            )
+        desired = {
+            self.cfg.prefill_component: (
+                win.num_prefill_desired
+                if win.num_prefill_desired is not None else win.num_prefill
+            ),
+            self.cfg.decode_component: (
+                win.num_decode_desired
+                if win.num_decode_desired is not None else win.num_decode
+            ),
+        }
+        if decision and (self._actuation is None or self._actuation.done()):
+            # actuate OFF the loop: a scale-down blocks for the whole
+            # lease-revoke drain, and decision rounds must keep sampling
+            self._actuation = asyncio.create_task(
+                self._actuate(decision, desired)
+            )
+        elif decision:
+            log.info("planner: actuation in flight; skipping %s", decision)
+            await self._publish_status()
+        else:
+            self.desired = desired
+            await self._publish_status()
+
+    async def _actuate(self, decision: PlannerDecision, desired: dict) -> None:
+        try:
+            await self._actuate_inner(decision, desired)
+        except asyncio.CancelledError:
+            raise
+        except Exception:  # noqa: BLE001 — a failed actuation must not
+            # surface as an unretrieved task exception; the next round
+            # simply decides again
+            log.exception("planner actuation failed")
+
+    async def _actuate_inner(
+        self, decision: PlannerDecision, desired: dict
+    ) -> None:
         if decision.remove_prefill:
-            await self.connector.remove_component(self.cfg.prefill_component)
+            if await self.connector.remove_component(self.cfg.prefill_component):
+                counters.inc("planner_scale_down_total")
+                desired[self.cfg.prefill_component] -= 1
         if decision.remove_decode:
-            await self.connector.remove_component(self.cfg.decode_component)
+            if await self.connector.remove_component(self.cfg.decode_component):
+                counters.inc("planner_scale_down_total")
+                desired[self.cfg.decode_component] -= 1
         if decision.add_prefill:
-            await self.connector.add_component(self.cfg.prefill_component)
+            if await self.connector.add_component(self.cfg.prefill_component):
+                counters.inc("planner_scale_up_total")
+                desired[self.cfg.prefill_component] += 1
         if decision.add_decode:
             if await self.connector.add_component(self.cfg.decode_component):
-                self._decode_grace_left = self.cfg.scale_down_grace_rounds
-        win.num_prefill = await self._count_prefill()
+                counters.inc("planner_scale_up_total")
+                desired[self.cfg.decode_component] += 1
+        self.desired = desired
+        await self._publish_status()
+
+    def _decay_desired(self, win: MetricsWindow) -> None:
+        idle = self._actuation is None or self._actuation.done()
+        for comp, observed in (
+            (self.cfg.prefill_component, win.num_prefill),
+            (self.cfg.decode_component, win.num_decode),
+        ):
+            if idle and self.desired.get(comp, 0) > observed:
+                self._lag_rounds[comp] = self._lag_rounds.get(comp, 0) + 1
+                if self._lag_rounds[comp] >= self.cfg.desired_decay_rounds:
+                    log.warning(
+                        "planner: %s desired=%d never materialized "
+                        "(observed=%d); reclaiming phantom budget",
+                        comp, self.desired[comp], observed,
+                    )
+                    self.desired[comp] = observed
+                    self._lag_rounds[comp] = 0
+            else:
+                self._lag_rounds[comp] = 0
+
+    def status(self) -> dict:
+        """The desired-state document published after each round (also
+        the exporter's gauge source)."""
+        win = self.last_window
+        return {
+            "namespace": self.cfg.namespace,
+            "desired": dict(self.desired),
+            "observed": {
+                "queue": round(win.avg_queue, 4) if win else 0.0,
+                "kv_load": round(win.avg_kv_load, 4) if win else 0.0,
+                "num_prefill": win.num_prefill if win else 0,
+                "num_decode": win.num_decode if win else 0,
+            },
+            "attainment": {
+                "min": win.avg_attain_min if win else None,
+                "mean": win.avg_attain_mean if win else None,
+                "target": self.cfg.slo_attainment_target,
+            },
+            "last_decision": self.last_decision.reason
+            if self.last_decision else "",
+            "adjustments": self.adjustments,
+            "ts": time.time(),
+        }
+
+    async def _publish_status(self) -> None:
+        """Mirror desired state onto the hub so the CRD controller and
+        the metrics exporter show the same truth as the actuations."""
+        try:
+            await self.runtime.hub.kv_put(
+                planner_status_key(self.cfg.namespace),
+                json.dumps(self.status()).encode(),
+            )
+        except Exception:  # noqa: BLE001 — a status publish must not
+            # kill the control loop (the hub may be restarting)
+            log.exception("planner status publish failed")
 
     async def _count_prefill(self) -> int:
         if not self.cfg.disagg:

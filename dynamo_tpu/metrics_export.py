@@ -32,16 +32,31 @@ PREFIX = "dynamo_llm"
 
 
 class MetricsExporter:
-    def __init__(self, drt, endpoint_path: str, poll_interval: float = 2.0):
+    def __init__(
+        self,
+        drt,
+        endpoint_path: str,
+        poll_interval: float = 2.0,
+        prefill_component: Optional[str] = None,
+    ):
         self.drt = drt
         self.eid = EndpointId.parse(endpoint_path)
         self.poll_interval = poll_interval
+        # disagg/control plane: poll the hub prefill queue for the LIVE
+        # fleet queue depth (the planner's prefill signal; per-worker
+        # last-observed depths also ride ForwardPassMetrics.disagg) and
+        # the planner's published status document for desired-replica
+        # gauges — the whole control episode is scrape-visible
+        self.prefill_component = prefill_component
+        self.prefill_queue_depth: Optional[int] = None
+        self.planner_status: dict = {}
         self.aggregator: Optional[KvMetricsAggregator] = None
         self.hit_events = 0
         self.hit_tokens = 0
         self.request_tokens = 0
         self._sub = None
         self._task: Optional[asyncio.Task] = None
+        self._control_task: Optional[asyncio.Task] = None
         self.app = web.Application()
         self.app.add_routes([web.get("/metrics", self._metrics)])
         self._runner: Optional[web.AppRunner] = None
@@ -61,11 +76,52 @@ class MetricsExporter:
         comp = self.drt.namespace(self.eid.namespace).component(self.eid.component)
         self._sub = await comp.subscribe(KV_HIT_RATE_SUBJECT)
         self._task = asyncio.create_task(self._pump_hit_rate())
+        self._control_task = asyncio.create_task(self._poll_control())
         self._runner = web.AppRunner(self.app)
         await self._runner.setup()
         site = web.TCPSite(self._runner, host, port)
         await site.start()
         self.port = site._server.sockets[0].getsockname()[1]
+
+    async def _poll_control(self) -> None:
+        """Control-plane poll (render() is sync and must not touch the
+        hub): live prefill-queue depth + the planner status document."""
+        import json
+
+        from dynamo_tpu.llm.disagg import PrefillQueue
+        from dynamo_tpu.llm.planner import planner_status_key
+
+        import time
+
+        queue = (
+            PrefillQueue(self.drt.hub, self.eid.namespace, self.prefill_component)
+            if self.prefill_component else None
+        )
+        key = planner_status_key(self.eid.namespace)
+        while True:
+            if queue is not None:
+                try:
+                    self.prefill_queue_depth = int(await queue.size())
+                except Exception:  # noqa: BLE001 — queue may not exist yet
+                    pass
+            try:
+                ent = await self.drt.hub.kv_get(key)
+                if ent is None:
+                    # the planner's key is GONE (stopped, hub wiped):
+                    # stop rendering its last state as live truth
+                    self.planner_status = {}
+                else:
+                    doc = json.loads(bytes(ent["value"]))
+                    # a stale ts means the planner stopped publishing
+                    # (crashed without the key expiring) — same rule
+                    ts = float(doc.get("ts") or 0.0)
+                    self.planner_status = (
+                        doc if not ts or time.time() - ts < 120.0 else {}
+                    )
+            except Exception:  # noqa: BLE001 — transient hub error:
+                # keep the last snapshot, retry next poll
+                pass
+            await asyncio.sleep(self.poll_interval)
 
     async def _pump_hit_rate(self) -> None:
         import msgpack
@@ -116,6 +172,14 @@ class MetricsExporter:
                     f'{{worker_id="{wid:x}",tenant="{tenant}",'
                     f'metric="{metric}"}}',
                 )
+            # disagg decision plane (DisaggDecodeWorker.stats riding
+            # ForwardPassMetrics.disagg): remote/local prefill counts,
+            # remote-wait timeouts, last observed queue depth
+            for key, val in sorted((m.disagg or {}).items()):
+                try:
+                    gauge(f"disagg_{key}", float(val), lab)
+                except (TypeError, ValueError):
+                    continue
         loads = [m.kv_active_blocks for m in eps.values()]
         gauge("load_avg", statistics.fmean(loads) if loads else 0.0)
         gauge("load_std", statistics.pstdev(loads) if len(loads) > 1 else 0.0)
@@ -127,6 +191,26 @@ class MetricsExporter:
                 lab = f'{{tenant="{tenant}",metric="{metric}"}}'
                 gauge("slo_attainment_fleet_mean", agg["mean"], lab)
                 gauge("slo_attainment_fleet_min", agg["min"], lab)
+        # control plane: live hub prefill-queue depth (the planner's
+        # prefill signal, --prefill-component) and the planner's last
+        # published desired state — scale decisions are scrape-visible
+        if self.prefill_queue_depth is not None:
+            gauge("prefill_queue_depth", self.prefill_queue_depth)
+        if self.planner_status:
+            for pool, n in sorted(
+                (self.planner_status.get("desired") or {}).items()
+            ):
+                gauge(
+                    "planner_desired_replicas", n, f'{{pool="{pool}"}}'
+                )
+            att = self.planner_status.get("attainment") or {}
+            for k in ("min", "mean"):
+                if att.get(k) is not None:
+                    gauge(f"planner_attainment_{k}", att[k])
+            gauge(
+                "planner_adjustments_total",
+                self.planner_status.get("adjustments", 0),
+            )
         lines.append(f"# TYPE {PREFIX}_kv_hit_rate_events counter")
         lines.append(f"{PREFIX}_kv_hit_rate_events {self.hit_events}")
         lines.append(f"# TYPE {PREFIX}_kv_hit_tokens counter")
@@ -143,6 +227,8 @@ class MetricsExporter:
     async def stop(self) -> None:
         if self._task:
             self._task.cancel()
+        if self._control_task:
+            self._control_task.cancel()
         if self._sub is not None:
             await self._sub.unsubscribe()
         if self.aggregator:
@@ -155,7 +241,10 @@ async def amain(args) -> None:
     from dynamo_tpu.runtime.distributed import DistributedRuntime
 
     drt = await DistributedRuntime.from_settings(hub_addr=args.hub)
-    exporter = MetricsExporter(drt, args.endpoint, poll_interval=args.poll_interval)
+    exporter = MetricsExporter(
+        drt, args.endpoint, poll_interval=args.poll_interval,
+        prefill_component=args.prefill_component,
+    )
     await exporter.start(args.host, args.port)
     print(f"prometheus metrics on :{exporter.port}/metrics")
     await asyncio.Event().wait()
@@ -168,6 +257,10 @@ def main() -> None:
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--port", type=int, default=9091)
     p.add_argument("--poll-interval", type=float, default=2.0)
+    p.add_argument("--prefill-component", default=None,
+                   help="disagg prefill component name: poll its hub "
+                        "queue and render prefill_queue_depth (the "
+                        "planner's prefill signal, live)")
     args = p.parse_args()
     configure_logging()
     asyncio.run(amain(args))

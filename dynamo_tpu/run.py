@@ -80,11 +80,20 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--slo-targets",
                    help="JSON file of per-tenant SLO targets "
                         '({"default": {"ttft_s": 2.0, "itl_s": 0.05, '
-                        '"queue_wait_s": 1.0}, "<tenant>": {...}}; the '
+                        '"queue_wait_s": 1.0, "priority": 0}, '
+                        '"<tenant>": {...}}; the '
                         "DYN_SLO_TARGETS env var takes inline JSON) — "
                         "renders slo_attainment/slo_breaches_total on "
-                        "/metrics and rides worker stats replies "
-                        "(docs/observability.md)")
+                        "/metrics, rides worker stats replies, and the "
+                        "optional per-tenant priority int feeds the "
+                        "admission/preemption ladder "
+                        "(docs/observability.md, docs/control.md)")
+    p.add_argument("--admission", action="store_true",
+                   help="arm the front-door admission gate (DYN_ADMISSION=1 "
+                        "equivalent): under overload (SLO attainment "
+                        "burning + queue over watermark) lowest-priority "
+                        "tenants shed with 429/503 + Retry-After "
+                        "(docs/control.md)")
     p.add_argument("--disagg-mode", choices=["agg", "decode", "prefill"],
                    default="agg", help="worker role in a disaggregated graph")
     p.add_argument("--max-local-prefill-length", type=int, default=128)
@@ -130,6 +139,66 @@ def build_slo_tracker(args):
 
     targets = load_slo_targets(args)
     return SloTracker(targets) if targets else None
+
+
+def build_admission(args):
+    """Front-door admission gate (docs/control.md): armed by
+    --admission (or DYN_ADMISSION=1) with tenant priority classes from
+    the same --slo-targets file ("priority": int per tenant). Signals
+    (queue depth + attainment) are late-bound once the engine or fleet
+    aggregator exists."""
+    import os
+
+    if not (getattr(args, "admission", False)
+            or os.environ.get("DYN_ADMISSION", "") not in ("", "0")):
+        return None
+    from dynamo_tpu.llm.http.admission import (
+        AdmissionConfig,
+        AdmissionController,
+        priorities_from_targets,
+    )
+
+    cfg = AdmissionConfig()
+    if os.environ.get("DYN_ADMISSION_QUEUE_HIGH"):
+        cfg.queue_high_watermark = float(os.environ["DYN_ADMISSION_QUEUE_HIGH"])
+    if os.environ.get("DYN_ADMISSION_ATTAIN_FLOOR"):
+        cfg.attainment_floor = float(os.environ["DYN_ADMISSION_ATTAIN_FLOOR"])
+    return AdmissionController(
+        priorities=priorities_from_targets(load_slo_targets(args)), cfg=cfg
+    )
+
+
+def _bind_ingress_admission(admission, watcher) -> None:
+    """Fleet signals for an ingress-mode admission gate: mean waiting
+    depth per worker + worst fleet attainment, read from the kv
+    routers' metrics aggregators (router_mode=kv; other modes have no
+    aggregator and the gate stays signal-less = always ok)."""
+    import statistics
+
+    def _aggs():
+        return [
+            r.router.aggregator
+            for r in watcher._kv_routers.values()
+            if getattr(r, "router", None) is not None
+        ]
+
+    def queue_depth():
+        waits = [
+            m.num_requests_waiting
+            for agg in _aggs()
+            for m in agg.current.endpoints.values()
+        ]
+        return statistics.fmean(waits) if waits else 0.0
+
+    def attainment():
+        mins = [
+            v["min"]
+            for agg in _aggs()
+            for v in agg.attainment().values()
+        ]
+        return min(mins) if mins else None
+
+    admission.bind(queue_depth_fn=queue_depth, attainment_fn=attainment)
 
 
 def build_engine_config_kwargs(args) -> dict:
@@ -208,8 +277,10 @@ async def run_http(args, out: str) -> None:
         from dynamo_tpu.llm.request_template import RequestTemplate
 
         template = RequestTemplate.load(args.request_template)
+    admission = build_admission(args)
     svc = HttpService(
-        request_template=template, request_timeout_s=args.request_timeout
+        request_template=template, request_timeout_s=args.request_timeout,
+        admission=admission,
     )
     # process-global health counters (hub reconnects, lease expiries,
     # transport retries, breaker trips, injected faults) ride the same
@@ -225,6 +296,8 @@ async def run_http(args, out: str) -> None:
         drt = await DistributedRuntime.from_settings(hub_addr=args.hub)
         watcher = ModelWatcher(drt, svc.manager, router_mode=args.router_mode)
         await watcher.start()
+        if admission is not None:
+            _bind_ingress_admission(admission, watcher)
         if tracing.enabled():
             # fleet trace plane: collect spans shipped by workers so
             # /debug/trace renders ONE merged timeline across processes
@@ -252,12 +325,26 @@ async def run_http(args, out: str) -> None:
             # attainment tracker when targets are configured
             from dynamo_tpu.llm.http.metrics import EngineMetrics
 
+            slo = build_slo_tracker(args)
             svc.metrics.extra.append(
                 EngineMetrics(
-                    engine, slo=build_slo_tracker(args),
+                    engine, slo=slo,
                     worker_id=instance.worker_id(),
                 )
             )
+            if admission is not None:
+                # local signals: the engine's own waiting depth + the
+                # local tracker's worst rolling fraction
+                def _local_attain():
+                    snap = slo.snapshot() if slo is not None else {}
+                    return min(snap.values()) if snap else None
+
+                admission.bind(
+                    queue_depth_fn=lambda: float(
+                        engine.metrics().get("num_requests_waiting", 0)
+                    ),
+                    attainment_fn=_local_attain,
+                )
     await svc.start(args.http_host, args.http_port)
     log.info("serving OpenAI HTTP on %s:%d", args.http_host, svc.port)
     await asyncio.Event().wait()
@@ -306,7 +393,6 @@ async def run_worker(args, inp: str, out: str) -> None:
     slo = build_slo_tracker(args)
     if slo is not None:
         engine.subscribe_requests(slo.observe)
-    metrics = KvMetricsPublisher.for_engine(engine, slo=slo)
 
     if args.disagg_mode == "prefill":
         from dynamo_tpu.llm.disagg import PrefillHandler
@@ -317,6 +403,7 @@ async def run_worker(args, inp: str, out: str) -> None:
         return
 
     serving_engine = engine
+    disagg_stats = None
     if args.disagg_mode == "decode":
         from dynamo_tpu.llm.disagg import (
             DisaggConfig,
@@ -335,6 +422,13 @@ async def run_worker(args, inp: str, out: str) -> None:
         )
         await worker.attach()
         serving_engine = worker
+        # remote/local prefill counts + live queue depth ride the stats
+        # replies (ForwardPassMetrics.disagg) so the controller's inputs
+        # are scrape-visible via metrics_export
+        disagg_stats = worker.stats
+    metrics = KvMetricsPublisher.for_engine(
+        engine, slo=slo, disagg_source=disagg_stats
+    )
 
     # attach the event publisher BEFORE the worker becomes discoverable:
     # events from requests arriving in the gap would be lost forever (the

@@ -189,6 +189,13 @@ class CrdController:
         self._hub: Optional[HubClient] = None
         self._applied: dict[str, dict] = {}  # doc key -> spec doc
         self._status_gen: dict[str, object] = {}  # doc key -> generation
+        self._planner_task: Optional[asyncio.Task] = None
+        self._planner_watch = None
+        # (doc key, planner ns) -> last planner block patched, so a
+        # status republish with unchanged content (the planner writes
+        # every round) doesn't amplify into an API patch per CR per
+        # round
+        self._planner_applied: dict[tuple, dict] = {}
         self._stop = asyncio.Event()
 
     async def _reconcile(self, cr: dict) -> None:
@@ -229,6 +236,7 @@ class CrdController:
         # an entry per deleted CR and suppress the Applied status update
         # if the CR is ever recreated at the same generation
         self._status_gen.pop(key, None)
+        self._drop_planner_cache(key)
         log.info("removed %s (operator will drain)", key)
 
     async def _status(
@@ -245,10 +253,88 @@ class CrdController:
         except Exception:
             log.exception("status patch failed for %s", meta.get("name"))
 
+    async def _mirror_planner(self) -> None:
+        """Mirror the autoscaler's desired-replica status into CR status
+        (docs/control.md): watch the planner's hub status documents
+        (llm/planner.PLANNER_STATUS_PREFIX, one per dynamo namespace)
+        and PATCH every controller-owned CR with the latest planner
+        block — the operator path shows the same desired state the
+        planner actuated through the Supervisor. Level-triggered like
+        run(): a hub hiccup or stream end re-watches (snapshot replays
+        the latest docs) instead of silently freezing CR status."""
+        from dynamo_tpu.llm.planner import PLANNER_STATUS_PREFIX
+
+        while not self._stop.is_set():
+            try:
+                self._planner_watch = await self._hub.watch_prefix(
+                    PLANNER_STATUS_PREFIX
+                )
+                for item in self._planner_watch.snapshot:
+                    await self._apply_planner_status(item["value"])
+                async for ev in self._planner_watch:
+                    if ev["type"] == "put":
+                        await self._apply_planner_status(ev["value"])
+                    if self._stop.is_set():
+                        return
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 — re-watch, never freeze
+                log.exception("planner mirror watch error; re-watching in 2s")
+                await asyncio.sleep(2.0)
+
+    async def _apply_planner_status(self, raw: bytes) -> None:
+        try:
+            doc = json.loads(raw)
+            ns = str(doc.get("namespace") or "default")
+            planner = {
+                "desiredReplicas": doc.get("desired") or {},
+                "attainment": doc.get("attainment") or {},
+                "lastDecision": doc.get("last_decision", ""),
+                "adjustments": doc.get("adjustments", 0),
+            }
+            # dedup key EXCLUDES the per-round adjustments counter: the
+            # planner republishes every round, and patching N CRs per
+            # round for a counter tick alone would hammer the API
+            # server — a patch goes out only when the meaningful state
+            # (desired replicas / attainment / decision) changed
+            dedup = {k: v for k, v in planner.items() if k != "adjustments"}
+        except Exception:  # noqa: BLE001 — a malformed status doc must
+            # not kill the mirror loop
+            log.exception("bad planner status ignored")
+            return
+        for key in list(self._applied):
+            if self._planner_applied.get((key, ns)) == dedup:
+                continue
+            ns_name = key[len(GRAPH_PREFIX):]
+            cr_ns, _, name = ns_name.partition(".")
+            try:
+                # keyed by the planner's DYNAMO namespace under
+                # status.planner: multiple planners (one per namespace)
+                # merge-patch their own subkey instead of clobbering
+                # each other's block last-writer-wins. (The CR spec does
+                # not name its dynamo namespace, so ownership cannot be
+                # filtered here — every controller-owned CR carries
+                # every planner's subkey; single-planner deployments see
+                # exactly their own.)
+                await self.api.patch_status(
+                    cr_ns or "default", name, {"planner": {ns: planner}}
+                )
+                self._planner_applied[(key, ns)] = dedup
+            except Exception:  # noqa: BLE001
+                log.exception("planner status patch failed for %s", key)
+
+    def _drop_planner_cache(self, key: str) -> None:
+        """Forget patched-planner state for a deleted CR: a re-created
+        CR starts with empty status and must get the first patch even
+        when the planner content has not changed since."""
+        for k in [k for k in self._planner_applied if k[0] == key]:
+            del self._planner_applied[k]
+
     async def run(self) -> None:
         """LIST (sync every CR + prune stale docs), then WATCH; on stream
         end or error, re-list — the standard level-triggered loop."""
         self._hub = await HubClient.connect(self.hub_addr)
+        self._planner_task = asyncio.create_task(self._mirror_planner())
         try:
             while not self._stop.is_set():
                 try:
@@ -277,6 +363,7 @@ class CrdController:
                             await self._hub.kv_del(key)
                             self._applied.pop(key, None)
                             self._status_gen.pop(key, None)
+                            self._drop_planner_cache(key)
                             log.info("pruned orphaned %s", key)
                     rv = (listing.get("metadata") or {}).get(
                         "resourceVersion", "0"
@@ -296,6 +383,17 @@ class CrdController:
                     log.exception("watch loop error; re-listing in 2s")
                     await asyncio.sleep(2.0)
         finally:
+            if self._planner_task is not None:
+                self._planner_task.cancel()
+                try:
+                    await self._planner_task
+                except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                    pass
+            if self._planner_watch is not None:
+                try:
+                    await self._planner_watch.cancel()
+                except Exception:  # noqa: BLE001 — hub may be gone
+                    pass
             await self._hub.close()
 
     def stop(self) -> None:

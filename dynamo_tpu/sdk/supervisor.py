@@ -31,6 +31,20 @@ from dynamo_tpu.sdk.service import ServiceSpec, discover_graph, get_spec
 log = logging.getLogger("dynamo_tpu.sdk.supervisor")
 
 GRACE_PERIOD_S = 10.0
+# scale-down drain: after revoking the victim's lease, how long to wait
+# for it to finish in-flight work and exit on its own before escalating
+# to SIGTERM (docs/control.md "Graceful drain")
+DRAIN_GRACE_S = 10.0
+
+# hub KV prefix where workers publish their primary-lease id (attached
+# to the lease itself, so the key vanishes with the worker); the watcher
+# reads it back at scale-down to revoke the lease BEFORE stopping the
+# process
+WORKER_LEASE_PREFIX = "/public/workers/"
+
+
+def worker_lease_key(watcher_name: str, worker_id: int) -> str:
+    return f"{WORKER_LEASE_PREFIX}{watcher_name}/{worker_id}"
 
 
 class Watcher:
@@ -51,6 +65,15 @@ class Watcher:
         self.numprocesses = numprocesses
         self.max_restarts = max_restarts
         self.restart_backoff_s = restart_backoff_s
+        # hub address for lease-revoke drain on scale-down (set by the
+        # Supervisor at start; None = SIGTERM-only stops)
+        self.hub_addr: Optional[str] = None
+        self.drain_grace_s = DRAIN_GRACE_S
+        # drain observability: ("lease_revoked"|"drained"|"sigterm"|
+        # "killed", wid) in the order they happened — the planner's
+        # scale-down contract ("revoke precedes stop") is asserted on
+        # this log in tests
+        self.events: list[tuple[str, int]] = []
         self._tasks: dict[int, asyncio.Task] = {}
         self._procs: dict[int, asyncio.subprocess.Process] = {}
         self._stopping = False
@@ -81,7 +104,10 @@ class Watcher:
                 *self.args,
                 "--worker-id",
                 str(wid),
-                env={**os.environ, **self.env},
+                # DYN_WATCHER_NAME keys the worker's lease-registration
+                # key (worker_lease_key) so scale-down can revoke it
+                env={**os.environ, **self.env,
+                     "DYN_WATCHER_NAME": self.name},
             )
             self._procs[wid] = proc
             log.info("%s[%d] started pid=%d", self.name, wid, proc.pid)
@@ -130,21 +156,65 @@ class Watcher:
         for wid in live[n:]:
             await self._stop_worker(wid)
 
+    async def _drain_worker(self, wid: int, proc) -> bool:
+        """Lease-revoke graceful drain (docs/control.md): revoke the
+        worker's hub lease so it stops pulling work (its endpoints
+        vanish from discovery, its queue pulls gate closed — the
+        PrefillHandler lease-validity pattern), finishes in-flight
+        streams, and exits on its own. True when the process exited
+        within the drain grace; False falls back to SIGTERM."""
+        if self.hub_addr is None:
+            return False
+        from dynamo_tpu.runtime.hub.client import HubClient
+
+        try:
+            client = await HubClient.connect(self.hub_addr)
+        except Exception:  # noqa: BLE001 — no hub, no drain
+            return False
+        try:
+            ent = await client.kv_get(worker_lease_key(self.name, wid))
+            if ent is None:
+                return False
+            lease_id = int(bytes(ent["value"]).decode())
+            await client.request("lease_revoke", lease_id=lease_id)
+            self.events.append(("lease_revoked", wid))
+            log.info("%s[%d] lease %#x revoked; draining", self.name, wid,
+                     lease_id)
+        except Exception:  # noqa: BLE001 — a malformed/missing lease key
+            # must degrade to the SIGTERM path, not wedge the rescale
+            log.exception("%s[%d] lease-revoke drain failed", self.name, wid)
+            return False
+        finally:
+            await client.close()
+        try:
+            await asyncio.wait_for(proc.wait(), self.drain_grace_s)
+        except asyncio.TimeoutError:
+            log.warning("%s[%d] did not drain in %.1fs; escalating to "
+                        "SIGTERM", self.name, wid, self.drain_grace_s)
+            return False
+        self.events.append(("drained", wid))
+        return True
+
     async def _stop_worker(self, wid: int, grace: float = GRACE_PERIOD_S) -> None:
         task = self._tasks.pop(wid, None)
         proc = self._procs.get(wid)
         if proc is not None and proc.returncode is None:
-            # mark this one slot non-restarting by cancelling its runner
-            # after the process exits gracefully
-            try:
-                proc.terminate()
-            except ProcessLookupError:
-                pass
-            try:
-                await asyncio.wait_for(proc.wait(), grace)
-            except asyncio.TimeoutError:
-                log.warning("%s[%d] ignored SIGTERM; killing", self.name, wid)
-                proc.kill()
+            # graceful path first: revoke the lease and let the worker
+            # drain itself; SIGTERM only as escalation
+            if not await self._drain_worker(wid, proc):
+                # mark this one slot non-restarting by cancelling its
+                # runner after the process exits gracefully
+                try:
+                    proc.terminate()
+                    self.events.append(("sigterm", wid))
+                except ProcessLookupError:
+                    pass
+                try:
+                    await asyncio.wait_for(proc.wait(), grace)
+                except asyncio.TimeoutError:
+                    log.warning("%s[%d] ignored SIGTERM; killing", self.name, wid)
+                    proc.kill()
+                    self.events.append(("killed", wid))
         if task is not None:
             task.cancel()
             try:
@@ -254,6 +324,8 @@ class Supervisor:
             log.info("started in-process hub at %s", self.hub_addr)
         for w in self.watchers.values():
             w.env.setdefault("DYN_HUB_ADDR", self.hub_addr)
+            # arm the lease-revoke drain path for scale-downs
+            w.hub_addr = self.hub_addr
             await w.start()
 
     async def stop(self) -> None:

@@ -32,6 +32,41 @@ class _BoundEngine:
         return await self._fn(request)
 
 
+async def publish_worker_lease(drt, watcher_name: str, worker_id: int) -> None:
+    """Register this worker's primary-lease id under the supervisor's
+    well-known key (sdk/supervisor.worker_lease_key), ATTACHED to the
+    lease itself so the key dies with the worker. The watcher reads it
+    back at scale-down to revoke the lease before stopping the process
+    (docs/control.md "Graceful drain")."""
+    from dynamo_tpu.sdk.supervisor import worker_lease_key
+
+    if drt.primary_lease is None:
+        return
+    await drt.hub.kv_put(
+        worker_lease_key(watcher_name, worker_id),
+        str(drt.primary_lease.lease_id).encode(),
+        lease=drt.primary_lease,
+    )
+
+
+async def lease_gate(drt, stop_evt: asyncio.Event, poll_s: float = 0.5) -> None:
+    """Drain trigger: poll primary-lease validity (the PrefillHandler
+    gate pattern, llm/disagg) and set `stop_evt` when the lease is gone
+    — the supervisor revoked it for a graceful scale-down, or the hub
+    expired it. The worker then stops pulling, finishes in-flight work
+    and exits 0."""
+    while not stop_evt.is_set():
+        await asyncio.sleep(poll_s)
+        try:
+            ok = await drt.primary_lease.is_valid()
+        except Exception:  # noqa: BLE001 — a hub hiccup is not a revoke
+            continue
+        if not ok:
+            log.info("primary lease revoked/expired; draining worker")
+            stop_evt.set()
+            return
+
+
 def _apply_chip_env(worker_id: int) -> None:
     """Slice this worker's disjoint chip range out of the watcher's
     allocation (reference: ResourceAllocator.assign_gpus setting
@@ -60,6 +95,14 @@ async def amain(entry_ident: str, service_name: str, worker_id: int) -> None:
     loop = asyncio.get_running_loop()
     for sig in (signal.SIGINT, signal.SIGTERM):
         loop.add_signal_handler(sig, stop_evt.set)
+
+    # lease-revoke drain contract with the supervisor: publish the lease
+    # id under the watcher's key and stop when the lease is revoked
+    watcher_name = os.environ.get("DYN_WATCHER_NAME")
+    gate_task = None
+    if watcher_name:
+        await publish_worker_lease(drt, watcher_name, worker_id)
+        gate_task = asyncio.create_task(lease_gate(drt, stop_evt))
 
     instance = spec.cls.__new__(spec.cls)
     # runtime context available to __init__ and hooks (reference:
@@ -91,6 +134,8 @@ async def amain(entry_ident: str, service_name: str, worker_id: int) -> None:
 
     await stop_evt.wait()
     log.info("%s[%d]: draining", spec.name, worker_id)
+    if gate_task is not None:
+        gate_task.cancel()
     for s in served:
         await s.shutdown()
     await drt.shutdown()

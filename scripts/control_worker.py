@@ -1,0 +1,134 @@
+"""Chaos-controller scenario worker (driven by scripts/control_chaos.py).
+
+A deliberately tiny "decode worker" with honest queueing dynamics and a
+real SLO tracker, so the fleet control loop can be scored end to end
+without a model:
+
+- serves ``<ns>.<component>.generate`` on the hub data plane; each
+  request takes a fixed service time on one of ``CHAOS_LANES`` parallel
+  lanes, so saturation produces REAL queueing delay (latency degrades
+  when capacity is lost, recovers when the planner adds a replica);
+- a real `SloTracker` (short rolling window) judges every request
+  against the TTFT target; its window fractions ride the stats replies
+  exactly like a production worker's (ForwardPassMetrics.slo_attainment
+  -> KvMetricsAggregator.attainment() — the planner's input);
+- publishes its primary-lease id under the supervisor's drain key and
+  runs the lease-validity gate (sdk/worker.py), so a planner scale-down
+  drains it gracefully: revoke -> stop pulling -> finish in-flight ->
+  exit 0;
+- the designated victim (``CHAOS_VICTIM`` == --worker-id) consults the
+  ``worker.die`` fault point per request: with
+  ``DYN_FAULTS=worker.die.fail@N`` it hard-exits (rc 1) on its N-th
+  request — the deterministic worker-death injection the scenario
+  scores recovery from.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import os
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+from dynamo_tpu.llm.http.metrics import SloTracker  # noqa: E402
+from dynamo_tpu.utils import faults  # noqa: E402
+from dynamo_tpu.utils.logging import configure_logging  # noqa: E402
+
+NS = os.environ.get("CHAOS_NS", "chaos")
+COMPONENT = os.environ.get("CHAOS_COMPONENT", "backend")
+SERVICE_S = float(os.environ.get("CHAOS_SERVICE_S", "0.04"))
+LANES = int(os.environ.get("CHAOS_LANES", "4"))
+TTFT_TARGET_S = float(os.environ.get("CHAOS_TTFT_S", "0.2"))
+SLO_WINDOW_S = float(os.environ.get("CHAOS_SLO_WINDOW_S", "3.0"))
+
+
+async def amain(worker_id: int) -> None:
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+    from dynamo_tpu.sdk.worker import lease_gate, publish_worker_lease
+
+    # short lease TTL: a hard-killed victim must vanish from discovery
+    # fast enough for the scenario's recovery clock to be about the
+    # CONTROLLER, not the lease horizon
+    drt = await DistributedRuntime.from_settings(  # DYN_HUB_ADDR
+        lease_ttl=float(os.environ.get("CHAOS_LEASE_TTL", "1.5"))
+    )
+    stop = asyncio.Event()
+    victim = worker_id == int(os.environ.get("CHAOS_VICTIM", "-1"))
+    slo = SloTracker(
+        {"default": {"ttft_s": TTFT_TARGET_S}}, window_s=SLO_WINDOW_S
+    )
+    lanes = asyncio.Semaphore(LANES)
+    state = {"waiting": 0, "active": 0, "served": 0}
+
+    class SimEngine:
+        async def generate(self, ctx):
+            if victim:
+                # deterministic death: DYN_FAULTS=worker.die.fail@N
+                try:
+                    faults.fire("worker.die")
+                except faults.FaultError:
+                    os._exit(1)
+            t0 = time.monotonic()
+
+            async def stream():
+                state["waiting"] += 1
+                async with lanes:
+                    state["waiting"] -= 1
+                    state["active"] += 1
+                    try:
+                        await asyncio.sleep(SERVICE_S)
+                    finally:
+                        state["active"] -= 1
+                lat = time.monotonic() - t0
+                state["served"] += 1
+                slo.observe({"tenant": "default", "ttft_s": lat})
+                yield {"ttft_s": round(lat, 5), "worker": worker_id}
+
+            return stream()
+
+    ep = drt.namespace(NS).component(COMPONENT).endpoint("generate")
+    served = await (
+        ep.endpoint_builder()
+        .engine(SimEngine())
+        .stats_handler(
+            lambda: {
+                "request_active_slots": state["active"],
+                "request_total_slots": LANES,
+                "num_requests_waiting": state["waiting"],
+                "gpu_cache_usage_perc": state["active"] / LANES,
+                "slo_attainment": slo.snapshot(),
+            }
+        )
+        .start()
+    )
+
+    # graceful-drain contract with the supervisor (docs/control.md)
+    watcher_name = os.environ.get("DYN_WATCHER_NAME", "decoder")
+    await publish_worker_lease(drt, watcher_name, worker_id)
+    gate = asyncio.create_task(lease_gate(drt, stop, poll_s=0.25))
+
+    await stop.wait()
+    gate.cancel()
+    # drain: deregister first (routers stop picking us), then let the
+    # in-flight lanes finish before exiting 0
+    await served.shutdown()
+    while state["active"] or state["waiting"]:
+        await asyncio.sleep(0.05)
+    await drt.shutdown()
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--worker-id", type=int, default=0)
+    args = p.parse_args()
+    configure_logging()
+    asyncio.run(amain(args.worker_id))
+
+
+if __name__ == "__main__":
+    main()

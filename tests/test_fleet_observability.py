@@ -536,6 +536,9 @@ def test_metrics_export_single_type_line_per_family():
         2: ForwardPassMetrics(slo_attainment={"default/ttft": 0.8}),
     })
     exp.aggregator = agg
+    # control-plane fields __init__ would set (this test bypasses it)
+    exp.prefill_queue_depth = 3
+    exp.planner_status = {"desired": {"backend": 2}, "adjustments": 1}
     text = exp.render()
     types = [ln for ln in text.splitlines() if ln.startswith("# TYPE")]
     assert len(types) == len(set(types)), types

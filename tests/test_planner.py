@@ -8,6 +8,7 @@ import asyncio
 
 from dynamo_tpu.llm.disagg import PrefillQueue, RemotePrefillRequest
 from dynamo_tpu.llm.planner import (
+    GraceGate,
     MetricsWindow,
     Planner,
     PlannerConfig,
@@ -27,48 +28,159 @@ CFG = PlannerConfig(
 )
 
 
-def win(queue=0.0, kv=0.0, p=1, d=1) -> MetricsWindow:
+def win(queue=0.0, kv=0.0, p=1, d=1, att=None) -> MetricsWindow:
     return MetricsWindow(
-        prefill_queue=[queue], kv_load=[kv], num_prefill=p, num_decode=d
+        prefill_queue=[queue], kv_load=[kv], num_prefill=p, num_decode=d,
+        attain_min=[att] if att is not None else [],
+        attain_mean=[att] if att is not None else [],
     )
 
 
 def test_decide_scale_up_prefill_under_queue_pressure():
-    d = decide(CFG, win(queue=10.0), 0)
+    d = decide(CFG, win(queue=10.0))
     assert d.add_prefill and not d.remove_prefill
     assert not d.add_decode and not d.remove_decode
 
 
 def test_decide_scale_up_decode_under_kv_pressure():
-    d = decide(CFG, win(kv=0.95), 0)
+    d = decide(CFG, win(kv=0.95))
     assert d.add_decode and not d.remove_decode
 
 
 def test_decide_scale_down_idle_pools():
-    d = decide(CFG, win(queue=0.0, kv=0.0, p=2, d=2), 0)
+    d = decide(CFG, win(queue=0.0, kv=0.0, p=2, d=2))
     assert d.remove_prefill and d.remove_decode
 
 
 def test_decide_min_endpoint_floor():
-    d = decide(CFG, win(queue=0.0, kv=0.0, p=1, d=1), 0)
+    d = decide(CFG, win(queue=0.0, kv=0.0, p=1, d=1))
     assert not d
 
 
 def test_decide_respects_chip_budget():
     # budget 4, already 2 prefill + 2 decode chips used: no room to grow
-    d = decide(CFG, win(queue=10.0, kv=0.95, p=2, d=2), 0)
+    d = decide(CFG, win(queue=10.0, kv=0.95, p=2, d=2))
     assert not d.add_prefill and not d.add_decode
-
-
-def test_decide_scale_down_waits_for_grace():
-    assert not decide(CFG, win(kv=0.0, d=2, queue=5.0), 1).remove_decode
-    assert decide(CFG, win(kv=0.0, d=2, queue=5.0), 0).remove_decode
 
 
 def test_decide_aggregated_mode_ignores_prefill():
     cfg = PlannerConfig(disagg=False, min_endpoint=1, max_chip_budget=4)
-    d = decide(cfg, win(queue=50.0, kv=0.95, p=0, d=1), 0)
+    d = decide(cfg, win(queue=50.0, kv=0.95, p=0, d=1))
     assert d.add_decode and not d.add_prefill
+
+
+# ------------------------------------------------- attainment-driven matrix
+
+
+def test_decide_attainment_burn_scales_decode_up():
+    # worst tenant below target with CALM load thresholds: latency SLOs
+    # miss before KV fills — burn alone must scale decode up
+    d = decide(CFG, win(kv=0.3, att=0.90))
+    assert d.add_decode and not d.remove_decode
+    assert "burn" in d.reason
+
+
+def test_decide_headroom_plus_low_load_scales_down():
+    # attainment comfortably above target AND both load signals idle
+    d = decide(CFG, win(queue=0.0, kv=0.05, p=2, d=2, att=1.0))
+    assert d.remove_decode and d.remove_prefill
+
+
+def test_decide_conflicting_signals_hold():
+    # load says down, attainment is AT target (no headroom): hold — a
+    # lull during a burn must not surrender the replica
+    d = decide(CFG, win(queue=0.0, kv=0.05, p=2, d=2, att=0.992))
+    assert not d
+    assert "hold" in d.reason
+    # burning outright: the decode pool must not scale down either (it
+    # scales UP) and the idle prefill pool holds too
+    d2 = decide(CFG, win(queue=0.0, kv=0.05, p=1, d=2, att=0.5))
+    assert d2.add_decode and not d2.remove_decode and not d2.remove_prefill
+
+
+def test_decide_no_attainment_reported_falls_back_to_load():
+    # deployments without SLO targets report nothing: pure PR-pre-11
+    # load-threshold behavior (vacuous headroom)
+    d = decide(CFG, win(queue=0.0, kv=0.05, p=2, d=2))
+    assert d.remove_decode and d.remove_prefill
+
+
+def test_decide_burn_respects_chip_budget():
+    d = decide(CFG, win(kv=0.3, att=0.5, p=2, d=2))
+    assert not d.add_decode
+    assert "budget" in d.reason
+
+
+def test_decide_budget_counts_desired_not_observed():
+    # replicas still booting are invisible to the stats scrape but hold
+    # chips: the desired counts (fed by the planner) clamp the budget
+    w = win(kv=0.3, att=0.5, p=0, d=1)
+    w.num_decode_desired = 4
+    cfg = PlannerConfig(disagg=False, min_endpoint=1, max_chip_budget=4)
+    assert not decide(cfg, w).add_decode
+    w.num_decode_desired = 3
+    assert decide(cfg, w).add_decode
+
+
+def test_grace_gate_per_direction():
+    gate = GraceGate(up_rounds=1, down_rounds=2)
+    up = win(kv=0.95, d=2)
+    down = win(kv=0.0, d=2, queue=5.0)
+    # up grace 1: first eligible round holds, second fires
+    assert not decide(CFG, up, gate).add_decode
+    assert decide(CFG, up, gate).add_decode
+    # down grace 2: two held rounds, third fires
+    assert not decide(CFG, down, gate).remove_decode
+    assert not decide(CFG, down, gate).remove_decode
+    assert decide(CFG, down, gate).remove_decode
+
+
+def test_grace_suppressed_removal_lends_no_chips():
+    """A scale-down the gate is still debouncing must NOT lend its
+    chips to a scale-up in the same round — budget accounting follows
+    what actually fires, so actuation never exceeds the budget."""
+    cfg = PlannerConfig(min_endpoint=1, max_chip_budget=8)
+    gate = GraceGate(up_rounds=0, down_rounds=1)
+    # budget full (4+4); decode idle with headroom wants OUT, queue
+    # pressure wants prefill IN — the add must wait for the remove
+    w = win(queue=10.0, kv=0.05, p=4, d=4, att=1.0)
+    d1 = decide(cfg, w, gate)
+    assert not d1.add_prefill and not d1.remove_decode, d1
+    d2 = decide(cfg, w, gate)
+    assert d2.remove_decode and d2.add_prefill, d2
+
+
+def test_desired_decay_reclaims_phantom_budget():
+    """A desired replica that never materializes (permanent crash,
+    restarts exhausted) must stop holding chip budget after
+    `desired_decay_rounds` idle rounds — otherwise a later burn reads
+    "budget full" forever and lost capacity is never replaced."""
+    cfg = PlannerConfig(disagg=False, max_chip_budget=4,
+                        desired_decay_rounds=2)
+    p = Planner.__new__(Planner)
+    p.cfg = cfg
+    p.desired = {cfg.prefill_component: 0, cfg.decode_component: 4}
+    p._lag_rounds = {}
+    p._actuation = None
+    w = win(kv=0.3, att=0.5, p=0, d=2)  # 2 live, 2 phantom, burning
+    p._decay_desired(w)  # round 1: gap noted
+    assert p.desired[cfg.decode_component] == 4
+    p._decay_desired(w)  # round 2: phantom chips reclaimed
+    assert p.desired[cfg.decode_component] == 2
+    w.num_decode_desired = max(w.num_decode, p.desired[cfg.decode_component])
+    assert decide(cfg, w).add_decode  # the burn can scale up again
+
+
+def test_grace_gate_streak_resets_and_cooldown():
+    gate = GraceGate(up_rounds=0, down_rounds=1)
+    down = win(kv=0.0, d=2, queue=5.0)
+    up = win(kv=0.95, d=2)
+    # a non-eligible round resets the down streak
+    assert not decide(CFG, down, gate).remove_decode
+    assert not decide(CFG, up, gate).remove_decode  # fires UP instead
+    # the executed scale-up reset the down streak: full grace again
+    assert not decide(CFG, down, gate).remove_decode
+    assert decide(CFG, down, gate).remove_decode
 
 
 class _RecordingConnector:
@@ -205,3 +317,82 @@ async def test_supervisor_connector_scales_watchers():
         assert not await conn.add_component("decode")
     finally:
         await sup.watchers["decoder"].stop()
+
+
+# worker stub for the drain test: connects to the hub, publishes its
+# lease under the watcher key, and exits 0 ONLY when the lease gate
+# trips (a SIGTERM instead would read as rc=-15). The watcher appends
+# "--worker-id N", absorbed from argv.
+_DRAIN_WORKER = """
+import asyncio, os, sys
+sys.path.insert(0, {root!r})
+async def main():
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+    from dynamo_tpu.sdk.worker import lease_gate, publish_worker_lease
+    wid = int(sys.argv[sys.argv.index("--worker-id") + 1])
+    drt = await DistributedRuntime.from_settings(lease_ttl=5.0)
+    stop = asyncio.Event()
+    await publish_worker_lease(drt, os.environ["DYN_WATCHER_NAME"], wid)
+    gate = asyncio.create_task(lease_gate(drt, stop, poll_s=0.1))
+    await stop.wait()
+    gate.cancel()
+    await drt.shutdown()
+asyncio.run(main())
+"""
+
+
+async def test_supervisor_scale_down_drains_via_lease_revoke():
+    """The SupervisorConnector scale-down contract (docs/control.md):
+    the victim's lease is revoked FIRST, the worker drains and exits on
+    its own (rc 0), and SIGTERM is never sent."""
+    import os
+    import sys
+
+    from dynamo_tpu.sdk.supervisor import Supervisor, Watcher
+
+    async with hub_server() as server:
+        hub_addr = f"127.0.0.1:{server.port}"
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        sup = Supervisor(hub_addr=hub_addr)
+        sup.watchers["decoder"] = Watcher(
+            name="t_drain",
+            args=[sys.executable, "-c", _DRAIN_WORKER.format(root=root)],
+            env={"DYN_HUB_ADDR": hub_addr},
+            numprocesses=2,
+        )
+        w = sup.watchers["decoder"]
+        w.hub_addr = hub_addr  # what Supervisor.start() would arm
+        conn = SupervisorConnector(sup, {"decode": "decoder"})
+        await w.start()
+        try:
+            # both workers must have REGISTERED their lease keys before
+            # a scale-down can drain them
+            from dynamo_tpu.runtime.hub.client import HubClient
+            from dynamo_tpu.sdk.supervisor import worker_lease_key
+
+            client = await HubClient.connect(hub_addr)
+            try:
+                for _ in range(100):
+                    got = [
+                        await client.kv_get(worker_lease_key("t_drain", i))
+                        for i in (0, 1)
+                    ]
+                    if all(g is not None for g in got):
+                        break
+                    await asyncio.sleep(0.1)
+                assert all(g is not None for g in got), "leases not published"
+            finally:
+                await client.close()
+
+            assert await conn.remove_component("decode")
+            # the highest wid (1) was drained: lease revocation STRICTLY
+            # precedes the process stop, with no SIGTERM escalation
+            assert ("lease_revoked", 1) in w.events, w.events
+            assert ("drained", 1) in w.events, w.events
+            assert w.events.index(("lease_revoked", 1)) < w.events.index(
+                ("drained", 1)
+            )
+            assert ("sigterm", 1) not in w.events, w.events
+            assert w.alive_count() == 1
+        finally:
+            await w.stop()
