@@ -37,6 +37,16 @@ QUANT = os.environ.get("BENCH_QUANT", "int8")
 QUANT = None if QUANT in ("", "none") else QUANT
 KV_QUANT = os.environ.get("BENCH_KV_QUANT", "int8")
 KV_QUANT = None if KV_QUANT in ("", "none") else KV_QUANT
+# int8-KV pallas kernels put page tokens in lanes (page 128); bf16 runs
+# use 64-token pages — fixed here because the PREFIX PROBE must know it
+PAGE_SIZE = 128 if KV_QUANT else 64
+# prefix-probe prompt length: at least 2 full pages + a partial tail
+# regardless of BENCH_ISL. A prompt shorter than one page has NO
+# cacheable block, so its "warm" serve reuses nothing and the reported
+# speedup is pure tunnel noise — exactly how BENCH_r06 (ISL=64, page
+# 128) printed the phantom 0.68x "regression". The engine config below
+# sizes prefill_chunk/max_model_len to cover this.
+PROBE_ISL = max(ISL, 2 * PAGE_SIZE + PAGE_SIZE // 2)
 # BENCH_FAST=1: headline wave + prefix probe only (the concurrency sweep
 # runs one engine init per point — skip the paced/offload/phase extras)
 FAST = os.environ.get("BENCH_FAST", "") not in ("", "0")
@@ -67,6 +77,13 @@ MIXED_OSL = int(os.environ.get("BENCH_MIXED_OSL", str(max(OSL, 128))))
 # wall (`mixed_sync_s + decode_sync_s`) as a fraction of the total
 # dispatch+sync step wall. Also runs whenever BENCH_MIXED=1 is set.
 PIPE = MIXED or os.environ.get("BENCH_PIPELINE", "") not in ("", "0")
+# BENCH_PREFIX_FLEET=1: multi-tenant shared-prefix FLEET scenario
+# (scripts/prefix_fleet.py) — in-process hub + two real workers + the
+# KV-aware router with live engine events, scoring warm-vs-cold TTFT
+# across the fleet, route-to-holder rate, cross-worker prefix pulls
+# (saturated holder -> export/ingest transfer instead of recompute) and
+# $-per-million-tokens. Emits the `prefix_fleet` BENCH_OUT section.
+PREFIX_FLEET = os.environ.get("BENCH_PREFIX_FLEET", "") not in ("", "0")
 # BENCH_CONTROL=1: chaos-controller scenario (scripts/control_chaos.py)
 # — spawn a real hub + supervisor-managed worker pool, inject a load
 # spike + DYN_FAULTS worker death, and score the SLO-driven planner on
@@ -127,12 +144,24 @@ ENV_HELP = """bench.py — serving benchmark; configuration via env vars:
   BENCH_OUT                    path: write a machine-readable JSON file
                                with every section's numbers keyed as
                                {headline, spec, mixed, mixed_spec,
-                               pipeline_ab, goodput} (sections not run
-                               are null; goodput always present:
-                               SLO-gated throughput + the per-request
-                               prefix/offload ledgers of the probes);
-                               stdout keeps the one-line headline
-                               artifact
+                               pipeline_ab, prefix_ab, prefix_fleet,
+                               control, goodput} (sections not run are
+                               null; goodput + prefix_ab always
+                               present: SLO-gated throughput, the
+                               per-request prefix/offload ledgers and
+                               the cold/warm counter breakdown of the
+                               probes); stdout keeps the one-line
+                               headline artifact
+  BENCH_PREFIX_FLEET=1         multi-tenant shared-prefix FLEET
+                               scenario: in-process hub + two real
+                               workers + the KV-aware router fed live
+                               engine events — warm-vs-cold TTFT,
+                               route-to-holder rate, cross-worker
+                               prefix pulls, $-per-M-tokens (adds the
+                               `prefix_fleet` BENCH_OUT section;
+                               scripts/prefix_fleet.py)
+  BENCH_CHIP_HOUR_USD          $/chip-hour for the fleet scenario's
+                               $-per-million-tokens line (1.20)
   BENCH_CONTROL=1              chaos-controller scenario: worker death +
                                load spike scored on SLO-attainment
                                recovery (adds the `control` BENCH_OUT
@@ -190,12 +219,16 @@ def main() -> None:
             model=cfg,
             dtype="bfloat16",
             max_batch_size=concurrency,
-            max_model_len=ISL + max(
+            max_model_len=max(ISL, PROBE_ISL) + max(
                 OSL,
                 SPEC_OSL if SPEC else 0,
                 MIXED_OSL if (MIXED or PIPE) else 0,
             ) + 32,
-            prefill_chunk=ISL,
+            # prefill_chunk covers the probe prompts too, so they stay
+            # single-chunk (a sub-page prefill_chunk would start later
+            # chunks off page boundaries, which the pallas write path
+            # refuses); for the default ISL=512 this is unchanged
+            prefill_chunk=max(ISL, PROBE_ISL),
             decode_steps=DECODE_STEPS,
             prefill_group_tokens=prefill_group,
             quantization=QUANT,
@@ -215,7 +248,7 @@ def main() -> None:
             mixed_batching=False,
             mixed_step_tokens=MIXED_TOKENS,
             # int8-KV pallas kernels put page tokens in lanes
-            page_size=128 if KV_QUANT else 64,
+            page_size=PAGE_SIZE,
             # HBM->host offload tier ON (the reference baselines run with
             # their multi-tier KV manager active); sized for the TTFT
             # probe, small enough to stay out of the headline's way
@@ -682,145 +715,178 @@ def main() -> None:
             )
             return out
 
-        if FAST:
-            probe = rng.randint(1, cfg.vocab_size, size=ISL).tolist()
-            cold, warm = {}, {}
+        # ---- prefix-cache TTFT probe, WAVE-based, shared by FAST and
+        # full runs (BASELINE.md: KV-aware routing's TTFT win comes from
+        # prefix hits). Single idle requests cannot see the effect on
+        # this rig — their TTFT is the tunnel fetch RTT (~0.17 s) on
+        # both serves. A wave of distinct PROBE_ISL prompts served cold
+        # then re-served (every full page a prefix hit) measures the
+        # saved compute under real queuing, and the prefix_ab breakdown
+        # (prefill/prefix/compile counter deltas per leg) makes a slow
+        # warm wave ATTRIBUTABLE — reuse that didn't happen reads as
+        # prefix_hits 0, a compile-contaminated leg as compile_events>0.
+        AB_KEYS = (
+            "prefill_dispatch_s", "prefill_tokens", "prefill_dispatches",
+            "prefix_hits", "prefix_full_hits", "prefix_reused_tokens",
+            "prefix_restored_tokens", "prefix_tail_tokens",
+        )
+
+        async def prefix_probe(n_probe):
+            def probe_prompts():
+                return [
+                    rng.randint(1, cfg.vocab_size, size=PROBE_ISL).tolist()
+                    for _ in range(n_probe)
+                ]
+
+            # sacrificial set A, served twice: the SECOND serve
+            # dispatches [n, tail-bucket] prefill groups over full-width
+            # block tables — continuation families the cold-path warmups
+            # never build. Without this the measured warm wave pays ~30 s
+            # remote compiles per family and every later phase measures
+            # the compiler (observed: 65 s paced p50 TTFT from exactly
+            # this cascade). The prefix_ab compile_events delta proves
+            # per-leg whether the warmup actually covered the families.
+            set_a = probe_prompts()
+            await asyncio.gather(*(one(p, {}) for p in set_a))
+            await asyncio.gather(*(one(p, {}) for p in set_a))
+            set_b = probe_prompts()
+            legs = {}
+            prefix_ab = {"probe_isl": PROBE_ISL, "n_probe": n_probe}
+            probe_summary = {}
             i0 = len(summaries)
-            await one(probe, cold)
-            i1 = len(summaries)
-            await one(probe, warm)
+            for leg in ("cold", "warm"):
+                recs = [dict() for _ in range(n_probe)]
+                ps_a, m_a = engine.phase_stats, engine.metrics()
+                t0 = time.perf_counter()
+                await asyncio.gather(
+                    *(one(p, r) for p, r in zip(set_b, recs))
+                )
+                wall = time.perf_counter() - t0
+                ps_b, m_b = engine.phase_stats, engine.metrics()
+                i1 = len(summaries)
+                ttft = float(np.percentile([r["ttft"] for r in recs], 50))
+                legs[leg] = {"ttft": ttft, "wall": wall}
+                prefix_ab[leg] = {
+                    "ttft_p50_s": round(ttft, 4),
+                    "wall_s": round(wall, 4),
+                    **{
+                        k: (
+                            round(ps_b[k] - ps_a[k], 4)
+                            if isinstance(ps_b[k], float)
+                            else ps_b[k] - ps_a[k]
+                        )
+                        for k in AB_KEYS
+                    },
+                    "compile_events": (
+                        m_b["compile_events"] - m_a["compile_events"]
+                    ),
+                    "compile_time_s": round(
+                        m_b["compile_time_s"] - m_a["compile_time_s"], 4
+                    ),
+                }
+                # per-request ledger of the leg: the warm wave's
+                # reused_blocks tell exactly how much prefill the cache
+                # skipped — a sub-1.0 "speedup" with full reuse points
+                # at dispatch/compile overhead, with zero reuse at
+                # eviction (or a probe too short to span a page)
+                probe_summary[leg] = {
+                    **ledger_agg(summaries[i0:i1]),
+                    "ttft_p50_s": round(ttft, 4),
+                    "wall_s": round(wall, 4),
+                }
+                i0 = i1
+            speedup = legs["cold"]["ttft"] / legs["warm"]["ttft"]
+            prefix_ab["ttft_speedup"] = round(speedup, 3)
             goodput["prefix_probe"] = {
-                "cold": {**ledger_agg(summaries[i0:i1]),
-                         "ttft_p50_s": round(cold["ttft"], 4)},
-                "warm": {**ledger_agg(summaries[i1:]),
-                         "ttft_p50_s": round(warm["ttft"], 4)},
-                "ttft_speedup": round(_probe_ratio(cold, warm), 3),
+                **probe_summary, "ttft_speedup": round(speedup, 3),
             }
+            return legs, prefix_ab
+
+        # ---- host-tier offload probe (BASELINE.md's +40% TTFT claim),
+        # also shared by FAST and full runs: serve a fresh prompt, wait
+        # for its pages to write-through to the host pool, EVICT them
+        # from HBM, re-serve — restore-from-host vs full recompute,
+        # under the cost gate. `restored > 0` here is the standing proof
+        # the tier works (the r06 gate sat idle because the FAST probe
+        # never forced an eviction).
+        async def offload_probe_run():
+            from dynamo_tpu.llm.tokens import compute_block_hashes
+
+            def evict_all():
+                grabbed = []
+                while True:
+                    got = engine.allocator.allocate(1)
+                    if not got:
+                        break
+                    grabbed.extend(got)
+                engine.allocator.release(grabbed)
+
+            async def await_offloaded(tokens):
+                hs = compute_block_hashes(tokens, engine.page_size)
+                hs = hs[: PROBE_ISL // engine.page_size]
+                for _ in range(200):
+                    if engine.host_pool is not None and all(
+                        h in engine.host_pool for h in hs
+                    ):
+                        return True
+                    engine._wake.set()
+                    await asyncio.sleep(0.05)
+                return False
+
+            engine.offload_paused = False
+            # warm cycle: the restore path (H2D inject + registration)
+            # has its own compile families — pay them before measuring
+            wprobe = rng.randint(1, cfg.vocab_size, size=PROBE_ISL).tolist()
+            await one(wprobe, {})
+            if await await_offloaded(wprobe):
+                evict_all()
+                await one(wprobe, {})
+
+            oprobe = rng.randint(1, cfg.vocab_size, size=PROBE_ISL).tolist()
+            ocold, owarm = {}, {}
+            await one(oprobe, ocold)
+            offloaded = await await_offloaded(oprobe)
+            # evict every evictable HBM page (incl. the probe's)
+            evict_all()
+            i_ow = len(summaries)
+            await one(oprobe, owarm)
+            engine.offload_paused = True
+            speedup = _probe_ratio(ocold, owarm) if offloaded else None
+            # the re-serve's ledger says whether the tier RESTORED or
+            # the gate declined (and why) — the "restored: 0, declined:
+            # 0" blindness of BENCH_r06 becomes an attributed decision
+            goodput["offload_probe"] = {
+                "offloaded": bool(offloaded),
+                "warm": ledger_agg(summaries[i_ow:]),
+                "ttft_speedup": round(speedup, 3) if speedup else None,
+            }
+            return speedup
+
+        if FAST:
+            legs, prefix_ab = await prefix_probe(min(4, concurrency))
+            offload_speedup = await offload_probe_run()
             return (
                 records, wall, wall_spread, phase_delta,
                 None, None,
-                {"ttft": _probe_ratio(cold, warm), "wall": None},
-                [], 0.0, 0.0, [], 0.0, 0.0, None,
+                {
+                    "ttft": legs["cold"]["ttft"] / legs["warm"]["ttft"],
+                    "wall": legs["cold"]["wall"] / legs["warm"]["wall"],
+                },
+                [], 0.0, 0.0, [], 0.0, 0.0, offload_speedup,
                 await spec_ab() if SPEC else None,
                 await mixed_ab() if MIXED else None,
                 await mixed_spec_ab() if (SPEC and MIXED) else None,
                 await pipeline_ab() if PIPE else None,
+                prefix_ab,
             )
 
-        # prefix-cache TTFT probe, WAVE-based (BASELINE.md: KV-aware
-        # routing's 3x TTFT win comes from prefix hits). Single idle
-        # requests cannot see the effect on this rig — their TTFT is the
-        # tunnel fetch RTT (~0.17 s) on both serves and the engine-side
-        # stamp returns before compute (async dispatch). A wave of
-        # distinct prompts served cold then re-served (every page a
-        # prefix hit) measures the saved compute under real queuing.
-        n_probe = min(32, concurrency)
+        legs, prefix_ab = await prefix_probe(min(32, concurrency))
+        cold = {"ttft": legs["cold"]["ttft"]}
+        warm = {"ttft": legs["warm"]["ttft"]}
+        prefix_cold_wall = legs["cold"]["wall"]
+        prefix_warm_wall = legs["warm"]["wall"]
 
-        def probe_prompts():
-            return [
-                rng.randint(1, cfg.vocab_size, size=ISL).tolist()
-                for _ in range(n_probe)
-            ]
-
-        # sacrificial set A, served twice: the SECOND serve dispatches
-        # [n, tail-bucket] prefill groups (whole wave all prefix hits) —
-        # row-count families the cold-path warmups never build. Without
-        # this, the measured warm wave pays ~30 s remote compiles per
-        # family and every later phase measures the compiler (observed:
-        # 65 s paced p50 TTFT from exactly this cascade)
-        set_a = probe_prompts()
-        await asyncio.gather(*(one(p, {}) for p in set_a))
-        await asyncio.gather(*(one(p, {}) for p in set_a))
-        set_b = probe_prompts()
-        cold_recs = [dict() for _ in range(n_probe)]
-        i_cold = len(summaries)
-        tpx = time.perf_counter()
-        await asyncio.gather(
-            *(one(p, r) for p, r in zip(set_b, cold_recs))
-        )
-        prefix_cold_wall = time.perf_counter() - tpx
-        warm_recs = [dict() for _ in range(n_probe)]
-        i_warm = len(summaries)
-        tpx = time.perf_counter()
-        await asyncio.gather(
-            *(one(p, r) for p, r in zip(set_b, warm_recs))
-        )
-        prefix_warm_wall = time.perf_counter() - tpx
-        i_end = len(summaries)
-        cold = {"ttft": float(np.percentile(
-            [r["ttft"] for r in cold_recs], 50))}
-        warm = {"ttft": float(np.percentile(
-            [r["ttft"] for r in warm_recs], 50))}
-        # per-request ledger of the probe waves: the warm wave's
-        # reused_blocks tell exactly how much prefill the prefix cache
-        # actually skipped — a 0.68x "speedup" with full reuse points at
-        # dispatch/queue overhead, with zero reuse at eviction
-        goodput["prefix_probe"] = {
-            "cold": {**ledger_agg(summaries[i_cold:i_warm]),
-                     "ttft_p50_s": round(cold["ttft"], 4),
-                     "wall_s": round(prefix_cold_wall, 4)},
-            "warm": {**ledger_agg(summaries[i_warm:i_end]),
-                     "ttft_p50_s": round(warm["ttft"], 4),
-                     "wall_s": round(prefix_warm_wall, 4)},
-            "ttft_speedup": round(_probe_ratio(cold, warm), 3),
-        }
-
-        # ---- host-tier offload probe (BASELINE.md's +40% TTFT claim):
-        # serve a fresh prompt, wait for its pages to write-through to
-        # the host pool, EVICT them from HBM, re-serve — restore-from-
-        # host vs full recompute
-        from dynamo_tpu.llm.tokens import compute_block_hashes
-
-        def evict_all():
-            grabbed = []
-            while True:
-                got = engine.allocator.allocate(1)
-                if not got:
-                    break
-                grabbed.extend(got)
-            engine.allocator.release(grabbed)
-
-        async def await_offloaded(tokens):
-            hs = compute_block_hashes(tokens, engine.page_size)
-            hs = hs[: ISL // engine.page_size]
-            for _ in range(200):
-                if engine.host_pool is not None and all(
-                    h in engine.host_pool for h in hs
-                ):
-                    return True
-                engine._wake.set()
-                await asyncio.sleep(0.05)
-            return False
-
-        engine.offload_paused = False
-        # warm cycle: the restore path (H2D inject + registration) has
-        # its own compile families — pay them before measuring
-        wprobe = rng.randint(1, cfg.vocab_size, size=ISL).tolist()
-        await one(wprobe, {})
-        if await await_offloaded(wprobe):
-            evict_all()
-            await one(wprobe, {})
-
-        oprobe = rng.randint(1, cfg.vocab_size, size=ISL).tolist()
-        ocold, owarm = {}, {}
-        await one(oprobe, ocold)
-        offloaded = await await_offloaded(oprobe)
-        # evict every evictable HBM page (incl. the probe's)
-        evict_all()
-        i_ow = len(summaries)
-        await one(oprobe, owarm)
-        engine.offload_paused = True
-        offload_speedup = _probe_ratio(ocold, owarm) if offloaded else None
-        # the re-serve's ledger says whether the tier RESTORED or the
-        # gate declined (and why) — the "restored: 0, declined: 0"
-        # blindness of BENCH_r06 becomes an attributed decision
-        goodput["offload_probe"] = {
-            "offloaded": bool(offloaded),
-            "warm": ledger_agg(summaries[i_ow:]),
-            "ttft_speedup": (
-                round(offload_speedup, 3) if offload_speedup else None
-            ),
-        }
+        offload_speedup = await offload_probe_run()
 
         # ---- paced (Poisson) arrivals: the reference benches with
         # genai-perf's paced load (perf.sh:22-46); closed-loop-burst TTFT
@@ -865,6 +931,7 @@ def main() -> None:
             await mixed_ab() if MIXED else None,
             await mixed_spec_ab() if (SPEC and MIXED) else None,
             await pipeline_ab() if PIPE else None,
+            prefix_ab,
         )
 
     (
@@ -878,6 +945,7 @@ def main() -> None:
         mixed_result,
         mixed_spec_result,
         pipeline_result,
+        prefix_ab_result,
     ) = asyncio.run(run())
     total_tokens = sum(r["tokens"] for r in records)
     toks_per_sec_chip = total_tokens / wall / n_chips
@@ -1034,16 +1102,32 @@ def main() -> None:
                     }),
                 },
             }
-    # chaos-controller scenario LAST (it spawns its own hub + worker
-    # processes; the engine above is done by now, so nothing contends)
-    control_result = None
-    if CONTROL:
+    # fleet scenarios LAST (they spawn their own hub + workers; the
+    # engine above is done by now, so nothing contends)
+    if PREFIX_FLEET or CONTROL:
         import sys as _sys
 
         _sys.path.insert(
             0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                             "scripts")
         )
+    prefix_fleet_result = None
+    if PREFIX_FLEET:
+        import prefix_fleet
+
+        prefix_fleet_result = prefix_fleet.run()
+        print(
+            "prefix_fleet: warm_vs_cold={} route_to_holder={} pulls={} "
+            "usd_per_mtok={}".format(
+                prefix_fleet_result["warm_vs_cold_ttft"],
+                prefix_fleet_result["route_to_holder_frac"],
+                prefix_fleet_result["pulls"]["landed"],
+                prefix_fleet_result["dollars"]["usd_per_mtok"],
+            ),
+            file=_sys.stderr,
+        )
+    control_result = None
+    if CONTROL:
         import control_chaos
 
         control_result = control_chaos.run()
@@ -1072,6 +1156,14 @@ def main() -> None:
                     "mixed": mixed_result,
                     "mixed_spec": mixed_spec_result,
                     "pipeline_ab": pipeline_result,
+                    # prefix probe attribution (always present): per-leg
+                    # prefill/prefix/compile counter deltas of the
+                    # cold/warm waves — the breakdown that explains the
+                    # headline prefix_hit_ttft_speedup
+                    "prefix_ab": prefix_ab_result,
+                    # BENCH_PREFIX_FLEET=1: multi-tenant shared-prefix
+                    # fleet scenario (two workers + KV router + pulls)
+                    "prefix_fleet": prefix_fleet_result,
                     # BENCH_CONTROL=1: chaos-controller recovery curve
                     # (worker death + spike vs the SLO-driven planner)
                     "control": control_result,

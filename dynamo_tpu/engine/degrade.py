@@ -53,9 +53,15 @@ class DegradeLadder:
         self,
         reprobe_s: float = 30.0,
         clock: Callable[[], float] = time.monotonic,
+        on_trip: Optional[Callable[[str, str], None]] = None,
     ):
         self.reprobe_s = reprobe_s
         self._clock = clock
+        # fired once per NEW trip with (rung, reason) — the engine uses
+        # it to invalidate rate calibrations (restore-gate EMAs) that
+        # were measured on the pre-degrade configuration. Exceptions are
+        # contained: a bad observer must not block the shed itself.
+        self._on_trip = on_trip
         # rung -> re-enable deadline (monotonic); _PERMANENT = never
         self._tripped: dict[str, float] = {}
         self.degrades_total = 0
@@ -110,6 +116,11 @@ class DegradeLadder:
                 "degrade.trip", cat="degrade", rung=rung, reason=reason,
                 permanent=permanent,
             )
+        if self._on_trip is not None:
+            try:
+                self._on_trip(rung, reason)
+            except Exception:  # noqa: BLE001 — observer must not block the shed
+                log.exception("degrade on_trip hook failed")
 
     def trip_next(self, reason: str) -> Optional[str]:
         """Walk the ladder: shed the first rung still enabled. Returns

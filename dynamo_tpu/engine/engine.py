@@ -622,6 +622,17 @@ class JaxEngine:
             "watchdog_fired": 0,
             "deadline_shed": 0,
             "deadline_timeouts": 0,
+            # prefix/offload economics (docs/kv_cache.md): reservations
+            # that reused >= 1 cached block, fully-cached prompts (only
+            # the trailing page recomputes), tokens reused from the HBM
+            # tier / restored from the host tier, and the tail tokens a
+            # hit still had to prefill — the engine-side attribution the
+            # bench's prefix_ab section diffs cold vs warm
+            "prefix_hits": 0,
+            "prefix_full_hits": 0,
+            "prefix_reused_tokens": 0,
+            "prefix_restored_tokens": 0,
+            "prefix_tail_tokens": 0,
         }
         # updates run in worker threads outside _kv_lock (serving prefill
         # + concurrent prefill_only dispatches) — guard the RMWs
@@ -630,8 +641,15 @@ class JaxEngine:
         # ---- fault-tolerance spine (docs/robustness.md) ----
         faults.load_env()  # arm DYN_FAULTS points (no-op when unset)
         # degrade ladder: ordered feature shedding with re-probe
-        # recovery, generalizing the one-way mixed_disabled trip
-        self._degrade = DegradeLadder(reprobe_s=config.degrade_reprobe_s)
+        # recovery, generalizing the one-way mixed_disabled trip. A trip
+        # also resets the restore-gate EMAs (ADVICE r5 follow-up): the
+        # rates were measured on the pre-degrade configuration — e.g. a
+        # pipelined engine's prefill tps — and a gate calibrated there
+        # would mis-price restore-vs-recompute on the degraded engine.
+        self._degrade = DegradeLadder(
+            reprobe_s=config.degrade_reprobe_s,
+            on_trip=self._reset_offload_ema,
+        )
         # watchdog: in-flight device-critical ops (dispatch calls and
         # result fetches) register here as {token: (label, t_start)};
         # the monitor task trips the ladder + dumps a crash artifact
@@ -911,7 +929,20 @@ class JaxEngine:
             "kv_total_blocks": usable,
             "num_requests_waiting": len(self.waiting),
             "gpu_cache_usage_perc": self.allocator.usage(),
+            # prefix-cache hit rate of the HBM tier. The honest key is
+            # `prefix_cache_hit_rate` (there is no GPU in this repo);
+            # `gpu_prefix_cache_hit_rate` is a DEPRECATED alias kept one
+            # release for dashboards wired to the reference's name.
+            "prefix_cache_hit_rate": self.allocator.hit_rate(),
             "gpu_prefix_cache_hit_rate": self.allocator.hit_rate(),
+            # prefix reservation breakdown (always-present zero-series:
+            # metrics() computes every key, so the gauges render 0.0
+            # from the first scrape per PR 7's declare convention)
+            "prefix_hits": ps["prefix_hits"],
+            "prefix_full_hits": ps["prefix_full_hits"],
+            "prefix_reused_tokens": ps["prefix_reused_tokens"],
+            "prefix_restored_tokens": ps["prefix_restored_tokens"],
+            "prefix_tail_tokens": ps["prefix_tail_tokens"],
             # KV pool telemetry (engine/allocator.py): live vs cached vs
             # free pages, the pool's high-water mark, slot occupancy and
             # fragmentation (cached share of occupied pages — high here
@@ -1487,6 +1518,8 @@ class JaxEngine:
                     f"prompt_embeds width {width} != model hidden size "
                     f"{self.model_cfg.hidden_size}"
                 )
+        if _blocks is None:
+            _blocks = self._blocks_from_metadata(request, pre)
         seq = Sequence.from_request(
             request, pre, self.page_size, self.config.max_model_len,
             blocks=_blocks,
@@ -1526,6 +1559,28 @@ class JaxEngine:
                     return
 
         return _gen()
+
+    def _blocks_from_metadata(self, request: Context, pre):
+        """Precomputed block-hash chain ridden in via Context metadata
+        (stamped by the KV router, which already hashed the prompt to
+        score workers) — saves the O(prompt) re-hash on the serving hot
+        path. Ignored unless the block size matches this engine's page
+        size and the chain covers exactly the prompt's full pages;
+        `Sequence.from_request`'s mismatch guard stays the backstop."""
+        md = request.metadata
+        if md.get("kv_block_size") != self.page_size:
+            return None
+        sh, lh = md.get("kv_seq_hashes"), md.get("kv_local_hashes")
+        if not sh or not lh:
+            return None
+        from dynamo_tpu.llm.tokens import TokenBlockSequence
+
+        try:
+            return TokenBlockSequence.with_hashes(
+                pre.token_ids, self.page_size, sh, lh
+            )
+        except (TypeError, ValueError):
+            return None
 
     async def generate_remote(
         self,
@@ -1701,6 +1756,41 @@ class JaxEngine:
         self.allocator.release(cached)
         self.allocator.release(pages)
         return full_pages * self.page_size
+
+    def export_prefix(
+        self, token_ids: list[int], hashes: Optional[list[int]] = None,
+    ):
+        """Extract this engine's cached KV for a prompt's longest cached
+        prefix — the SOURCE side of a cross-worker prefix pull
+        (docs/kv_cache.md). Returns (n_tokens, k, v, ks, vs) with k/v
+        numpy [L, T, Kh*Hd] (int8 + [L, T, Kh] scales on an int8-KV
+        engine — the wire stays int8, half the bytes), or None when no
+        full page of the prompt is cached.
+
+        Matched pages are PINNED for the duration of the extract so the
+        gather cannot race an eviction; pins drop before returning (the
+        pages stay cached). Blocking (jit dispatch + device fetch):
+        callers run it in a worker thread."""
+        if hashes is None:
+            from dynamo_tpu.llm.tokens import compute_block_hashes
+
+            hashes = compute_block_hashes(token_ids, self.page_size)
+        pages = self.allocator.match_prefix(hashes)
+        if not pages:
+            return None
+        try:
+            ps = self.page_size
+            slots = np.concatenate(
+                [pid * ps + np.arange(ps, dtype=np.int32) for pid in pages]
+            )
+            with self._kv_lock:
+                out = self._extract_fn(self.kv, jnp.asarray(slots))
+            arrs = tuple(np.asarray(a) for a in out)
+        finally:
+            self.allocator.release(pages)
+        if len(arrs) == 4:
+            return (len(pages) * ps, *arrs)
+        return (len(pages) * ps, arrs[0], arrs[1], None, None)
 
     def _convert_wire_kv(self, nk, nv, nks, nvs, put=lambda a: a):
         """Normalize a disagg KV payload to this engine's KV dtype — ONE
@@ -2258,6 +2348,30 @@ class JaxEngine:
                 "offload.gate", cat="kv", req=seq.ctx.id,
                 decision="restored", blocks=len(host_run),
             )
+        if matched or host_run:
+            # prefix attribution: the phase counters the bench's
+            # prefix_ab section diffs cold vs warm, plus one event per
+            # hit on the engine.prefix track so a slow warm serve is
+            # attributable in the trace (which hit, how much reused,
+            # how much tail it still prefilled)
+            tail = t - seq.num_cached
+            # "full" = only the trailing page (or less) recomputes: the
+            # cache covered every other page of the prompt
+            full_hit = tail <= self.page_size
+            with self._phase_lock:
+                st = self._phase_stats
+                st["prefix_hits"] += 1
+                st["prefix_full_hits"] += 1 if full_hit else 0
+                st["prefix_reused_tokens"] += len(matched) * self.page_size
+                st["prefix_restored_tokens"] += len(host_run) * self.page_size
+                st["prefix_tail_tokens"] += tail
+            if tracing.enabled():
+                tracing.instant(
+                    "prefix.hit", cat="kv", req=seq.ctx.id,
+                    track="engine.prefix", reused_blocks=len(matched),
+                    restored_blocks=len(host_run), tail_tokens=tail,
+                    full=full_hit,
+                )
         return True
 
     # ---- prefill ------------------------------------------------------
@@ -2446,9 +2560,10 @@ class JaxEngine:
         return dict(self._phase_stats)
 
     def _any_mid_decode(self) -> bool:
-        """Is decode actually RUNNING? True when a decode dispatch is in
-        flight, or — covering the brief sync-to-build gap between
-        dispatches — when a stream has emitted past its first token.
+        """Is decode actually RUNNING? True when a decode dispatch with
+        at least one LIVE row is in flight, or — covering the brief
+        sync-to-build gap between dispatches — when a stream has emitted
+        past its first token.
 
         generated == 1 wave members (first token from the prefill-group
         fetch, no decode dispatched yet) deliberately do NOT count on
@@ -2458,13 +2573,38 @@ class JaxEngine:
         (b) suppress the sibling prefill groups' early first-token
         emits. A generated == 1 stream whose decode IS under way is
         caught by the in-flight test instead — the gap the bare
-        `generated > 1` predicate used to mislabel idle."""
-        if self._inflight is not None:
+        `generated > 1` predicate used to mislabel idle.
+
+        The in-flight test checks LIVENESS, not mere existence: with the
+        step pipeline on, the dispatch launched speculatively behind a
+        wave's final sync outlives every stream it carried — a dead
+        rectangle still draining through the device. Counting it as
+        mid-decode suppressed the NEXT admission's early first emits,
+        parking its first tokens until a full decode dispatch + sync.
+        Cold serves amortize that shadow over a long prefill; a
+        prefix-hit's short tail lives entirely inside it — measured on
+        the CPU tiny rig as warm-TTFT ~0.84x of cold (the BENCH_r06
+        0.68x class). Dead dispatches must not gate emission."""
+        if self._inflight_live():
             return True
         return any(
             s is not None and not s.prefilling and s.generated > 1
             for s in self.slots
         )
+
+    def _inflight_live(self) -> bool:
+        """Does the in-flight dispatch carry any row whose sequence
+        still occupies its slot? False for the pipelined overshoot
+        dispatch left behind after its streams all finished."""
+        d = self._inflight
+        if d is None:
+            return False
+        if d.mixed:
+            return any(
+                self.slots[slot] is seq
+                for _kind, slot, seq, _chunk in d.bld["entries"]
+            )
+        return any(self.slots[i] is s for i, s in d.snapshot)
 
     def _stamp_first_meta(self, seq: Sequence) -> None:
         """Attach the engine-side latency split to the first frame's
@@ -4236,6 +4376,15 @@ class JaxEngine:
             self.page_size * m.num_kv_heads * 4 * 2 if self._kv_quant else 0
         )
         return m.num_layers * (2 * per_pool + scales)
+
+    def _reset_offload_ema(self, rung: str = "", reason: str = "") -> None:
+        """Degrade-ladder trip hook (ADVICE r5 follow-up): the restore
+        gate's rate EMAs were calibrated on the pre-degrade engine
+        configuration (e.g. pipelined prefill throughput); after a trip
+        they would mis-price restore-vs-recompute, so both reset and the
+        next restore/prefill re-calibrate on the degraded engine."""
+        self._ema_restore_bps = None
+        self._ema_prefill_tps = None
 
     def _restore_worthwhile(self, n_pages: int) -> bool:
         """Gate a host-tier restore on measured rates: restore wins only

@@ -171,10 +171,24 @@ class ModelWatcher:
         client = await ep.client()
         self._clients[service] = client
         if self.router_mode == "kv":
+            import os
+
             from dynamo_tpu.llm.kv_router import KvPushRouter
 
+            # cross-worker prefix pulls + host-tier weighting
+            # (docs/kv_cache.md): DYN_KV_PULL_TOKENS > 0 lets the router
+            # move a saturated worker's cached prefix instead of
+            # recomputing it; DYN_KV_HOST_WEIGHT discounts host-tier
+            # blocks in the selector logit (device reuse is free, a
+            # host hit still pays an H2D restore)
             router = await KvPushRouter.create(
-                ep.component, client, block_size=card.kv_cache_block_size
+                ep.component, client, block_size=card.kv_cache_block_size,
+                pull_threshold_tokens=int(
+                    os.environ.get("DYN_KV_PULL_TOKENS", "0")
+                ),
+                host_tier_weight=float(
+                    os.environ.get("DYN_KV_HOST_WEIGHT", "0.5")
+                ),
             )
             self._kv_routers[service] = router
         pipeline = self._build(entry, card, client)

@@ -36,6 +36,22 @@ from dynamo_tpu.runtime.client import Client, PushRouter
 from dynamo_tpu.runtime.pipeline.context import Context
 from dynamo_tpu.utils import tracing
 
+from dynamo_tpu.utils import counters as _counters
+
+# zero-series at import (PR-7 declare convention): the pull plane's
+# counters must exist on /metrics before the first pull ever fires —
+# declared here (not in .pull) so the frontend process, which imports
+# the router but never the worker-side pull module directly, renders
+# them too. The package __init__ runs for both.
+for _name in (
+    "kv_pull_decisions_total",   # router chose pull-over-recompute
+    "kv_pull_attempts_total",    # puller started a transfer
+    "kv_pull_landed_total",      # prefix ingested into the local cache
+    "kv_pull_tokens_total",      # tokens of KV landed via pulls
+    "kv_pull_failed_total",      # transfer failed/timed out (fell back)
+):
+    _counters.declare(_name)
+
 __all__ = [
     "KvRouter",
     "KvPushRouter",
@@ -68,6 +84,9 @@ class KvRouter:
         block_size: int = 16,
         selector: Optional[WorkerSelector] = None,
         poll_interval: float = 1.0,
+        pull_threshold_tokens: int = 0,
+        pull_busy_frac: float = 0.9,
+        host_tier_weight: float = 0.5,
     ):
         self.component = component
         self.client = client
@@ -75,8 +94,21 @@ class KvRouter:
         self.indexer = KvIndexer(component, block_size)
         self.aggregator = KvMetricsAggregator(client, poll_interval)
         self.scheduler = KvScheduler(
-            component=component, selector=selector, block_size=block_size
+            component=component,
+            selector=selector
+            or DefaultWorkerSelector(host_tier_weight=host_tier_weight),
+            block_size=block_size,
         )
+        # cross-worker prefix pull (docs/kv_cache.md): when the best-
+        # overlap worker is saturated and holds at least this many
+        # cached prefix tokens MORE than the alternative, route to the
+        # alternative and tell it to PULL the prefix from the holder
+        # instead of recomputing it. 0 disables (routing then only ever
+        # sends requests toward their cache).
+        self.pull_threshold_tokens = pull_threshold_tokens
+        # saturation bar for the holder: active slots at or above this
+        # fraction of its total, or a non-empty admission queue
+        self.pull_busy_frac = pull_busy_frac
         self._started = False
 
     async def start(self) -> "KvRouter":
@@ -121,12 +153,22 @@ class KvRouter:
         healthy = [w for w in ids if w not in bad]
         return healthy or ids
 
-    async def schedule(self, token_ids: list[int]) -> SchedulingDecision:
+    async def schedule(
+        self,
+        token_ids: list[int],
+        hashes: Optional[list[int]] = None,
+        allow_pull: bool = True,
+    ) -> SchedulingDecision:
         """Pick the worker for these tokens (reference:
-        kv_router.rs:129-141 `schedule`)."""
-        overlaps = self.indexer.find_matches(
-            compute_block_hashes(token_ids, self.block_size)
-        )
+        kv_router.rs:129-141 `schedule`). Pass `hashes` when the caller
+        already chained the prompt's block hashes (KvPushRouter hashes
+        once and also ships the chain to the worker — the prompt must
+        never be hashed twice on the hot path). `allow_pull=False` for
+        callers that cannot deliver the pull decision to a worker (the
+        router-as-engine path returns only worker_id/overlap)."""
+        if hashes is None:
+            hashes = compute_block_hashes(token_ids, self.block_size)
+        overlaps = self.indexer.find_matches(hashes)
         candidates = self._healthy_candidates(self.client.instance_ids())
         workers = self.aggregator.endpoints_for(candidates)
         decision = await self.scheduler.schedule(
@@ -138,14 +180,84 @@ class KvRouter:
             raise NoInstancesError(
                 f"no live instances of {self.client.endpoint_id.subject}"
             )
-        return decision
+        if not allow_pull:
+            return decision
+        return self._maybe_pull(decision, workers, overlaps, len(token_ids))
+
+    def _saturated(self, m: ForwardPassMetrics) -> bool:
+        if (
+            m.request_total_slots
+            and m.request_active_slots
+            >= self.pull_busy_frac * m.request_total_slots
+        ):
+            return True
+        return m.num_requests_waiting > 0
+
+    def _maybe_pull(
+        self,
+        decision: SchedulingDecision,
+        workers: dict[int, ForwardPassMetrics],
+        overlaps,
+        isl_tokens: int,
+    ) -> SchedulingDecision:
+        """Cross-worker reuse decision: the selector just sent this
+        request to its best-overlap worker, but if that worker is
+        saturated, recomputing elsewhere wastes the prefix the fleet
+        already paid for — route to the best OTHER worker and have it
+        pull the holder's cached prefix (engine.export_prefix →
+        ingest_prefix) instead. Only fires when the pull is worth its
+        transfer: holder overlap minus the alternative's own overlap
+        must reach `pull_threshold_tokens`."""
+        thr = self.pull_threshold_tokens
+        if not thr or len(workers) < 2 or decision.pull_from is not None:
+            return decision
+        overlap_tokens = decision.overlap_blocks * self.block_size
+        if overlap_tokens < thr:
+            return decision
+        holder = decision.worker_id
+        m = workers.get(holder)
+        if m is None or not self._saturated(m):
+            return decision
+        rest = {w: mm for w, mm in workers.items() if w != holder}
+        alt = self.scheduler.selector.select(
+            rest, overlaps, isl_tokens, self.block_size
+        )
+        if alt is None:
+            return decision
+        pull_tokens = overlap_tokens - alt.overlap_blocks * self.block_size
+        if pull_tokens < thr:
+            # the alternative is nearly as warm already — plain routing
+            # to it reuses its own cache without any transfer
+            return alt
+        from dynamo_tpu.utils import counters
+
+        counters.inc("kv_pull_decisions_total")
+        if tracing.enabled():
+            tracing.instant(
+                "kv_router.pull", cat="router",
+                worker_id=alt.worker_id, pull_from=holder,
+                pull_tokens=overlap_tokens,
+                holder_active=m.request_active_slots,
+                holder_waiting=m.num_requests_waiting,
+            )
+        return SchedulingDecision(
+            worker_id=alt.worker_id,
+            overlap_blocks=alt.overlap_blocks,
+            logit=alt.logit,
+            pull_from=holder,
+            pull_tokens=overlap_tokens,
+        )
 
     # --- router-as-engine (reference: kv_router.rs:144-169) -------------
 
     async def generate(self, request: Context) -> AsyncIterator[dict]:
         payload = request.payload
         token_ids = payload["token_ids"] if isinstance(payload, dict) else payload.token_ids
-        decision = await self.schedule(token_ids)
+        # router-as-engine replies carry only worker_id/overlap — a pull
+        # decision here could never reach a worker, so don't make one
+        # (it would count kv_pull_decisions with no attempt ever firing
+        # and deliberately route AWAY from the holder for nothing)
+        decision = await self.schedule(token_ids, allow_pull=False)
 
         async def _one() -> AsyncIterator[dict]:
             yield RouterResponse(
@@ -175,8 +287,14 @@ class KvPushRouter(PushRouter):
         client: Client,
         block_size: int = 16,
         selector: Optional[WorkerSelector] = None,
+        pull_threshold_tokens: int = 0,
+        host_tier_weight: float = 0.5,
     ) -> "KvPushRouter":
-        router = KvRouter(component, client, block_size=block_size, selector=selector)
+        router = KvRouter(
+            component, client, block_size=block_size, selector=selector,
+            pull_threshold_tokens=pull_threshold_tokens,
+            host_tier_weight=host_tier_weight,
+        )
         await router.start()
         return cls(client, router)
 
@@ -194,7 +312,29 @@ class KvPushRouter(PushRouter):
             return await self.client.generate(
                 payload, context=context, mode="round_robin"
             )
-        decision = await self.router.schedule(list(token_ids))
+        from dynamo_tpu.llm.tokens import TokenBlockSequence
+
+        # hash ONCE: the same chain scores the workers here and rides
+        # Context metadata to the chosen worker, whose engine rebuilds
+        # its block sequence from it instead of re-hashing the prompt
+        # (and whose puller re-uses it for the export request)
+        tbs = TokenBlockSequence(list(token_ids), self.router.block_size)
+        seq_hashes = tbs.sequence_hashes()
+        decision = await self.router.schedule(
+            list(token_ids), hashes=seq_hashes
+        )
+        context = context or Context(payload)
+        context.metadata["kv_block_size"] = self.router.block_size
+        context.metadata["kv_seq_hashes"] = seq_hashes
+        context.metadata["kv_local_hashes"] = [
+            b.local_hash for b in tbs.blocks
+        ]
+        if decision.pull_from is not None:
+            # cross-worker reuse: the chosen worker pulls the prefix
+            # from the saturated holder before serving (llm/kv_router/
+            # pull.PrefixPuller on the worker side)
+            context.metadata["kv_pull_from"] = decision.pull_from
+            context.metadata["kv_pull_tokens"] = decision.pull_tokens
         return await self.client.generate(
             payload, context=context, mode="direct", instance_id=decision.worker_id
         )

@@ -25,9 +25,18 @@ from dynamo_tpu.llm.kv_router.protocols import KvCacheEvent, RouterEvent
 @dataclass
 class OverlapScores:
     """Per-worker count of contiguously matched prefix blocks
-    (reference: indexer.rs OverlapScores)."""
+    (reference: indexer.rs OverlapScores).
+
+    `scores` is the total overlap per worker regardless of tier (the
+    back-compat view); `device_scores`/`host_scores` split it by where
+    the worker holds each block — a device-tier hit is free reuse while
+    a host-tier hit still pays an H2D restore (and may be declined by
+    the worker's cost gate), so the selector weights host blocks below
+    device blocks (docs/kv_cache.md "Router scoring")."""
 
     scores: dict[int, int] = field(default_factory=dict)
+    device_scores: dict[int, int] = field(default_factory=dict)
+    host_scores: dict[int, int] = field(default_factory=dict)
     matched_blocks: int = 0  # length of the longest matched chain
 
     def best(self) -> int:
@@ -102,6 +111,12 @@ class RadixTree:
             out.matched_blocks += 1
             for w in active:
                 out.scores[w] = out.scores.get(w, 0) + 1
+                # tier split: a block present on device counts there even
+                # if the host pool also holds a copy (restore never needed)
+                if "device" in node.workers[w]:
+                    out.device_scores[w] = out.device_scores.get(w, 0) + 1
+                else:
+                    out.host_scores[w] = out.host_scores.get(w, 0) + 1
         return out
 
     @property

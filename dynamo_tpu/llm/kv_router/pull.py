@@ -1,0 +1,245 @@
+"""Cross-worker prefix pull: reuse a saturated worker's cached KV.
+
+The KV router's selector sends a request toward the worker already
+holding its prefix (`2·overlap − usage − slots`). When that worker is
+saturated, the reference's answer — and the pre-PR behavior here — was
+to route elsewhere and RECOMPUTE the prefix, throwing away work the
+fleet already paid for. This module closes that gap: the router stamps
+``kv_pull_from`` into the request's Context metadata (KvRouter
+`_maybe_pull`), and the chosen worker pulls the prefix from the holder
+before serving:
+
+  1. `KvExportHandler` (holder side) serves the component's ``kv_export``
+     subject: longest-cached-prefix extract via `Engine.export_prefix`
+     (pages pinned for the gather), streamed back in bounded layer-group
+     parts — the same host-staged wire as the disagg plane (an int8-KV
+     holder ships int8 + scales, half the bytes);
+  2. `PrefixPuller` (chosen-worker side) wraps the serving engine: on a
+     ``kv_pull_from`` request it fetches the parts (deadline-clamped),
+     lands them through `Engine.ingest_prefix` (pages registered in the
+     prefix cache, so admission rides them like a local hit), records
+     the ``kv.pull`` span on the request's trace track, and THEN
+     delegates to the engine — which now serves a warm prompt.
+
+Every pull is fail-open: a missing holder, transport error, or timeout
+logs, counts (``kv_pull_failed_total``) and falls through to a plain
+local recompute — the pull is an optimization, never a liability
+(docs/kv_cache.md "Cross-worker reuse").
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Any, AsyncIterator, Optional
+
+import msgpack
+import numpy as np
+
+from dynamo_tpu.llm.disagg import LAYERS_PER_PART, _np_from_wire, _np_to_wire
+from dynamo_tpu.runtime.pipeline.context import Context
+from dynamo_tpu.utils import counters, tracing
+from dynamo_tpu.utils.logging import get_logger
+
+log = get_logger("dynamo_tpu.kv_pull")
+
+KV_EXPORT_ENDPOINT = "kv_export"
+
+
+class KvExportHandler:
+    """Holder side: serve the component's ``kv_export`` subject.
+
+    Raw data-plane handler (the disagg-ingest pattern): request is a
+    msgpack dict ``{token_ids}`` (+ optional ``hashes`` when the caller
+    already chained them); the reply streams a header frame
+    ``{n_tokens, parts}`` followed by one frame per layer group so a
+    deep model never serializes as one giant message."""
+
+    def __init__(self, drt, engine, namespace: str, component: str):
+        self.drt = drt
+        self.engine = engine
+        self.subject = f"{namespace}.{component}.{KV_EXPORT_ENDPOINT}"
+
+    async def start(self) -> "KvExportHandler":
+        await self.drt.ensure_data_plane()
+        self.drt.data_plane.register(self.subject, self._handle)
+        return self
+
+    async def _handle(self, ctx: Context) -> AsyncIterator[bytes]:
+        d = msgpack.unpackb(ctx.payload, raw=False)
+        token_ids = list(d["token_ids"])
+        # the extract is a jit dispatch + device fetch — worker thread,
+        # never the event loop (the engine may be mid-decode)
+        out = await asyncio.to_thread(
+            self.engine.export_prefix, token_ids, d.get("hashes")
+        )
+
+        async def _stream() -> AsyncIterator[bytes]:
+            if out is None:
+                yield msgpack.packb({"n_tokens": 0, "parts": 0})
+                return
+            n_tokens, k, v, ks, vs = out
+            n_layers = k.shape[0]
+            parts = -(-n_layers // LAYERS_PER_PART)
+            yield msgpack.packb({"n_tokens": int(n_tokens), "parts": parts})
+            for p in range(parts):
+                lo, hi = p * LAYERS_PER_PART, min((p + 1) * LAYERS_PER_PART, n_layers)
+                frame: dict = {
+                    "part": p,
+                    "k": _np_to_wire(np.ascontiguousarray(k[lo:hi])),
+                    "v": _np_to_wire(np.ascontiguousarray(v[lo:hi])),
+                }
+                if ks is not None:
+                    # int8-KV holder: wire stays int8 + f32 scales
+                    frame["ks"] = _np_to_wire(np.ascontiguousarray(ks[lo:hi]))
+                    frame["vs"] = _np_to_wire(np.ascontiguousarray(vs[lo:hi]))
+                yield msgpack.packb(frame, use_bin_type=True)
+
+        return _stream()
+
+
+class PrefixPuller:
+    """Chosen-worker side: engine wrapper executing the router's pull
+    decision before delegating to the real serving engine.
+
+    Wraps whatever `run.py` would otherwise register (the plain engine
+    or a DisaggDecodeWorker) — requests without ``kv_pull_from``
+    metadata pass straight through with one dict lookup of overhead."""
+
+    def __init__(
+        self,
+        drt,
+        serving_engine,
+        engine,
+        eid,
+        pull_wait_s: float = 30.0,
+    ):
+        self.drt = drt
+        self.serving = serving_engine
+        self.engine = engine  # the JaxEngine (ingest/peek live here)
+        self.eid = eid
+        self.export_subject = (
+            f"{eid.namespace}.{eid.component}.{KV_EXPORT_ENDPOINT}"
+        )
+        # transfer budget; a request deadline shrinks it further (the
+        # PR-6 contract: waits always fit the caller's budget)
+        self.pull_wait_s = pull_wait_s
+        self._client = None
+        self.pulls = 0
+        self.pull_tokens = 0
+        self.pull_failures = 0
+
+    async def _holder_address(self, worker_id: int) -> Optional[str]:
+        if self._client is None:
+            ep = (
+                self.drt.namespace(self.eid.namespace)
+                .component(self.eid.component)
+                .endpoint(self.eid.name)
+            )
+            self._client = await ep.client()
+        info = self._client.instances.get(worker_id)
+        return info.address if info is not None else None
+
+    async def generate(self, request: Context) -> AsyncIterator[Any]:
+        holder = request.metadata.get("kv_pull_from")
+        if holder is not None:
+            await self._maybe_pull(request, int(holder))
+        return await self.serving.generate(request)
+
+    async def _maybe_pull(self, request: Context, holder: int) -> None:
+        payload = request.payload
+        token_ids = (
+            payload.get("token_ids")
+            if isinstance(payload, dict)
+            else getattr(payload, "token_ids", None)
+        )
+        if not token_ids:
+            return
+        ps = self.engine.page_size
+        want = int(request.metadata.get("kv_pull_tokens") or len(token_ids))
+        want = min(want, len(token_ids)) // ps * ps  # page-granular
+        if want <= 0:
+            return
+        prefix = list(token_ids[:want])
+        # already warm locally (an earlier pull, or organic traffic):
+        # the transfer would be pure waste
+        if self.engine.peek_prefix_tokens(prefix) >= want:
+            return
+        wait_s = self.pull_wait_s
+        try:
+            deadline = float(request.metadata.get("deadline") or 0.0)
+        except (TypeError, ValueError):
+            deadline = 0.0
+        if deadline:
+            remaining = deadline - time.time()
+            if remaining <= 0:
+                return  # the engine's own shed ladder owns the 429
+            wait_s = min(wait_s, remaining)
+        counters.inc("kv_pull_attempts_total")
+        t0 = time.perf_counter()
+        try:
+            n = await asyncio.wait_for(
+                self._pull(request, holder, prefix), timeout=wait_s
+            )
+        except Exception as exc:  # noqa: BLE001 — fail-open by contract
+            self.pull_failures += 1
+            counters.inc("kv_pull_failed_total")
+            log.warning(
+                "prefix pull from %x failed (%s); recomputing locally",
+                holder, exc,
+            )
+            return
+        if tracing.enabled():
+            tracing.complete(
+                "kv.pull", t0, time.perf_counter(), cat="kv",
+                req=request.id, pull_from=f"{holder:x}", tokens=n,
+            )
+        if n:
+            self.pulls += 1
+            self.pull_tokens += n
+            counters.inc("kv_pull_landed_total")
+            counters.inc("kv_pull_tokens_total", n)
+
+    async def _pull(self, request: Context, holder: int, prefix: list) -> int:
+        addr = await self._holder_address(holder)
+        if addr is None:
+            raise RuntimeError(f"holder {holder:x} has no live instance")
+        hashes = request.metadata.get("kv_seq_hashes")
+        req: dict = {"token_ids": prefix}
+        if hashes:
+            req["hashes"] = list(hashes)[: len(prefix) // self.engine.page_size]
+        handle = await self.drt.data_plane_client.request(
+            addr, self.export_subject,
+            msgpack.packb(req, use_bin_type=True),
+            request_id=request.id,
+        )
+        header = None
+        parts: dict[int, tuple] = {}
+        async for raw in handle:
+            d = msgpack.unpackb(raw, raw=False)
+            if header is None:
+                header = d
+                continue
+            parts[d["part"]] = (
+                _np_from_wire(d["k"]),
+                _np_from_wire(d["v"]),
+                _np_from_wire(d["ks"]) if "ks" in d else None,
+                _np_from_wire(d["vs"]) if "vs" in d else None,
+            )
+        if not header or not header.get("n_tokens"):
+            return 0  # holder's cache moved on (evicted): recompute
+        if len(parts) != header["parts"]:
+            raise RuntimeError(
+                f"pull truncated: {len(parts)}/{header['parts']} parts"
+            )
+        n_tokens = int(header["n_tokens"])
+        k = np.concatenate([parts[i][0] for i in range(header["parts"])])
+        v = np.concatenate([parts[i][1] for i in range(header["parts"])])
+        ks = vs = None
+        if parts[0][2] is not None:
+            ks = np.concatenate([parts[i][2] for i in range(header["parts"])])
+            vs = np.concatenate([parts[i][3] for i in range(header["parts"])])
+        # ingest is jit scatter + registration — worker thread again
+        return await asyncio.to_thread(
+            self.engine.ingest_prefix, prefix[:n_tokens], k, v, ks, vs
+        )

@@ -35,6 +35,12 @@ class SchedulingDecision:
     worker_id: int
     overlap_blocks: int
     logit: float
+    # cross-worker prefix pull (docs/kv_cache.md): when the best-overlap
+    # worker was saturated, `worker_id` is the alternative the request
+    # routes to and `pull_from` names the holder it should pull the
+    # prefix from instead of recomputing it; None = no pull.
+    pull_from: Optional[int] = None
+    pull_tokens: int = 0
 
 
 class WorkerSelector(Protocol):
@@ -48,8 +54,18 @@ class WorkerSelector(Protocol):
 
 
 class DefaultWorkerSelector:
-    def __init__(self, rng: Optional[random.Random] = None):
+    def __init__(
+        self,
+        rng: Optional[random.Random] = None,
+        host_tier_weight: float = 0.5,
+    ):
         self._rng = rng or random.Random()
+        # host-tier blocks weigh below device-tier in the overlap term:
+        # a host hit still pays an H2D restore (and the worker's cost
+        # gate may decline it), so it must not tie with free device
+        # reuse. 0.0 ignores the host tier entirely; 1.0 restores the
+        # tier-blind pre-PR behavior.
+        self.host_tier_weight = host_tier_weight
 
     def select(
         self,
@@ -67,7 +83,14 @@ class DefaultWorkerSelector:
         best: list[tuple[int, int, float]] = []  # (worker, overlap, logit)
         for wid, m in workers.items():
             overlap = overlaps.scores.get(wid, 0)
-            score = 2.0 * (overlap * block_size / max(isl_tokens, 1))
+            # tier-weighted overlap: device blocks full weight, host
+            # blocks discounted (older events predate the tier split and
+            # land in `scores` only — treat the untagged remainder as
+            # device so the formula degrades to the reference's)
+            host = overlaps.host_scores.get(wid, 0)
+            dev = overlap - host
+            eff = dev + self.host_tier_weight * host
+            score = 2.0 * (eff * block_size / max(isl_tokens, 1))
             usage = m.gpu_cache_usage_perc
             slots = m.request_active_slots / max_active if max_active else 0.0
             logit = score - usage - slots

@@ -83,6 +83,43 @@ class TokenBlockSequence:
             self._parent = seq
         return new_blocks
 
+    @classmethod
+    def with_hashes(
+        cls,
+        tokens: Sequence[int],
+        block_size: int,
+        sequence_hashes: Sequence[int],
+        local_hashes: Sequence[int],
+    ) -> "TokenBlockSequence":
+        """Rebuild a block sequence from PRECOMPUTED hashes — the far end
+        of a hop that already hashed the prompt (the KV router hashes
+        once to score workers and ships the chain in request metadata),
+        so the serving engine skips the O(prompt) re-hash on its hot
+        path. Both hash lists must cover exactly the full blocks of
+        `tokens`; mismatched lengths raise (callers fall back to
+        hashing). Later `extend` calls chain from the last provided
+        sequence hash, exactly as if computed locally."""
+        n_full = len(tokens) // block_size
+        if len(sequence_hashes) != n_full or len(local_hashes) != n_full:
+            raise ValueError(
+                f"precomputed hash chain covers {len(sequence_hashes)} "
+                f"blocks; prompt has {n_full}"
+            )
+        seq = cls.__new__(cls)
+        seq.block_size = block_size
+        seq.salt = None
+        seq.blocks = []
+        seq.partial = list(tokens[n_full * block_size:])
+        parent = ROOT_PARENT_HASH
+        for i in range(n_full):
+            chunk = tuple(tokens[i * block_size:(i + 1) * block_size])
+            seq.blocks.append(
+                TokenBlock(chunk, local_hashes[i], sequence_hashes[i], parent)
+            )
+            parent = sequence_hashes[i]
+        seq._parent = parent
+        return seq
+
     @property
     def total_tokens(self) -> int:
         return len(self.blocks) * self.block_size + len(self.partial)

@@ -430,6 +430,15 @@ async def run_worker(args, inp: str, out: str) -> None:
         engine, slo=slo, disagg_source=disagg_stats
     )
 
+    # cross-worker prefix pulls (docs/kv_cache.md): serve this worker's
+    # cached prefixes on the component's kv_export subject, and execute
+    # router pull decisions (Context metadata kv_pull_from) before the
+    # engine serves — requests without the metadata pass straight through
+    from dynamo_tpu.llm.kv_router.pull import KvExportHandler, PrefixPuller
+
+    await KvExportHandler(drt, engine, eid.namespace, eid.component).start()
+    serving_engine = PrefixPuller(drt, serving_engine, engine, eid)
+
     # attach the event publisher BEFORE the worker becomes discoverable:
     # events from requests arriving in the gap would be lost forever (the
     # indexer has no replay)

@@ -1,0 +1,314 @@
+"""Cross-worker prefix pull plane (llm/kv_router/pull.py).
+
+Covers the ISSUE-12 acceptance matrix: the router's live-event loop
+(store → route-to-holder → remove → fallback) against REAL engines on a
+hub, the saturation-aware pull decision, export_prefix/ingest_prefix
+byte-identity (bf16 and int8 wires), and the ``kv.pull`` span landing
+on the request's trace track.
+"""
+
+import asyncio
+
+import numpy as np
+
+from dynamo_tpu.engine import EngineConfig, JaxEngine
+from dynamo_tpu.llm.kv_router import (
+    KvEventPublisher,
+    KvMetricsPublisher,
+    KvPushRouter,
+    KvRouter,
+)
+from dynamo_tpu.llm.kv_router.indexer import OverlapScores
+from dynamo_tpu.llm.kv_router.protocols import ForwardPassMetrics
+from dynamo_tpu.llm.kv_router.pull import KvExportHandler, PrefixPuller
+from dynamo_tpu.llm.kv_router.scheduler import SchedulingDecision
+from dynamo_tpu.llm.protocols.common import (
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.models import config as cfgmod
+from dynamo_tpu.runtime.component import EndpointId
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.pipeline.context import Context
+from dynamo_tpu.utils import tracing
+
+from .helpers import hub_server
+
+PAGE = 8
+TINY = cfgmod.get_config("tiny")
+
+
+def engine_config(**kw):
+    base = dict(
+        model=TINY, dtype="float32", page_size=PAGE, num_pages=64,
+        max_batch_size=2, max_model_len=256, prefill_chunk=32,
+    )
+    base.update(kw)
+    return EngineConfig(**base)
+
+
+def pre_request(tokens, max_tokens=6):
+    return PreprocessedRequest(
+        token_ids=tokens,
+        stop_conditions=StopConditions(max_tokens=max_tokens, ignore_eos=True),
+        sampling_options=SamplingOptions(greedy=True),
+    )
+
+
+async def collect_engine(engine, tokens, max_tokens=6):
+    out, meta0 = [], None
+    async for frame in await engine.generate(
+        Context(pre_request(tokens, max_tokens).to_dict())
+    ):
+        out.extend(frame.get("token_ids") or [])
+        if meta0 is None and frame.get("meta"):
+            meta0 = frame["meta"]
+    return out, meta0
+
+
+# ------------------------------------------------ export/ingest roundtrip
+
+
+async def test_export_ingest_roundtrip_byte_identical():
+    a = JaxEngine(engine_config())
+    b = JaxEngine(engine_config())
+    rng = np.random.RandomState(0)
+    tokens = rng.randint(1, TINY.vocab_size, size=3 * PAGE + 3).tolist()
+    try:
+        cold, _ = await collect_engine(a, tokens, max_tokens=8)
+        out = a.export_prefix(tokens)
+        assert out is not None
+        n, k, v, ks, vs = out
+        assert n == 3 * PAGE and ks is None
+        landed = b.ingest_prefix(tokens[:n], k, v)
+        assert landed == n
+        warm, meta = await collect_engine(b, tokens, max_tokens=8)
+        assert meta["prefix_cached_tokens"] == n
+        assert warm == cold
+        # nothing cached for an unknown prompt
+        assert a.export_prefix([9, 9, 9, 9, 9, 9, 9, 9, 9]) is None
+        # pins dropped: the exported pages are still evictable/reusable
+        assert a.allocator.pages_used == 0
+    finally:
+        await a.close()
+        await b.close()
+
+
+async def test_export_ingest_int8_wire_byte_identical():
+    """int8-KV engines exchange int8 + scales; the landed pages must
+    reproduce the holder's greedy stream exactly."""
+    a = JaxEngine(engine_config(kv_quantization="int8"))
+    b = JaxEngine(engine_config(kv_quantization="int8"))
+    rng = np.random.RandomState(1)
+    tokens = rng.randint(1, TINY.vocab_size, size=3 * PAGE + 2).tolist()
+    try:
+        cold, _ = await collect_engine(a, tokens, max_tokens=8)
+        n, k, v, ks, vs = a.export_prefix(tokens)
+        assert k.dtype == np.int8 and ks is not None
+        landed = b.ingest_prefix(tokens[:n], k, v, ks, vs)
+        assert landed == n == 3 * PAGE
+        warm, meta = await collect_engine(b, tokens, max_tokens=8)
+        assert meta["prefix_cached_tokens"] == n
+        assert warm == cold
+    finally:
+        await a.close()
+        await b.close()
+
+
+# ------------------------------------------------------ decision (unit)
+
+
+def _pull_router(threshold=16):
+    router = KvRouter(
+        component=None, client=None, block_size=PAGE,
+        pull_threshold_tokens=threshold,
+    )
+    router.scheduler.component = None  # no hit-rate publishes
+    return router
+
+
+def _overlaps(worker, blocks):
+    o = OverlapScores(scores={worker: blocks})
+    o.device_scores[worker] = blocks
+    o.matched_blocks = blocks
+    return o
+
+
+def test_pull_decision_requires_saturation_and_margin():
+    router = _pull_router(threshold=2 * PAGE)
+    busy = ForwardPassMetrics(
+        request_active_slots=4, request_total_slots=4
+    )
+    idle = ForwardPassMetrics(request_total_slots=4)
+    workers = {1: busy, 2: idle}
+    d = SchedulingDecision(worker_id=1, overlap_blocks=3, logit=1.0)
+
+    out = router._maybe_pull(d, workers, _overlaps(1, 3), isl_tokens=32)
+    assert out.worker_id == 2 and out.pull_from == 1
+    assert out.pull_tokens == 3 * PAGE
+
+    # idle holder: no pull, original decision stands
+    workers_idle = {1: idle, 2: idle}
+    out = router._maybe_pull(d, workers_idle, _overlaps(1, 3), 32)
+    assert out.pull_from is None and out.worker_id == 1
+
+    # overlap under the threshold: recompute is cheaper than a transfer
+    d_small = SchedulingDecision(worker_id=1, overlap_blocks=1, logit=1.0)
+    out = router._maybe_pull(d_small, workers, _overlaps(1, 1), 32)
+    assert out.pull_from is None and out.worker_id == 1
+
+    # alternative nearly as warm: plain route to it, no transfer
+    o = _overlaps(1, 3)
+    o.scores[2] = 3
+    o.device_scores[2] = 3
+    out = router._maybe_pull(d, workers, o, 32)
+    assert out.worker_id == 2 and out.pull_from is None
+
+    # pull disabled (threshold 0): decision untouched
+    router0 = _pull_router(threshold=0)
+    out = router0._maybe_pull(d, workers, _overlaps(1, 3), 32)
+    assert out is d
+
+
+# --------------------------------------------------------------- live e2e
+
+
+async def test_pull_e2e_store_route_remove_fallback():
+    """The acceptance loop against real engines: stored events route a
+    warm prompt to its holder; saturating the holder pulls the prefix to
+    the idle worker via ingest_prefix (kv.pull span on the request's
+    track); removed events (cache clear) drop the overlap back to 0."""
+    tracing.enable()
+    tracing.clear()
+    rng = np.random.RandomState(2)
+    prefix = rng.randint(1, TINY.vocab_size, size=4 * PAGE).tolist()
+    eid = EndpointId("demo", "backend", "generate")
+
+    async with hub_server() as server:
+        hub = f"127.0.0.1:{server.port}"
+        drts = [
+            await DistributedRuntime.from_settings(hub_addr=hub)
+            for _ in range(3)
+        ]
+        w1, w2, rtr = drts
+        engines, pullers, wids = [], [], []
+        try:
+            for drt in (w1, w2):
+                engine = JaxEngine(engine_config())
+                engines.append(engine)
+                wids.append(drt.primary_lease.lease_id)
+                ep = drt.namespace("demo").component("backend").endpoint(
+                    "generate"
+                )
+                KvEventPublisher(
+                    ep.component, drt.primary_lease.lease_id
+                ).attach(engine).start()
+                await KvExportHandler(drt, engine, "demo", "backend").start()
+                puller = PrefixPuller(drt, engine, engine, eid)
+                pullers.append(puller)
+                metrics = KvMetricsPublisher.for_engine(engine)
+                await ep.serve_engine(
+                    puller, stats_handler=metrics.stats_handler
+                )
+
+            ep = rtr.namespace("demo").component("backend").endpoint(
+                "generate"
+            )
+            client = await ep.client()
+            await client.wait_for_instances()
+            for _ in range(100):
+                if len(client.instance_ids()) >= 2:
+                    break
+                await asyncio.sleep(0.05)
+            router = KvRouter(
+                ep.component, client, block_size=PAGE,
+                poll_interval=0.2,
+                pull_threshold_tokens=2 * PAGE,
+            )
+            await router.start()
+            push = KvPushRouter(client, router)
+
+            async def via_router(tokens, max_tokens=6):
+                out = []
+                async for f in await push.generate(
+                    pre_request(tokens, max_tokens).to_dict()
+                ):
+                    out.extend(f.get("token_ids") or [])
+                return out
+
+            # ---- store: cold serve lands the prefix somewhere
+            t0 = prefix + rng.randint(1, TINY.vocab_size, size=3).tolist()
+            cold = await via_router(t0)
+            for _ in range(100):
+                if router.indexer.tree.num_blocks >= 4:
+                    break
+                await asyncio.sleep(0.05)
+            d = await router.schedule(t0)
+            holder_id = d.worker_id
+            assert d.overlap_blocks == 4 and d.pull_from is None
+            hold_i = wids.index(holder_id)
+            holder_engine = engines[hold_i]
+            other_engine = engines[1 - hold_i]
+
+            # ---- route-to-holder: a warm serve reuses on the holder
+            hits0 = holder_engine.allocator.hits
+            warm = await via_router(t0)
+            assert warm == cold
+            assert holder_engine.allocator.hits > hits0
+
+            # ---- saturate the holder; the next shared-prefix request
+            # must PULL to the idle worker instead of recomputing
+            async def hold_one():
+                toks = rng.randint(
+                    1, TINY.vocab_size, size=2 * PAGE
+                ).tolist()
+                async for _ in await holder_engine.generate(
+                    Context(pre_request(toks, max_tokens=48).to_dict())
+                ):
+                    pass
+
+            held = [asyncio.create_task(hold_one()) for _ in range(2)]
+            for _ in range(100):
+                m = router.aggregator.current.endpoints.get(holder_id)
+                if m is not None and m.request_active_slots >= 2:
+                    break
+                await asyncio.sleep(0.1)
+            t1 = prefix + rng.randint(1, TINY.vocab_size, size=3).tolist()
+            pulled = await via_router(t1)
+            await asyncio.gather(*held)
+            other_puller = pullers[1 - hold_i]
+            assert other_puller.pulls == 1
+            assert other_puller.pull_tokens == 4 * PAGE
+            assert other_engine.peek_prefix_tokens(prefix) == 4 * PAGE
+            # the pulled serve reproduces the holder's stream for the
+            # shared prefix portion of a fresh suffix request
+            local_check, _ = await collect_engine(
+                holder_engine, t1, max_tokens=6
+            )
+            assert pulled == local_check
+            evs = tracing.export()["traceEvents"]
+            assert any(e["name"] == "kv.pull" for e in evs)
+            assert any(e["name"] == "kv_router.pull" for e in evs)
+
+            # ---- remove: clearing both caches feeds removed events;
+            # the router falls back to overlap 0
+            for engine in engines:
+                engine.allocator.clear_cache()
+            for _ in range(100):
+                if not router.indexer.find_matches_for_tokens(t0).scores:
+                    break
+                await asyncio.sleep(0.05)
+            d3 = await router.schedule(t0)
+            assert d3.overlap_blocks == 0 and d3.pull_from is None
+            await router.close()
+        finally:
+            for e in engines:
+                await e.close()
+            for drt in drts:
+                try:
+                    await drt.shutdown()
+                except Exception:  # noqa: BLE001
+                    pass
+            tracing.disable()
+            tracing.clear()

@@ -368,3 +368,80 @@ async def test_router_excludes_stale_and_open_breaker_workers():
     decision = await router.schedule([1, 2, 3, 4])
     assert decision.worker_id == 3
     _counters.reset()
+
+
+# ------------------------------------------------- host-tier weighting
+# (docs/kv_cache.md "Router scoring": device blocks are free reuse, a
+# host-tier block still pays an H2D restore — the selector must prefer
+# the worker whose copy needs no restore)
+
+
+def tier_stored(worker, hashes, tier):
+    return RouterEvent(
+        worker_id=worker,
+        event=KvCacheEvent(
+            type="stored", tier=tier,
+            blocks=[StoredBlock(block_hash=h, tokens_hash=h ^ 1) for h in hashes],
+        ),
+    )
+
+
+def test_radix_tier_split_scores():
+    tree = RadixTree()
+    tree.apply_event(tier_stored(1, [10, 11], "device"))
+    tree.apply_event(tier_stored(2, [10, 11], "host"))
+    m = tree.find_matches([10, 11])
+    assert m.scores == {1: 2, 2: 2}
+    assert m.device_scores == {1: 2}
+    assert m.host_scores == {2: 2}
+    # device copy appearing on a host-only worker upgrades its tier view
+    tree.apply_event(tier_stored(2, [10], "device"))
+    m = tree.find_matches([10])
+    assert m.device_scores == {1: 1, 2: 1} and m.host_scores == {}
+
+
+def test_selector_prefers_device_tier_at_equal_overlap():
+    sel = DefaultWorkerSelector(rng=random.Random(0), host_tier_weight=0.5)
+    tree = RadixTree()
+    tree.apply_event(tier_stored(1, [5, 6], "device"))
+    tree.apply_event(tier_stored(2, [5, 6], "host"))
+    overlaps = tree.find_matches([5, 6])
+    workers = {
+        1: ForwardPassMetrics(request_total_slots=4),
+        2: ForwardPassMetrics(request_total_slots=4),
+    }
+    d = sel.select(workers, overlaps, isl_tokens=32, block_size=16)
+    assert d.worker_id == 1  # host copy discounted, device copy wins
+    # weight 1.0 restores the tier-blind tie (random break over both)
+    sel_blind = DefaultWorkerSelector(
+        rng=random.Random(1), host_tier_weight=1.0
+    )
+    picks = {
+        sel_blind.select(workers, overlaps, 32, 16).worker_id
+        for _ in range(30)
+    }
+    assert picks == {1, 2}
+
+
+def test_radix_host_tier_removal_falls_back_to_device():
+    """store(host+device) -> removed(host) keeps the device copy; a
+    worker loses the block only when EVERY tier dropped it."""
+    tree = RadixTree()
+    tree.apply_event(tier_stored(1, [7], "device"))
+    tree.apply_event(tier_stored(1, [7], "host"))
+    tree.apply_event(
+        RouterEvent(
+            worker_id=1,
+            event=KvCacheEvent(type="removed", block_hashes=[7], tier="host"),
+        )
+    )
+    m = tree.find_matches([7])
+    assert m.scores == {1: 1} and m.device_scores == {1: 1}
+    tree.apply_event(
+        RouterEvent(
+            worker_id=1,
+            event=KvCacheEvent(type="removed", block_hashes=[7], tier="device"),
+        )
+    )
+    assert tree.find_matches([7]).scores == {}
+    assert tree.num_blocks == 0
