@@ -929,12 +929,11 @@ class JaxEngine:
             "kv_total_blocks": usable,
             "num_requests_waiting": len(self.waiting),
             "gpu_cache_usage_perc": self.allocator.usage(),
-            # prefix-cache hit rate of the HBM tier. The honest key is
-            # `prefix_cache_hit_rate` (there is no GPU in this repo);
-            # `gpu_prefix_cache_hit_rate` is a DEPRECATED alias kept one
-            # release for dashboards wired to the reference's name.
+            # prefix-cache hit rate of the HBM tier (the honest key —
+            # there is no GPU in this repo; the reference-named
+            # `gpu_prefix_cache_hit_rate` alias rode one release, PR 9,
+            # and is gone)
             "prefix_cache_hit_rate": self.allocator.hit_rate(),
-            "gpu_prefix_cache_hit_rate": self.allocator.hit_rate(),
             # prefix reservation breakdown (always-present zero-series:
             # metrics() computes every key, so the gauges render 0.0
             # from the first scrape per PR 7's declare convention)

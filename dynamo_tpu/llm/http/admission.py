@@ -140,6 +140,14 @@ class AdmissionController:
             self._attainment_fn = attainment_fn
         return self
 
+    @property
+    def state(self) -> str:
+        """Last evaluated ladder state ("ok"/"overload"/"critical") —
+        refreshed by request traffic through check(); read-only for
+        dashboards and harnesses (no signal evaluation, no shed
+        counting)."""
+        return self._state
+
     def priority_of(self, tenant: str) -> int:
         """Tenant's priority class: its own entry, else the "default"
         entry, else 0 — mirrors SloTracker._resolve fall-through."""

@@ -107,10 +107,24 @@ class ModelWatcher:
     """Frontend-side watcher building pipelines for discovered models
     (reference: discovery.rs:100-229 model_watcher)."""
 
-    def __init__(self, drt, manager: ModelManager, router_mode: str = "round_robin"):
+    def __init__(
+        self,
+        drt,
+        manager: ModelManager,
+        router_mode: str = "round_robin",
+        collect_stats: bool = False,
+    ):
         self._drt = drt
         self.manager = manager
         self.router_mode = router_mode
+        # collect_stats=True (run.py sets it when the admission gate is
+        # armed): non-kv router modes get a standalone stats aggregator
+        # per service so fleet overload signals (queue depth, SLO
+        # attainment riding worker stats replies) exist WITHOUT the kv
+        # router — previously round-robin/random ingress ran the
+        # admission gate blind (signal-less = always admit). kv mode
+        # already scrapes through its router's aggregator.
+        self.collect_stats = collect_stats
         self._task: Optional[asyncio.Task] = None
         self._watch = None
         # service_name -> {worker_key,...} live entries
@@ -118,6 +132,8 @@ class ModelWatcher:
         self._model_names: dict[str, str] = {}  # service_name -> public name
         self._clients: dict[str, object] = {}
         self._kv_routers: dict[str, object] = {}  # service -> KvPushRouter (mode kv)
+        # service -> KvMetricsAggregator (non-kv modes, collect_stats)
+        self.stats_aggregators: dict[str, object] = {}
         self.pipeline_factory = self._default_pipeline
 
     async def start(self) -> None:
@@ -134,6 +150,8 @@ class ModelWatcher:
             await self._watch.cancel()
         for router in self._kv_routers.values():
             await router.router.close()
+        for agg in self.stats_aggregators.values():
+            await agg.close()
         for client in self._clients.values():
             await client.close()
 
@@ -191,6 +209,14 @@ class ModelWatcher:
                 ),
             )
             self._kv_routers[service] = router
+        elif self.collect_stats:
+            from dynamo_tpu.llm.kv_router.metrics_aggregator import (
+                KvMetricsAggregator,
+            )
+
+            agg = KvMetricsAggregator(client)
+            await agg.start()
+            self.stats_aggregators[service] = agg
         pipeline = self._build(entry, card, client)
         self.manager.add_chat_model(entry.name, pipeline)
         self.manager.add_completion_model(entry.name, pipeline)
@@ -233,6 +259,9 @@ class ModelWatcher:
         kv_router = self._kv_routers.pop(service, None)
         if kv_router is not None:
             await kv_router.router.close()
+        agg = self.stats_aggregators.pop(service, None)
+        if agg is not None:
+            await agg.close()
         client = self._clients.pop(service, None)
         if client is not None:
             await client.close()

@@ -90,12 +90,11 @@ class ForwardPassMetrics:
     kv_total_blocks: int = 0
     num_requests_waiting: int = 0
     gpu_cache_usage_perc: float = 0.0
-    # prefix-cache hit rate of the worker's HBM tier. The honest key is
-    # `prefix_cache_hit_rate`; `gpu_prefix_cache_hit_rate` is the
-    # DEPRECATED alias (one release) matching the reference's name —
-    # from_dict accepts either, to_dict sends both.
+    # prefix-cache hit rate of the worker's HBM tier. This is the ONLY
+    # key (the reference-named `gpu_prefix_cache_hit_rate` alias was
+    # deprecated for one release in PR 9 and dropped); from_dict
+    # ignores the old key from stale senders rather than erroring.
     prefix_cache_hit_rate: float = 0.0
-    gpu_prefix_cache_hit_rate: float = 0.0
     data_parallel_rank: int = 0
     # per-worker SLO attainment, {"tenant/metric": fraction} over the
     # worker's rolling window (llm/http/metrics.SloTracker.snapshot) —
@@ -118,12 +117,6 @@ class ForwardPassMetrics:
         for optional in ("slo_attainment", "disagg"):
             if known.get(optional) is None:
                 known.pop(optional, None)
-        # deprecated-alias fill: a sender on either side of the rename
-        # populates both views
-        if "prefix_cache_hit_rate" not in known and "gpu_prefix_cache_hit_rate" in known:
-            known["prefix_cache_hit_rate"] = known["gpu_prefix_cache_hit_rate"]
-        elif "gpu_prefix_cache_hit_rate" not in known and "prefix_cache_hit_rate" in known:
-            known["gpu_prefix_cache_hit_rate"] = known["prefix_cache_hit_rate"]
         return cls(**known)
 
 

@@ -1,0 +1,39 @@
+"""Trace-driven scenario load harness (docs/loadgen.md).
+
+The measurement plane for the whole system: seeded deterministic trace
+generators (Poisson, bursty/diurnal, multi-tenant shared-prefix), an
+open-loop async replay driver that never gates arrivals on completions,
+SLO-gated goodput scoring (the PR-7 machinery), and a scenario registry
+with one scenario per workload the engine claims to support — emitted
+as the ``scenarios`` BENCH_OUT section (``BENCH_SCENARIOS=1``).
+"""
+
+from dynamo_tpu.loadgen.trace import (
+    Trace,
+    TraceRecord,
+    bursty_trace,
+    poisson_trace,
+    shared_prefix_trace,
+)
+from dynamo_tpu.loadgen.prompts import PromptFactory
+from dynamo_tpu.loadgen.driver import (
+    LedgerJoin,
+    RequestResult,
+    engine_submitter,
+    replay,
+)
+from dynamo_tpu.loadgen.score import score_results
+
+__all__ = [
+    "Trace",
+    "TraceRecord",
+    "poisson_trace",
+    "bursty_trace",
+    "shared_prefix_trace",
+    "PromptFactory",
+    "RequestResult",
+    "LedgerJoin",
+    "replay",
+    "engine_submitter",
+    "score_results",
+]

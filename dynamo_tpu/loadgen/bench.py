@@ -1,0 +1,125 @@
+"""Scenario suite runner — the ``scenarios`` BENCH_OUT section.
+
+``bench.py`` calls :func:`run_suite` when ``BENCH_SCENARIOS=1``;
+``scripts/run_scenarios.py`` is the standalone CLI entrypoint (CI
+``scenario-smoke``). Configuration rides ``LOADGEN_*`` env vars:
+
+    LOADGEN_SCENARIOS   csv of names, "default" (the 8 workload
+                        scenarios), or "all" (+ the fleet-proof
+                        adapters) — default "default"
+    LOADGEN_SCALE       tiny | real (default tiny: CI-runnable; real
+                        sizes traces/engines for an on-rig run)
+    LOADGEN_MODEL       model preset for real-scale scenario engines
+                        (default llama-3.2-1b)
+    LOADGEN_SEED        trace seed (default 0; same seed = byte-
+                        identical trace files)
+    LOADGEN_N           requests per scenario trace (scale override)
+    LOADGEN_RATE        base offered rate, req/s (scale override)
+    LOADGEN_TRACE_DIR   dump each scenario's trace JSONL here
+
+Each scenario runs in its own event loop; one failing scenario records
+an ``error`` entry instead of killing the suite.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import sys
+import time
+from typing import Optional
+
+from dynamo_tpu.loadgen.scenarios import (
+    SCENARIOS,
+    Scale,
+    real_scale,
+    tiny_scale,
+)
+
+# the 8 workload scenarios every BENCH_SCENARIOS run covers; the fleet
+# adapters join under "all" (bench.py already runs them standalone via
+# BENCH_PREFIX_FLEET/BENCH_CONTROL, so the default set avoids paying
+# for them twice)
+DEFAULT_SET = (
+    "chat", "rag", "shared_prefix", "bursty",
+    "long_context", "moe", "vision", "structured",
+)
+FLEET_SET = ("prefix_fleet", "control_chaos")
+
+
+def scale_from_env() -> Scale:
+    name = os.environ.get("LOADGEN_SCALE", "tiny")
+    over: dict = {}
+    if os.environ.get("LOADGEN_SEED"):
+        over["seed"] = int(os.environ["LOADGEN_SEED"])
+    if os.environ.get("LOADGEN_N"):
+        over["n"] = int(os.environ["LOADGEN_N"])
+    if os.environ.get("LOADGEN_RATE"):
+        over["rate_rps"] = float(os.environ["LOADGEN_RATE"])
+    if os.environ.get("LOADGEN_TRACE_DIR"):
+        over["trace_dir"] = os.environ["LOADGEN_TRACE_DIR"]
+    if name == "real":
+        return real_scale(**over)
+    if name == "tiny":
+        return tiny_scale(**over)
+    raise ValueError(f"unknown LOADGEN_SCALE {name!r} (want tiny|real)")
+
+
+def names_from_env() -> list[str]:
+    raw = os.environ.get("LOADGEN_SCENARIOS", "default").strip()
+    if raw in ("", "default"):
+        return list(DEFAULT_SET)
+    if raw == "all":
+        return list(DEFAULT_SET) + list(FLEET_SET)
+    names = [n.strip() for n in raw.split(",") if n.strip()]
+    unknown = [n for n in names if n not in SCENARIOS]
+    if unknown:
+        raise ValueError(
+            f"unknown scenario(s) {unknown}; have {sorted(SCENARIOS)}"
+        )
+    return names
+
+
+def run_suite(
+    names: Optional[list[str]] = None,
+    scale: Optional[Scale] = None,
+) -> dict:
+    """Run the selected scenarios sequentially (each in a fresh event
+    loop) and return the ``scenarios`` section dict."""
+    names = names if names is not None else names_from_env()
+    scale = scale or scale_from_env()
+    results: dict[str, dict] = {}
+    for name in names:
+        spec = SCENARIOS[name]
+        t0 = time.perf_counter()
+        print(f"scenario {name} [{spec.workload}] ...", file=sys.stderr)
+        try:
+            out = asyncio.run(spec.fn(scale))
+        except Exception as exc:  # noqa: BLE001 — one broken scenario
+            # must not hide the other seven's numbers
+            out = {
+                "scenario": name,
+                "workload": spec.workload,
+                "error": f"{type(exc).__name__}: {exc}",
+            }
+        out["scenario_wall_s"] = round(time.perf_counter() - t0, 2)
+        results[name] = out
+        if "error" in out:
+            line = f"ERROR {out['error']}"
+        elif out.get("kind") == "fleet_adapter":
+            # adapters carry their own proof payload, not the goodput
+            # contract — don't print a misleading goodput=None
+            line = f"fleet proof ok ({len(out.get('fleet') or {})} keys)"
+        else:
+            line = (
+                f"goodput={(out.get('goodput') or {}).get('goodput_toks_per_sec')} tok/s "
+                f"ttft_p50={((out.get('ttft') or {}).get('p50_s'))}s"
+            )
+        print(
+            f"scenario {name}: {line} [{out['scenario_wall_s']}s]",
+            file=sys.stderr,
+        )
+    return {
+        "scale": scale.to_dict(),
+        "results": results,
+    }

@@ -162,9 +162,9 @@ class MetricsExporter:
             gauge("requests_active_slots", m.request_active_slots, lab)
             gauge("requests_total_slots", m.request_total_slots, lab)
             gauge("gpu_cache_usage_percent", m.gpu_cache_usage_perc, lab)
-            # honest key (no GPU in this repo); the ForwardPassMetrics
-            # wire carries gpu_prefix_cache_hit_rate one more release as
-            # a deprecated alias (docs/kv_cache.md)
+            # honest key (no GPU in this repo); the one-release
+            # gpu_prefix_cache_hit_rate wire alias is gone
+            # (docs/kv_cache.md)
             gauge("prefix_cache_hit_rate", m.prefix_cache_hit_rate, lab)
             gauge("requests_waiting", m.num_requests_waiting, lab)
             # per-worker SLO attainment (rolling-window fractions the

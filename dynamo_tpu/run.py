@@ -170,17 +170,20 @@ def build_admission(args):
 
 def _bind_ingress_admission(admission, watcher) -> None:
     """Fleet signals for an ingress-mode admission gate: mean waiting
-    depth per worker + worst fleet attainment, read from the kv
-    routers' metrics aggregators (router_mode=kv; other modes have no
-    aggregator and the gate stays signal-less = always ok)."""
+    depth per worker + worst fleet attainment. router_mode=kv reads the
+    kv routers' metrics aggregators; round-robin/random modes read the
+    standalone per-service stats aggregators the ModelWatcher starts
+    when collect_stats is set (same worker stats plane, no router) —
+    so the gate is never signal-blind just because routing is dumb."""
     import statistics
 
     def _aggs():
-        return [
+        kv = [
             r.router.aggregator
             for r in watcher._kv_routers.values()
             if getattr(r, "router", None) is not None
         ]
+        return kv + list(watcher.stats_aggregators.values())
 
     def queue_depth():
         waits = [
@@ -294,7 +297,13 @@ async def run_http(args, out: str) -> None:
         from dynamo_tpu.runtime.distributed import DistributedRuntime
 
         drt = await DistributedRuntime.from_settings(hub_addr=args.hub)
-        watcher = ModelWatcher(drt, svc.manager, router_mode=args.router_mode)
+        watcher = ModelWatcher(
+            drt, svc.manager, router_mode=args.router_mode,
+            # armed admission needs overload signals in EVERY router
+            # mode: non-kv modes start a standalone stats aggregator
+            # per discovered service (docs/control.md)
+            collect_stats=admission is not None,
+        )
         await watcher.start()
         if admission is not None:
             _bind_ingress_admission(admission, watcher)

@@ -29,7 +29,10 @@ Timeline (all durations configurable):
 
 Emits one JSON dict (the ``control`` BENCH_OUT section); run directly
 it prints the JSON and exits non-zero if the loop failed to close
-(no scale-up, infinite recovery, or an ungraceful drain).
+(no scale-up, infinite recovery, or an ungraceful drain). Also
+registered in the loadgen scenario registry as the ``control_chaos``
+adapter (docs/loadgen.md), so ``scripts/run_scenarios.py --scenarios
+all`` runs this proof too.
 """
 
 from __future__ import annotations

@@ -36,7 +36,9 @@ economics, in the unit the ROADMAP asks for.
 
 Emits one JSON dict (the ``prefix_fleet`` BENCH_OUT section); run
 directly it prints the JSON and exits non-zero when the plane failed
-(no routing reuse, or no pull landed).
+(no routing reuse, or no pull landed). Also registered in the loadgen
+scenario registry as the ``prefix_fleet`` adapter (docs/loadgen.md),
+so ``scripts/run_scenarios.py --scenarios all`` runs this proof too.
 """
 
 from __future__ import annotations

@@ -1,0 +1,105 @@
+"""One entrypoint for every scenario and fleet proof (docs/loadgen.md).
+
+    python scripts/run_scenarios.py [--scenarios chat,bursty|all]
+                                    [--out path.json] [--scale tiny|real]
+
+Runs the loadgen scenario registry (dynamo_tpu/loadgen/scenarios.py) —
+including the prefix_fleet and control_chaos fleet proofs when selected
+— validates every emitted section against the scenarios contract
+(SLO-gated goodput + TTFT/ITL percentiles + throughput present, no
+errors), prints the JSON, and exits non-zero on a malformed or failed
+scenario. CI's ``scenario-smoke`` job runs a 3-scenario subset.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def check_section(name: str, out: dict) -> list[str]:
+    """Contract violations for one scenario section ([] = well-formed)."""
+    bad = []
+    if "error" in out:
+        return [f"{name}: scenario errored: {out['error']}"]
+    if out.get("kind") == "fleet_adapter":
+        if not isinstance(out.get("fleet"), dict) or not out["fleet"]:
+            bad.append(f"{name}: fleet adapter carried no payload")
+        return bad
+    gp = out.get("goodput") or {}
+    if gp.get("goodput_toks_per_sec") is None:
+        bad.append(f"{name}: missing goodput_toks_per_sec")
+    elif gp["goodput_toks_per_sec"] <= 0:
+        bad.append(f"{name}: zero goodput ({gp})")
+    if gp.get("attained_frac") is None:
+        bad.append(f"{name}: missing SLO attained_frac")
+    for metric in ("ttft", "itl"):
+        for q in ("p50_s", "p99_s"):
+            if (out.get(metric) or {}).get(q) is None:
+                bad.append(f"{name}: missing {metric}.{q}")
+    if out.get("throughput_toks_per_sec") is None:
+        bad.append(f"{name}: missing throughput")
+    reqs = out.get("requests") or {}
+    if reqs.get("errors"):
+        bad.append(f"{name}: {reqs['errors']} request errors")
+    if (out.get("trace") or {}).get("sha256") is None:
+        bad.append(f"{name}: missing trace identity")
+    return bad
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--scenarios", default=None,
+                    help="csv of scenario names, 'default' or 'all' "
+                         "(default: LOADGEN_SCENARIOS env or 'default')")
+    ap.add_argument("--scale", default=None, choices=["tiny", "real"],
+                    help="default: LOADGEN_SCALE env or tiny")
+    ap.add_argument("--out", default=None, help="also write JSON here")
+    ap.add_argument("--list", action="store_true",
+                    help="list registered scenarios and exit")
+    args = ap.parse_args()
+
+    if args.scenarios is not None:
+        os.environ["LOADGEN_SCENARIOS"] = args.scenarios
+    if args.scale is not None:
+        os.environ["LOADGEN_SCALE"] = args.scale
+
+    from dynamo_tpu.loadgen import bench as loadgen_bench
+    from dynamo_tpu.loadgen.scenarios import SCENARIOS
+
+    if args.list:
+        for name, spec in SCENARIOS.items():
+            kind = " [fleet]" if spec.fleet else ""
+            print(f"{name}{kind}: {spec.description}")
+        return 0
+
+    section = loadgen_bench.run_suite()
+    print(json.dumps(section, indent=2))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(section, f, indent=2)
+            f.write("\n")
+
+    problems = []
+    for name, out in section["results"].items():
+        problems.extend(check_section(name, out))
+    if problems:
+        for p in problems:
+            print(f"MALFORMED: {p}", file=sys.stderr)
+        return 1
+    print(
+        f"{len(section['results'])} scenario(s) well-formed "
+        f"(scale={section['scale']['name']})",
+        file=sys.stderr,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -352,6 +352,80 @@ async def test_disagg_remote_wait_sheds_at_deadline():
             await drt.shutdown()
 
 
+# ------------------------- admission signals on non-kv ingress modes
+
+
+async def test_non_kv_ingress_admission_gets_fleet_signals():
+    """round_robin/random ingress previously ran the admission gate
+    BLIND (no aggregator = no signals = always admit). With
+    collect_stats the ModelWatcher starts a standalone stats aggregator
+    per service, so queue depth + worst attainment reach the gate the
+    same way the kv mode's router aggregator feeds it."""
+    from dynamo_tpu.llm.engines import EchoEngineCore
+    from dynamo_tpu.llm.http.discovery import ModelWatcher, register_llm
+    from dynamo_tpu.llm.model_card import ModelDeploymentCard
+    from dynamo_tpu.run import _bind_ingress_admission
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+    from .fixtures import tiny_model_dir
+
+    async with hub_server() as server:
+        hub_addr = f"127.0.0.1:{server.port}"
+        worker = await DistributedRuntime.from_settings(hub_addr=hub_addr)
+        frontend = await DistributedRuntime.from_settings(hub_addr=hub_addr)
+        svc = HttpService()
+        watcher = ModelWatcher(
+            frontend, svc.manager, router_mode="round_robin",
+            collect_stats=True,
+        )
+        try:
+            # worker stats reply: a burning, deeply-queued snapshot
+            def stats_handler():
+                return {
+                    "num_requests_waiting": 40,
+                    "request_active_slots": 4,
+                    "slo_attainment": {"default/ttft": 0.5},
+                }
+
+            card = ModelDeploymentCard.from_local_path(
+                tiny_model_dir(), name="tiny-echo"
+            )
+            await register_llm(
+                worker, EchoEngineCore(), card,
+                "dyn://demo.backend.generate", stats_handler=stats_handler,
+            )
+            await watcher.start()
+            for _ in range(50):
+                if svc.manager.get_chat("tiny-echo"):
+                    break
+                await asyncio.sleep(0.1)
+            assert "tiny-echo" in [
+                m for m in svc.manager.list_models()
+            ]
+            assert watcher.stats_aggregators, "no stats aggregator started"
+
+            ctl = AdmissionController(
+                priorities={"interactive": 10, "batch": 0, "default": 0},
+                cfg=AdmissionConfig(
+                    eval_interval_s=0.0, queue_high_watermark=8.0
+                ),
+            )
+            _bind_ingress_admission(ctl, watcher)
+            # the aggregator scraped at start(); signals must reach the
+            # gate and trip the ladder (queue 40 > 2x8 => critical)
+            for _ in range(50):
+                if ctl.check("batch") is not None:
+                    break
+                await asyncio.sleep(0.1)
+            shed = ctl.check("batch")
+            assert shed is not None and shed.status == 503
+            assert ctl.check("interactive") is None
+        finally:
+            await watcher.stop()
+            await worker.shutdown()
+            await frontend.shutdown()
+
+
 # ------------------------------------------------- k8s planner mirror
 
 

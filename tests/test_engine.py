@@ -121,7 +121,7 @@ async def test_prefix_cache_hit_and_events():
     assert frames2[0]["meta"]["prefix_cached_tokens"] == 16
     assert t2 == t1
     m = engine.metrics()
-    assert m["gpu_prefix_cache_hit_rate"] > 0
+    assert m["prefix_cache_hit_rate"] > 0
     await engine.close()
 
 

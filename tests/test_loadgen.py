@@ -1,0 +1,259 @@
+"""Loadgen harness tests (docs/loadgen.md): seeded generator
+determinism + trace file round-trip, open-loop driver timing (arrivals
+never gated on completions), SLO-gated scoring math on synthetic
+results, and a tiny in-process end-to-end scenario run asserting the
+``scenarios`` BENCH_OUT section shape."""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import tempfile
+
+from dynamo_tpu.loadgen.driver import (
+    STATUS_ERROR,
+    STATUS_OK,
+    STATUS_SHED,
+    RequestResult,
+    replay,
+)
+from dynamo_tpu.loadgen.prompts import PromptFactory
+from dynamo_tpu.loadgen.score import score_results
+from dynamo_tpu.loadgen.trace import (
+    Trace,
+    bursty_trace,
+    poisson_trace,
+    shared_prefix_trace,
+)
+
+# ------------------------------------------------------------ generators
+
+
+def test_poisson_trace_seed_determinism():
+    kw = dict(n=32, rate_rps=20.0, isl=(16, 64), osl=(4, 12),
+              tenants=(("a", 1, 2.0), ("b", 0)))
+    a = poisson_trace(seed=7, **kw)
+    b = poisson_trace(seed=7, **kw)
+    assert a.dumps() == b.dumps()          # byte-identical serialization
+    assert a.sha256() == b.sha256()
+    c = poisson_trace(seed=8, **kw)
+    assert a.dumps() != c.dumps()
+    # arrivals strictly ordered, lengths within the requested ranges
+    ts = [r.arrival_ts for r in a.records]
+    assert ts == sorted(ts)
+    assert all(16 <= r.isl <= 64 and 4 <= r.osl <= 12 for r in a.records)
+    assert {r.tenant for r in a.records} <= {"a", "b"}
+    assert all(
+        r.priority == (1 if r.tenant == "a" else 0) for r in a.records
+    )
+
+
+def test_bursty_trace_determinism_and_modulation():
+    kw = dict(n=128, base_rps=4.0, peak_rps=64.0, period_s=4.0)
+    a = bursty_trace(seed=1, **kw)
+    assert a.dumps() == bursty_trace(seed=1, **kw).dumps()
+    # the crest (around period/2 mod period) must be denser than the
+    # trough: compare arrivals in the middle vs the edges of a period
+    phase = [r.arrival_ts % 4.0 for r in a.records]
+    crest = sum(1 for p in phase if 1.0 <= p < 3.0)
+    trough = len(phase) - crest
+    assert crest > trough * 1.5, (crest, trough)
+
+
+def test_shared_prefix_trace_groups():
+    t = shared_prefix_trace(
+        tenants=4, per_tenant=3, rate_rps=10.0, seed=2, isl=32, osl=8
+    )
+    assert len(t) == 12
+    groups = {r.prefix_group for r in t.records}
+    assert groups == {f"group{i}" for i in range(4)}
+    # each tenant's records share one group
+    for r in t.records:
+        assert r.prefix_group == r.tenant.replace("tenant", "group")
+    assert t.dumps() == shared_prefix_trace(
+        tenants=4, per_tenant=3, rate_rps=10.0, seed=2, isl=32, osl=8
+    ).dumps()
+
+
+def test_trace_file_round_trip():
+    t = poisson_trace(n=16, rate_rps=5.0, seed=3, isl=24, osl=6,
+                      sampling={"temperature": 0.7, "seed": 9})
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "t.jsonl")
+        t.dump(path)
+        back = Trace.load(path)
+        assert back.dumps() == t.dumps()
+        assert back.meta == t.meta
+        assert back.records[0].sampling == {"temperature": 0.7, "seed": 9}
+        # a second dump of the loaded trace is byte-identical too
+        path2 = os.path.join(d, "t2.jsonl")
+        back.dump(path2)
+        assert open(path).read() == open(path2).read()
+
+
+def test_prompt_factory_determinism_and_prefix_sharing():
+    f1 = PromptFactory(256, seed=5, page_size=8)
+    f2 = PromptFactory(256, seed=5, page_size=8)
+    t = shared_prefix_trace(
+        tenants=2, per_tenant=3, rate_rps=10.0, seed=2, isl=33, osl=8
+    )
+    for i, r in enumerate(t.records):
+        assert f1.tokens_for(r, i) == f2.tokens_for(r, i)
+        assert len(f1.tokens_for(r, i)) == r.isl
+    # same group -> identical page-aligned prefix; different suffixes
+    same = [
+        (i, r) for i, r in enumerate(t.records)
+        if r.prefix_group == "group0"
+    ]
+    (i0, r0), (i1, r1) = same[0], same[1]
+    n = f1.prefix_len(r0)
+    assert n > 0 and n % 8 == 0
+    a, b = f1.tokens_for(r0, i0), f1.tokens_for(r1, i1)
+    assert a[:n] == b[:n]
+    assert a[n:] != b[n:]
+    # different seed -> different prefixes
+    assert PromptFactory(256, seed=6, page_size=8).tokens_for(r0, i0) != a
+
+
+# ------------------------------------------------------------ open loop
+
+
+async def test_replay_is_open_loop():
+    """A submitter that BLOCKS for the whole trace must not delay later
+    arrivals: launch lag stays tiny while completions are all pending."""
+    trace = poisson_trace(n=10, rate_rps=100.0, seed=0, isl=8, osl=4)
+    launched: list[float] = []
+    release = asyncio.Event()
+
+    async def submit(rec, res):
+        launched.append(asyncio.get_running_loop().time())
+        await release.wait()   # nothing completes until every arrival fired
+        res.ttft_s = 0.01
+        res.tokens = rec.osl
+
+    async def releaser():
+        # release only after the last scheduled arrival time has passed
+        await asyncio.sleep(trace.duration_s + 0.2)
+        release.set()
+
+    rel = asyncio.create_task(releaser())
+    results, wall = await replay(trace, submit)
+    await rel
+    assert len(launched) == 10
+    # every request launched near its trace time despite ZERO completions
+    max_lag = max(r.launch_lag_s for r in results)
+    assert max_lag < 0.15, max_lag
+    assert all(r.status == STATUS_OK for r in results)
+
+
+async def test_replay_marks_escaped_exceptions():
+    trace = poisson_trace(n=3, rate_rps=50.0, seed=0, isl=8, osl=4)
+
+    async def submit(rec, res):
+        if res.index == 1:
+            raise RuntimeError("boom")
+        res.ttft_s = 0.01
+        res.tokens = 1
+
+    results, _ = await replay(trace, submit)
+    assert results[1].status == STATUS_ERROR
+    assert "boom" in results[1].error
+    assert results[0].status == STATUS_OK
+
+
+# -------------------------------------------------------------- scoring
+
+
+def _result(i, status=STATUS_OK, ttft=0.1, itl=0.01, tokens=10,
+            lag=0.001):
+    return RequestResult(
+        index=i, request_id=f"r{i}", scheduled_s=float(i),
+        launched_s=float(i) + lag, status=status, ttft_s=ttft,
+        itl_s=itl, tokens=tokens,
+    )
+
+
+def test_score_results_goodput_math():
+    # 4 ok (2 within SLO), 1 shed, 1 error over a 10 s wall
+    results = [
+        _result(0, ttft=0.5, tokens=10),
+        _result(1, ttft=1.0, tokens=10),
+        _result(2, ttft=3.0, tokens=10),   # breaches ttft
+        _result(3, ttft=2.0, tokens=10),   # exactly at target ATTAINS
+        _result(4, status=STATUS_SHED, ttft=None, itl=None, tokens=0),
+        _result(5, status=STATUS_ERROR, ttft=None, itl=None, tokens=0),
+    ]
+    s = score_results(results, wall_s=10.0, slo_ttft_s=2.0)
+    assert s["requests"] == {"total": 6, "ok": 4, "shed": 1, "errors": 1}
+    assert s["goodput"]["attained_frac"] == 0.75   # 3 of 4 admitted
+    assert s["goodput"]["good_requests"] == 3
+    assert s["goodput"]["goodput_toks_per_sec"] == 3.0   # 30 tok / 10 s
+    assert s["throughput_toks_per_sec"] == 4.0           # 40 tok / 10 s
+    assert s["ttft"]["p50_s"] is not None
+    assert s["itl"]["p50_s"] == 0.01
+    assert s["open_loop"]["max_launch_lag_s"] == 0.001
+
+    # the ITL gate composes: a request within TTFT but over ITL is bad
+    s2 = score_results(results, wall_s=10.0, slo_ttft_s=2.0,
+                       slo_itl_s=0.005)
+    assert s2["goodput"]["good_requests"] == 0
+    assert s2["goodput"]["goodput_toks_per_sec"] == 0.0
+
+
+def test_score_results_empty_and_all_shed():
+    s = score_results([], wall_s=1.0)
+    assert s["requests"]["total"] == 0
+    assert s["goodput"]["attained_frac"] == 0.0
+    shed = [_result(0, status=STATUS_SHED, ttft=None, itl=None, tokens=0)]
+    s2 = score_results(shed, wall_s=1.0)
+    assert s2["requests"]["shed"] == 1
+    assert s2["goodput"]["goodput_toks_per_sec"] == 0.0
+
+
+# ------------------------------------------------------ scenario section
+
+
+async def test_tiny_scenario_emits_wellformed_section():
+    """One in-process end-to-end scenario run: the emitted section must
+    satisfy the ``scenarios`` BENCH_OUT contract (SLO-gated goodput,
+    TTFT/ITL percentiles, throughput, trace identity, reuse ledger)."""
+    import sys
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "scripts",
+    ))
+    from run_scenarios import check_section
+
+    from dynamo_tpu.loadgen.scenarios import SCENARIOS, tiny_scale
+
+    with tempfile.TemporaryDirectory() as d:
+        scale = tiny_scale(n=6, rate_rps=40.0, trace_dir=d)
+        out = await SCENARIOS["shared_prefix"].fn(scale)
+        assert check_section("shared_prefix", out) == []
+        assert out["scenario"] == "shared_prefix"
+        assert out["workload"] == "shared_prefix"
+        assert out["requests"]["ok"] == out["requests"]["total"]
+        assert out["goodput"]["goodput_toks_per_sec"] > 0
+        assert out["trace"]["sha256"]
+        # warm serves rode the prefix cache and the ledger was joined
+        assert out["reuse"]["requests_with_reuse"] > 0
+        assert out["warm_reuse_frac"] > 0
+        # the replayable trace file was dumped and round-trips
+        dumped = Trace.load(os.path.join(d, "shared_prefix.jsonl"))
+        assert dumped.summary()["sha256"] == out["trace"]["sha256"]
+
+
+def test_registry_covers_claimed_workloads():
+    from dynamo_tpu.loadgen.bench import DEFAULT_SET, FLEET_SET
+    from dynamo_tpu.loadgen.scenarios import SCENARIOS
+
+    # one scenario per workload the engine claims to support, plus the
+    # folded standalone fleet proofs — all behind one entrypoint
+    assert set(DEFAULT_SET) <= set(SCENARIOS)
+    assert set(FLEET_SET) <= set(SCENARIOS)
+    workloads = {SCENARIOS[n].workload for n in DEFAULT_SET}
+    assert {"chat", "rag", "shared_prefix", "bursty_diurnal",
+            "long_context", "moe", "vision",
+            "structured_sampling"} <= workloads
+    assert all(SCENARIOS[n].fleet for n in FLEET_SET)

@@ -202,8 +202,20 @@ async def test_prefix_trace_track_and_metric_rename():
         await collect(engine, tokens)
         await collect(engine, tokens)
         m = engine.metrics()
-        assert m["prefix_cache_hit_rate"] == m["gpu_prefix_cache_hit_rate"]
         assert m["prefix_cache_hit_rate"] > 0
+        # the PR-9 one-release gpu_* alias is gone from metrics() and
+        # the wire: from_dict tolerates (ignores) it from stale senders
+        assert "gpu_prefix_cache_hit_rate" not in m
+        from dynamo_tpu.llm.kv_router.protocols import ForwardPassMetrics
+
+        fpm = ForwardPassMetrics.from_dict(
+            {"gpu_prefix_cache_hit_rate": 0.5}
+        )
+        assert fpm.prefix_cache_hit_rate == 0.0
+        assert not hasattr(fpm, "gpu_prefix_cache_hit_rate")
+        assert "gpu_prefix_cache_hit_rate" not in ForwardPassMetrics(
+            prefix_cache_hit_rate=0.7
+        ).to_dict()
         assert m["prefix_hits"] == 1
         # every prefix gauge is an always-present zero-series key
         for key in ("prefix_full_hits", "prefix_reused_tokens",
