@@ -1227,9 +1227,7 @@ def main() -> None:
     if BENCH_OUT:
         # machine-readable trajectory artifact: one file, every section
         # keyed (null = section not requested this run)
-        with open(BENCH_OUT, "w") as f:
-            json.dump(
-                {
+        sections = {
                     "headline": headline,
                     "spec": spec_result,
                     "mixed": mixed_result,
@@ -1255,10 +1253,13 @@ def main() -> None:
                     # throughput over the measured wave + the
                     # per-request prefix/offload ledgers of the probes
                     "goodput": goodput,
-                },
-                f,
-                indent=2,
-            )
+        }
+        # provenance: extra.rev (git SHA) + extra.ts in EVERY section,
+        # so scripts/bench_history.py joins runs to commits without
+        # filename archaeology
+        _stamp_provenance(sections)
+        with open(BENCH_OUT, "w") as f:
+            json.dump(sections, f, indent=2)
             f.write("\n")
     if BENCH_TRACE:
         import sys
@@ -1271,6 +1272,32 @@ def main() -> None:
         # freed its HBM above), so dump via the tracing module.
         n_ev = _tracing.dump(BENCH_TRACE)
         print(f"trace: {n_ev} events -> {BENCH_TRACE}", file=sys.stderr)
+
+
+def _stamp_provenance(sections: dict) -> None:
+    """extra.rev (git SHA) + extra.ts on every emitted section: the
+    join key scripts/bench_history.py uses to line a BENCH_OUT up
+    against commits. GITHUB_SHA wins (CI checkouts can be detached or
+    shallow); a local git rev-parse covers dev runs; rev stays null
+    outside both."""
+    import subprocess
+
+    rev = os.environ.get("GITHUB_SHA") or None
+    if not rev:
+        try:
+            rev = subprocess.run(
+                ["git", "rev-parse", "HEAD"],
+                capture_output=True, text=True, timeout=10,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+            ).stdout.strip() or None
+        except Exception:  # noqa: BLE001 — provenance is best-effort
+            rev = None
+    ts = int(time.time())
+    for section in sections.values():
+        if isinstance(section, dict):
+            extra = section.setdefault("extra", {})
+            extra.setdefault("rev", rev)
+            extra.setdefault("ts", ts)
 
 
 if __name__ == "__main__":

@@ -157,6 +157,14 @@ class EngineConfig:
     # crash-artifact directory for watchdog dumps (trace ring + phase
     # stats JSON); None = DYN_CRASH_DIR env or /tmp.
     crash_dir: Optional[str] = None
+    # ---- forensics plane (docs/observability.md "Forensics plane") ----
+    # always-on flight recorder: a bounded ring of per-step digests +
+    # per-phase latency baselines; SLO breaches / watchdog fires /
+    # deadline-shed bursts / sustained anomalies dump a rate-limited
+    # forensic artifact (engine/flight_recorder.py; ring size and
+    # trigger knobs ride DYN_FLIGHT_* env vars). False disables the
+    # ring entirely (byte-identical serving either way).
+    flight_recorder: bool = True
     # ---- fleet control plane (docs/control.md) ----
     # tenant-priority scheduling: admission picks the highest-priority
     # waiting class (FIFO within a class) and preemption evicts the

@@ -94,6 +94,16 @@ class DegradeLadder:
     def any_tripped(self) -> bool:
         return bool(self._tripped)
 
+    def mask(self) -> int:
+        """Bit i set = RUNGS[i] currently tripped — the compact degrade
+        field of a flight-recorder step digest (non-probing read, like
+        `state()`)."""
+        m = 0
+        for i, rung in enumerate(RUNGS):
+            if rung in self._tripped:
+                m |= 1 << i
+        return m
+
     # ------------------------------------------------------ transitions
 
     def trip(self, rung: str, reason: str, permanent: bool = False) -> None:

@@ -84,7 +84,8 @@ from dynamo_tpu.ops.sampling import (
     sample_tokens,
     verify_draft_tokens,
 )
-from dynamo_tpu.engine import telemetry
+from dynamo_tpu.engine import flight_recorder as flightmod
+from dynamo_tpu.engine import profiler, telemetry
 from dynamo_tpu.parallel import mesh as meshmod
 from dynamo_tpu.runtime.pipeline.context import Context
 from dynamo_tpu.utils import artifacts, faults, instance, tracing
@@ -650,6 +651,15 @@ class JaxEngine:
             reprobe_s=config.degrade_reprobe_s,
             on_trip=self._reset_offload_ema,
         )
+        # flight recorder (docs/observability.md "Forensics plane"):
+        # always-on per-step digest ring sampled at the _phase_stats
+        # sites + rolling per-phase latency baselines; SLO breaches,
+        # watchdog fires, deadline-shed bursts, sustained anomalies and
+        # GET /debug/snapshot dump a correlated, rate-limited artifact
+        self.flight = flightmod.FlightRecorder(
+            context_fn=self._flight_context,
+            directory=config.crash_dir,
+        ) if config.flight_recorder else None
         # watchdog: in-flight device-critical ops (dispatch calls and
         # result fetches) register here as {token: (label, t_start)};
         # the monitor task trips the ladder + dumps a crash artifact
@@ -1012,6 +1022,24 @@ class JaxEngine:
             "deadline_shed": ps["deadline_shed"],
             "deadline_timeouts": ps["deadline_timeouts"],
             "faults_injected": faults.fired_total() if faults.active() else 0,
+            # forensics plane (engine/flight_recorder.py): digest-ring
+            # fill, artifacts written vs rate-limit-suppressed, and
+            # total anomalous steps (the per-phase split renders as the
+            # labeled engine_step_anomalies_total counter)
+            "flight_digests": (
+                self.flight.count if self.flight is not None else 0
+            ),
+            "flight_dumps": (
+                self.flight.dumps_total if self.flight is not None else 0
+            ),
+            "flight_suppressed": (
+                self.flight.suppressed_total
+                if self.flight is not None else 0
+            ),
+            "step_anomalies": (
+                self.flight.anomalies_total
+                if self.flight is not None else 0
+            ),
         }
 
     # ------------------------------------------------------------------
@@ -1891,6 +1919,12 @@ class JaxEngine:
                 "watchdog.fire", cat="degrade", op=label,
                 stalled_s=round(stalled_s, 3), rung=rung or "",
             )
+        if self.flight is not None:
+            # forensics plane: the flight recorder's correlated artifact
+            # (digest window + trace slice + context) rides every
+            # watchdog fire too — rate-limited, so a storm of stalled
+            # ops still writes one
+            self.flight.trigger(f"watchdog:{label}")
 
     def _dump_crash_artifact(
         self, label: str, stalled_s: float, rung: Optional[str]
@@ -1915,6 +1949,12 @@ class JaxEngine:
                 ],
                 "trace": tracing.export(),
             }
+            if self.flight is not None:
+                # the step-digest window rides the watchdog artifact
+                # too: what the engine was doing in the seconds BEFORE
+                # the hang, not just the hang itself
+                artifact["digest_fields"] = list(flightmod.FIELDS)
+                artifact["digests"] = self.flight.snapshot_rows()
         except Exception:  # noqa: BLE001 — the dump is best-effort
             log.exception("watchdog crash-artifact dump failed")
             return None
@@ -1952,6 +1992,11 @@ class JaxEngine:
             seq.out_queue.put_nowait(
                 EngineOutput.final(FINISH_REASON_TIMEOUT).to_dict()
             )
+        if expired and self.flight is not None:
+            # a shed BURST (not one straggler) is a forensic trigger:
+            # the recorder windows the counts and dumps past its
+            # threshold (DYN_FLIGHT_SHED_BURST)
+            self.flight.note_shed(len(expired))
         return bool(expired)
 
     def _sweep_expired(self, seq: Sequence, now: float) -> bool:
@@ -1977,6 +2022,11 @@ class JaxEngine:
     async def close(self) -> None:
         self._closed = True
         self._wake.set()
+        if self.flight is not None:
+            # freeze the final context snapshot and drop the bound
+            # provider: the flight-recorder registry keeps the RING
+            # dumpable post-close without pinning this engine's pools
+            self.flight.seal_context()
         if self._watchdog_task is not None and not self._watchdog_task.done():
             self._watchdog_task.cancel()
             try:
@@ -2558,6 +2608,56 @@ class JaxEngine:
         """Snapshot of the engine-side phase accounting (see __init__)."""
         return dict(self._phase_stats)
 
+    def _flight_context(self) -> dict:
+        """Engine snapshot embedded in every flight-recorder artifact
+        (metrics + phase stats + in-flight ops) — the state the digest
+        window alone cannot carry."""
+        # _ops is mutated lock-free by dispatch worker threads; a busy
+        # incident — exactly when triggers fire — can resize it mid-
+        # iteration. Retry the copy rather than letting build_artifact
+        # swallow the RuntimeError and ship an EMPTY context.
+        ops = []
+        for _ in range(4):
+            try:
+                ops = list(self._ops.values())
+                break
+            except RuntimeError:
+                continue
+        return {
+            "metrics": self.metrics(),
+            "phase_stats": self.phase_stats,
+            "degrade": self._degrade.state(),
+            "waiting": len(self.waiting),
+            "inflight_ops": [
+                {"op": lbl, "age_s": round(time.perf_counter() - t0, 3)}
+                for lbl, t0 in ops
+            ],
+        }
+
+    def _flight_record(
+        self, kind: str, wall_s: float, rows: int = 0, tokens: int = 0,
+        budget: int = 0,
+    ) -> None:
+        """Sample one step digest into the flight recorder — called from
+        the exact sites that feed _phase_stats, so the digests and the
+        counters can never disagree about a dispatch. Must never take
+        down the dispatch it observes."""
+        fr = self.flight
+        if fr is None:
+            return
+        try:
+            fr.record(
+                kind, wall_s, rows=rows, tokens=tokens,
+                budget_fill=round(tokens / budget, 4) if budget else 0.0,
+                queue_depth=len(self.waiting),
+                slots_active=sum(1 for s in self.slots if s is not None),
+                kv_frac=round(self.allocator.usage(), 4),
+                degrade_mask=self._degrade.mask(),
+                step=self._step_count,
+            )
+        except Exception:  # noqa: BLE001 — forensics must not break serving
+            log.exception("flight-recorder digest failed")
+
     def _any_mid_decode(self) -> bool:
         """Is decode actually RUNNING? True when a decode dispatch with
         at least one LIVE row is in flight, or — covering the brief
@@ -2796,7 +2896,10 @@ class JaxEngine:
             final_row[j] = seq.num_computed + chunk >= seq.total_tokens
         t_dispatch0 = time.perf_counter()  # dispatch section only: the
         # host-side input build above must not skew the phase split
-        with self._kv_lock:
+        # xprof annotation named like the engine.steps span, so an
+        # on-device capture joins the Perfetto ring export by name
+        with profiler.step_annotation(self._step_count), \
+                profiler.annotate("prefill"), self._kv_lock:
             self._key, sub = jax.random.split(self._key)
             common = (
                 self.params, self.kv,
@@ -2851,6 +2954,9 @@ class JaxEngine:
             self._phase_stats["prefill_dispatch_s"] += now - t_dispatch0
             self._phase_stats["prefill_dispatches"] += 1
             self._phase_stats["prefill_tokens"] += n_tok
+        self._flight_record(
+            "prefill", now - t_dispatch0, rows=len(seqs), tokens=n_tok,
+        )
         if tracing.enabled():
             # step timeline: same site that feeds _phase_stats, so the
             # trace and the counters can never disagree about a dispatch
@@ -3281,6 +3387,9 @@ class JaxEngine:
             # the whole dispatch+fetch wall is time the decode rows did
             # NOT spend parked behind a separate prefill dispatch
             self._phase_stats["mixed_decode_stall_saved_s"] += now - t0
+        self._flight_record(
+            "sync", now - t_sync0, rows=len(bld["entries"]),
+        )
         if tracing.enabled():
             tracing.complete(
                 "mixed.sync", t_sync0, now, cat="step",
@@ -3462,7 +3571,9 @@ class JaxEngine:
         t0 = time.perf_counter()
         wd = self._op_begin("mixed.dispatch")
         try:
-            with self._kv_lock:
+            # xprof phase annotation matches the engine.steps span name
+            with profiler.step_annotation(self._step_count), \
+                    profiler.annotate("mixed"), self._kv_lock:
                 self._flush_dev_state_locked(bld["dirty"])
                 self._key, sub = jax.random.split(self._key)
                 S, self.kv, self._carry_toks = self._mixed_fn(
@@ -3482,6 +3593,11 @@ class JaxEngine:
         t1 = time.perf_counter()
         with self._phase_lock:
             self._phase_stats["mixed_dispatch_s"] += t1 - t0
+        self._flight_record(
+            "mixed", t1 - t0, rows=len(bld["entries"]),
+            tokens=sum(e[3] for e in bld["entries"]),
+            budget=self.config.mixed_step_tokens,
+        )
         if tracing.enabled():
             entries = bld["entries"]
             tracing.complete(
@@ -3841,7 +3957,10 @@ class JaxEngine:
         t0 = time.perf_counter()
         wd = self._op_begin("spec.dispatch" if bld.spec else "decode.dispatch")
         try:
-            with self._kv_lock:
+            # xprof phase annotation matches the engine.steps span name
+            with profiler.step_annotation(self._step_count), \
+                    profiler.annotate("spec_verify" if bld.spec else "decode"), \
+                    self._kv_lock:
                 if bld.spec:
                     out = self._run_spec_dispatch_locked(bld)
                 else:
@@ -3855,6 +3974,9 @@ class JaxEngine:
             with self._phase_lock:
                 self._phase_stats["spec_dispatch_s"] += t1 - t0
                 self._phase_stats["spec_dispatches"] += 1
+            self._flight_record(
+                "spec_verify", t1 - t0, rows=rows, tokens=n_tok,
+            )
             if tracing.enabled():
                 tracing.complete(
                     "spec_verify", t0, t1, cat="step",
@@ -3869,6 +3991,7 @@ class JaxEngine:
             # includes the <= steps-1 overshoot positions of rows that
             # finish mid-scan, so this bounds emitted tokens from above
             self._phase_stats["decode_tokens"] += n_tok
+        self._flight_record("decode", t1 - t0, rows=rows, tokens=n_tok)
         if tracing.enabled():
             tracing.complete(
                 "decode", t0, t1, cat="step", track="engine.steps",
@@ -4050,6 +4173,10 @@ class JaxEngine:
                 self._phase_stats["mixed_decode_stall_saved_s"] += (
                     t_sync1 - d.bld["t0"]
                 )
+        self._flight_record(
+            "overlap" if overlapped else "sync", t_sync1 - t_sync0,
+            rows=len(d.bld["entries"]) if d.mixed else len(d.snapshot),
+        )
         if tracing.enabled():
             tracing.complete(
                 "mixed.sync" if d.mixed
@@ -4548,14 +4675,18 @@ class JaxEngine:
                 if seq.t_first_emit and seq.generated > 1 else None
             ),
         }
-        for cb in self._request_observers:
-            try:
-                cb(summary)
-            except Exception:
-                log.exception("request observer failed")
+        # record the request span BEFORE notifying observers: an
+        # observer can dump a forensic artifact for this very request
+        # (SloTracker breach -> flight recorder), and the artifact's
+        # trace slice must already contain the submit→finish span
         if tracing.enabled() and seq.t_submit:
             tracing.complete(
                 "request", seq.t_submit, now, cat="request",
                 req=seq.ctx.id, finish_reason=reason,
                 prompt_tokens=seq.prompt_len, tokens=seq.generated,
             )
+        for cb in self._request_observers:
+            try:
+                cb(summary)
+            except Exception:
+                log.exception("request observer failed")

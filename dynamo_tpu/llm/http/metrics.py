@@ -253,6 +253,13 @@ class EngineMetrics:
                 yield f"{name}{self._worker_label} {float(val)}"
         for h in (self.ttft, self.itl, self.queue_wait, self.tokens):
             yield from h.render()
+        # forensics counters (engine/flight_recorder.py): the labeled
+        # step_anomalies{phase} + dump/suppressed families ride the same
+        # scrape as the engine gauges (zero-series declared at recorder
+        # construction — scripts/check_prom.py gates them rendering)
+        fr = getattr(self.engine, "flight", None)
+        if fr is not None:
+            yield from fr.render_prom()
         if self.slo is not None:
             yield from self.slo.render()
 
@@ -301,6 +308,13 @@ class SloTracker:
         self.targets: dict = targets or {}
         self.window_s = window_s
         self.max_samples = max_samples
+        # breach hook (forensics plane): called with (tenant_row, metric
+        # slug, value, target, request_id) for every request that missed
+        # its target — run.py wires it to the engine flight recorder's
+        # `on_slo_breach` so the forensic artifact exists the moment the
+        # breach lands, rate-limited recorder-side. Exceptions are
+        # contained: forensics must never break the finish path.
+        self.on_breach: Optional[callable] = None
         # (tenant, metric) -> deque[(monotonic_ts, attained_bool)]
         self._windows: dict[tuple, deque] = {}
         self.breaches = Counter(
@@ -357,6 +371,14 @@ class SloTracker:
             self.requests.inc(tenant=row, metric=slug)
             if not attained:
                 self.breaches.inc(tenant=row, metric=slug)
+                if self.on_breach is not None:
+                    try:
+                        self.on_breach(
+                            row, slug, value, target,
+                            summary.get("request_id"),
+                        )
+                    except Exception:  # noqa: BLE001 — forensics must
+                        pass           # not break the finish path
             self._refresh(row, slug, now)
 
     def _refresh(self, tenant: str, slug: str, now: float) -> None:

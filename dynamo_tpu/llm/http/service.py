@@ -111,6 +111,8 @@ class HttpService:
                 web.get("/v1/models", self._models),
                 web.get("/metrics", self._metrics),
                 web.get("/debug/trace", self._debug_trace),
+                web.get("/debug/snapshot", self._debug_snapshot),
+                web.post("/debug/profile", self._debug_profile),
                 web.get("/health", self._health),
                 web.get("/live", self._health),
             ]
@@ -159,11 +161,95 @@ class HttpService:
         (utils/tracing.py) MERGED with spans shipped from other
         processes (runtime/trace_plane.py) — a request that crossed
         frontend → router → worker renders each process as its own
-        named track group. `?request_id=<id>` filters to one request.
-        Empty unless tracing is armed (DYN_TRACE=1); load the body at
+        named track group. `?request_id=<id>` filters to one request,
+        `?track=<name>` to one named track (e.g. ``engine.steps``).
+        The response is CAPPED at `?limit=` newest non-metadata events
+        (default ``DYN_TRACE_HTTP_MAX_EVENTS``, 20000; ``limit=0``
+        lifts the cap) — the merged fleet ring can exceed multi-MB and
+        one scrape must not serialize everything unconditionally; a
+        capped body carries ``truncatedEvents``. Empty unless tracing
+        is armed (DYN_TRACE=1); load the body at
         https://ui.perfetto.dev — see docs/observability.md."""
+        import os
+
         rid = request.query.get("request_id")
-        return web.json_response(tracing.export(request_id=rid))
+        track = request.query.get("track")
+        raw_limit = request.query.get("limit")
+        if raw_limit is not None:
+            try:
+                limit = int(raw_limit)
+            except ValueError:
+                return _error_response(
+                    400, f"invalid limit {raw_limit!r} (want an int)"
+                )
+        else:
+            # an operator typo in the env default must not brick the
+            # endpoint with a 400 blaming the client's absent ?limit=
+            try:
+                limit = int(
+                    os.environ.get("DYN_TRACE_HTTP_MAX_EVENTS", "")
+                    or 20000
+                )
+            except ValueError:
+                limit = 20000
+        return web.json_response(
+            tracing.export(
+                request_id=rid, track=track,
+                max_events=limit if limit > 0 else None,
+            )
+        )
+
+    async def _debug_snapshot(self, request: web.Request) -> web.Response:
+        """Manual flight-recorder trigger (docs/observability.md
+        "Forensics plane"): every registered recorder dumps its
+        correlated forensic artifact NOW (rate limit bypassed — a human
+        asked) and the paths come back. ``?request_id=<id>`` scopes the
+        embedded trace slice to one request."""
+        from dynamo_tpu.engine import flight_recorder
+
+        rid = request.query.get("request_id")
+        arts = []
+        for rec in flight_recorder.registered():
+            path = rec.trigger("manual", request_id=rid, force=True)
+            arts.append({
+                "path": path,
+                "digests": rec.count,
+                "dumps_total": rec.dumps_total,
+            })
+        return web.json_response(
+            {"recorders": len(arts), "artifacts": arts}
+        )
+
+    async def _debug_profile(self, request: web.Request) -> web.Response:
+        """On-demand on-device profiling (``POST /debug/profile?``
+        ``duration_ms=N``): one bounded `jax.profiler` capture into
+        ``DYN_PROFILE_DIR``, phase-annotated to join the Perfetto ring
+        export by name (engine/profiler.py). A capture already in
+        flight answers 409 — the single-capture gate."""
+        from dynamo_tpu.engine import profiler
+
+        raw = request.query.get("duration_ms", "1000")
+        try:
+            duration_ms = float(raw)
+        except ValueError:
+            return _error_response(
+                400, f"invalid duration_ms {raw!r} (want milliseconds)"
+            )
+        duration_ms = min(max(duration_ms, 1.0), 60000.0)
+        if not profiler.available():
+            return _error_response(
+                501, "jax.profiler unavailable (or DYN_PROFILE=0)"
+            )
+        try:
+            info = await profiler.capture(duration_ms)
+        except profiler.ProfilerBusy as exc:
+            return _error_response(409, str(exc))
+        except profiler.ProfilerUnavailable as exc:
+            return _error_response(501, str(exc))
+        except Exception as exc:  # noqa: BLE001 — capture is best-effort
+            log.exception("profile capture failed")
+            return _error_response(500, f"profile capture failed: {exc}")
+        return web.json_response(info)
 
     async def _chat_completions(self, request: web.Request) -> web.StreamResponse:
         return await self._serve_llm(

@@ -335,6 +335,12 @@ async def run_http(args, out: str) -> None:
             from dynamo_tpu.llm.http.metrics import EngineMetrics
 
             slo = build_slo_tracker(args)
+            if slo is not None and getattr(engine, "flight", None) is not None:
+                # forensics plane: an SLO breach dumps the correlated
+                # flight-recorder artifact (digest window + the
+                # breaching request's trace slice) the moment it lands —
+                # rate-limited recorder-side (docs/observability.md)
+                slo.on_breach = engine.flight.on_slo_breach
             svc.metrics.extra.append(
                 EngineMetrics(
                     engine, slo=slo,
@@ -402,6 +408,10 @@ async def run_worker(args, inp: str, out: str) -> None:
     slo = build_slo_tracker(args)
     if slo is not None:
         engine.subscribe_requests(slo.observe)
+        if getattr(engine, "flight", None) is not None:
+            # breach -> forensic artifact, worker-side too (the trace
+            # slice still joins the frontend via the shipped spans)
+            slo.on_breach = engine.flight.on_slo_breach
 
     if args.disagg_mode == "prefill":
         from dynamo_tpu.llm.disagg import PrefillHandler

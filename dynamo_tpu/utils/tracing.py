@@ -526,14 +526,23 @@ def _foreign_tid(process: str, track: str) -> int:
 # ------------------------------------------------------------------- export
 
 
-def export(request_id: Optional[str] = None) -> dict:
+def export(
+    request_id: Optional[str] = None,
+    track: Optional[str] = None,
+    max_events: Optional[int] = None,
+) -> dict:
     """Snapshot the ring as a Chrome trace-event JSON object: events
     sorted by ts (monotonic), one thread_name metadata record per track.
     Foreign events ingested from other processes merge in on their own
     pid with ``process_name`` metadata — each process a named track
     group of ONE timeline. `request_id` filters the export (metadata
     records for the surviving tracks are kept) — the /debug/trace
-    per-request view."""
+    per-request view. `track` filters to one named track (request rows
+    are named by their request id; foreign tracks match on their wire
+    name regardless of process). `max_events` keeps only the NEWEST N
+    non-metadata events — the response-size cap a multi-MB merged fleet
+    ring needs on every HTTP scrape; the count dropped is reported as
+    ``truncatedEvents`` (Perfetto ignores unknown top-level keys)."""
     # copy() is a single C call that never runs Python code mid-loop, so
     # it cannot observe a concurrent worker-thread append mid-iteration —
     # sorting the live deque directly could raise "mutated during
@@ -547,6 +556,11 @@ def export(request_id: Optional[str] = None) -> dict:
         foreign = [
             e for e in foreign if e["args"].get("request_id") == request_id
         ]
+    if track is not None:
+        with _tracks_lock:
+            names = {tid: name for name, tid in _tracks.items()}
+        local = [e for e in local if names.get(e["tid"]) == track]
+        foreign = [e for e in foreign if e["track"] == track]
     remote = []
     with _tracks_lock:
         tracks = dict(_tracks)
@@ -560,6 +574,12 @@ def export(request_id: Optional[str] = None) -> dict:
         proc_pids = dict(_foreign_pids)
         foreign_tracks = dict(_foreign_tracks)
     events = sorted(local + remote, key=lambda e: e["ts"])
+    truncated = 0
+    if max_events is not None and len(events) > max_events:
+        # newest win, like the ring itself: the tail of the timeline is
+        # the part a latency postmortem reads first
+        truncated = len(events) - max_events
+        events = events[truncated:]
     meta = [
         {
             "name": "process_name",
@@ -602,7 +622,10 @@ def export(request_id: Optional[str] = None) -> dict:
         )
         if process in proc_pids
     ]
-    return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+    out = {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+    if truncated:
+        out["truncatedEvents"] = truncated
+    return out
 
 
 def dump(path: str) -> int:

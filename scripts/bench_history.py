@@ -1,0 +1,403 @@
+"""Bench-history trend + regression gate over the BENCH_r*.json trajectory.
+
+The repo carries one machine-readable bench artifact per external run
+(``BENCH_r01.json`` …) and ``bench.py`` writes the same shape fresh via
+``BENCH_OUT`` — but until now nothing READ the trajectory, so a win lost
+quietly (the 0.68x warm-TTFT class) surfaced at re-anchor time instead
+of at PR time. This tool closes that loop:
+
+- **normalize** every run (old harness wrappers ``{n, cmd, rc, parsed}``
+  and the sectioned BENCH_OUT shape both) into a flat metric set with a
+  comparability *context* per metric — a tiny-model headline is never
+  compared against a llama-scale one, an ISL=64 probe never against
+  ISL=160 (contexts must match exactly);
+- **print a trend table** (runs × metrics, with each run's
+  ``extra.rev`` commit join when stamped);
+- with ``--fresh BENCH_OUT.json``, **gate**: each fresh metric is
+  compared against the most recent comparable historical value and the
+  tool exits non-zero when one regressed beyond its per-metric
+  tolerance (relative + a small absolute floor for near-zero
+  fractions). History-only mode never fails — the trajectory is a
+  record, not a promise; only a FRESH run is judged.
+
+Usage:
+    python scripts/bench_history.py                      # trend table
+    python scripts/bench_history.py --fresh out.json     # gate a run
+    python scripts/bench_history.py --history-glob 'dir/BENCH_r*.json' \
+        --fresh out.json --json                          # CI form
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from dataclasses import dataclass
+from typing import Optional
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@dataclass
+class Metric:
+    value: float
+    higher_better: bool
+    context: str          # must match exactly for two runs to compare
+    rtol: float = 0.10    # relative tolerance before a delta is a regression
+    atol: float = 0.0     # absolute floor (near-zero fractions are noisy)
+
+
+def _ctx(*parts) -> str:
+    return "|".join(str(p) for p in parts)
+
+
+def _scenario_key(section: dict) -> str:
+    """Stable serialization of a section's own `scenario` descriptor —
+    the scale key for sections that carry one (prefix_fleet, control)."""
+    sc = section.get("scenario")
+    return (
+        json.dumps(sc, sort_keys=True) if isinstance(sc, dict) else "-"
+    )
+
+
+def _num(x) -> Optional[float]:
+    return float(x) if isinstance(x, (int, float)) and not isinstance(
+        x, bool
+    ) else None
+
+
+def normalize(doc: dict) -> dict:
+    """One run (either wire shape) -> {"rev", "ts", "ok", "metrics"}."""
+    if "rc" in doc and "cmd" in doc:
+        # old external-harness wrapper: parsed holds the headline only
+        if doc.get("rc") != 0 or not isinstance(doc.get("parsed"), dict):
+            return {"rev": None, "ts": None, "ok": False, "metrics": {}}
+        doc = {"headline": doc["parsed"]}
+    metrics: dict[str, Metric] = {}
+    rev = ts = None
+
+    def note_prov(section: dict) -> None:
+        nonlocal rev, ts
+        extra = section.get("extra") or {}
+        rev = rev or extra.get("rev")
+        ts = ts or extra.get("ts")
+
+    headline = doc.get("headline")
+    if isinstance(headline, dict):
+        note_prov(headline)
+        extra = headline.get("extra") or {}
+        v = _num(headline.get("value"))
+        if v is not None:
+            # context = the full metric string (model + ISL/OSL/conc):
+            # the r06 lesson — a tiny headline must never be compared
+            # against the llama-scale trajectory
+            hctx = _ctx("headline", headline.get("metric"))
+            metrics["headline.toks_per_sec_chip"] = Metric(
+                v, True, hctx, rtol=0.15
+            )
+            sp = _num(extra.get("prefix_hit_ttft_speedup"))
+            if sp is not None:
+                metrics["prefix.hit_ttft_speedup"] = Metric(
+                    sp, True, hctx, rtol=0.15, atol=0.05
+                )
+    spec = doc.get("spec")
+    if isinstance(spec, dict) and _num(spec.get("speedup")) is not None:
+        note_prov(spec)
+        metrics["spec.speedup"] = Metric(
+            _num(spec["speedup"]), True,
+            _ctx("spec", spec.get("k_max"), spec.get("osl"),
+                 spec.get("concurrency")),
+            rtol=0.25,
+        )
+    mixed = doc.get("mixed")
+    if isinstance(mixed, dict) and _num(
+        mixed.get("itl_p99_speedup")
+    ) is not None:
+        note_prov(mixed)
+        metrics["mixed.itl_p99_speedup"] = Metric(
+            _num(mixed["itl_p99_speedup"]), True,
+            _ctx("mixed", mixed.get("step_tokens"),
+                 mixed.get("held_streams"), mixed.get("wave_prompts")),
+            rtol=0.30,
+        )
+    ms = doc.get("mixed_spec")
+    if isinstance(ms, dict) and _num(ms.get("itl_p99_ratio")) is not None:
+        note_prov(ms)
+        # ratio of mixed+spec p99 over mixed-only p99: LOWER is better
+        metrics["mixed_spec.itl_p99_ratio"] = Metric(
+            _num(ms["itl_p99_ratio"]), False,
+            _ctx("mixed_spec", ms.get("step_tokens"),
+                 ms.get("held_streams")),
+            rtol=0.30, atol=0.1,
+        )
+    pab = doc.get("pipeline_ab")
+    if isinstance(pab, dict):
+        note_prov(pab)
+        sf = _num((pab.get("pipelined") or {}).get("sync_frac"))
+        if sf is not None:
+            # true-stall fraction of the step wall: lower is better,
+            # and near zero — the absolute floor carries the judgment.
+            # The A/B legs run on the HEADLINE engine, so the headline
+            # metric string (model + ISL/OSL/conc) is the scale key: a
+            # tiny-CI smoke must not gate a real-model trajectory
+            metrics["pipeline.sync_frac"] = Metric(
+                sf, False,
+                _ctx(
+                    "pipeline_ab",
+                    (headline or {}).get("metric")
+                    if isinstance(headline, dict) else None,
+                ),
+                rtol=0.5, atol=0.02,
+            )
+    goodput = doc.get("goodput")
+    if isinstance(goodput, dict):
+        note_prov(goodput)
+        slo = goodput.get("slo") or {}
+        v = _num(slo.get("goodput_toks_per_sec_chip"))
+        hctx = _ctx(
+            "goodput",
+            (headline or {}).get("metric") if isinstance(headline, dict)
+            else None,
+        )
+        if v is not None:
+            metrics["goodput.toks_per_sec_chip"] = Metric(
+                v, True, hctx, rtol=0.25
+            )
+        af = _num(slo.get("attained_frac"))
+        if af is not None:
+            metrics["goodput.attained_frac"] = Metric(
+                af, True, hctx, rtol=0.10, atol=0.05
+            )
+    pf = doc.get("prefix_fleet")
+    if isinstance(pf, dict):
+        note_prov(pf)
+        # the section's own scenario descriptor (tenants/page/prefix
+        # pages/...) IS its scale: runs only compare when the probe
+        # shape matches exactly
+        pctx = _ctx("prefix_fleet", _scenario_key(pf))
+        v = _num(pf.get("warm_vs_cold_ttft"))
+        if v is not None:
+            metrics["prefix_fleet.warm_vs_cold_ttft"] = Metric(
+                v, True, pctx, rtol=0.20, atol=0.05
+            )
+        v = _num(pf.get("route_to_holder_frac"))
+        if v is not None:
+            metrics["prefix_fleet.route_to_holder_frac"] = Metric(
+                v, True, pctx, rtol=0.20, atol=0.05
+            )
+    control = doc.get("control")
+    if isinstance(control, dict):
+        note_prov(control)
+        # scale = the chaos scenario's own shape (workers/budget/rps/
+        # fault point) — a tiny smoke never gates a bigger replay
+        cctx = _ctx("control", _scenario_key(control))
+        ttr = _num(control.get("time_to_recover_s"))
+        if ttr is not None:
+            # recovery time at tiny scale is sampler-quantized: generous
+            # relative + absolute slack
+            metrics["control.time_to_recover_s"] = Metric(
+                ttr, False, cctx, rtol=1.0, atol=3.0
+            )
+        v = _num((control.get("goodput") or {}).get("retained"))
+        if v is not None:
+            metrics["control.goodput_retained"] = Metric(
+                v, True, cctx, rtol=0.25, atol=0.1
+            )
+    scenarios = doc.get("scenarios")
+    if isinstance(scenarios, dict):
+        note_prov(scenarios)
+        scale = scenarios.get("scale") or {}
+        sctx = _ctx(
+            "scenarios", scale.get("name"), scale.get("n"),
+            scale.get("rate_rps"), scale.get("seed"),
+        )
+        for name, out in (scenarios.get("results") or {}).items():
+            if not isinstance(out, dict) or "error" in out:
+                continue
+            v = _num((out.get("goodput") or {}).get("goodput_toks_per_sec"))
+            if v is not None:
+                metrics[f"scenario.{name}.goodput"] = Metric(
+                    v, True, sctx, rtol=0.40
+                )
+    return {"rev": rev, "ts": ts, "ok": True, "metrics": metrics}
+
+
+def load_history(pattern: str) -> list[tuple[str, dict]]:
+    """[(run_name, normalized)] sorted by the numeric run suffix."""
+    def run_key(path: str):
+        m = re.search(r"r(\d+)", os.path.basename(path))
+        return (int(m.group(1)) if m else 0, path)
+
+    out = []
+    for path in sorted(glob.glob(pattern), key=run_key):
+        name = re.sub(
+            r"^BENCH_|\.json$", "", os.path.basename(path)
+        )
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, json.JSONDecodeError) as exc:
+            print(f"bench_history: skipping {path}: {exc}",
+                  file=sys.stderr)
+            continue
+        out.append((name, normalize(doc)))
+    return out
+
+
+def baseline_for(
+    key: str, metric: Metric, history: list[tuple[str, dict]]
+) -> Optional[tuple[str, Metric]]:
+    """Most recent historical value of `key` with a MATCHING context."""
+    for name, run in reversed(history):
+        prior = run["metrics"].get(key)
+        if prior is not None and prior.context == metric.context:
+            return name, prior
+    return None
+
+
+def judge(
+    fresh: dict, history: list[tuple[str, dict]], scale: float = 1.0
+) -> list[dict]:
+    """Per fresh metric: {key, verdict, ...}. verdict in
+    ok|regressed|improved|new (new = no comparable baseline)."""
+    rows = []
+    for key, m in sorted(fresh["metrics"].items()):
+        base = baseline_for(key, m, history)
+        if base is None:
+            rows.append({"key": key, "verdict": "new", "value": m.value})
+            continue
+        bname, bm = base
+        rtol, atol = m.rtol * scale, m.atol * scale
+        if m.higher_better:
+            floor = bm.value * (1 - rtol) - atol
+            regressed = m.value < floor
+            improved = m.value > bm.value * (1 + rtol) + atol
+        else:
+            ceil = bm.value * (1 + rtol) + atol
+            regressed = m.value > ceil
+            improved = m.value < bm.value * (1 - rtol) - atol
+        rows.append({
+            "key": key,
+            "verdict": (
+                "regressed" if regressed
+                else "improved" if improved else "ok"
+            ),
+            "value": m.value,
+            "baseline": bm.value,
+            "baseline_run": bname,
+            "delta_frac": (
+                round(m.value / bm.value - 1, 4) if bm.value else None
+            ),
+            "direction": "higher" if m.higher_better else "lower",
+            "rtol": rtol,
+        })
+    return rows
+
+
+def print_trend(history: list[tuple[str, dict]], fresh=None) -> None:
+    runs = list(history) + ([("fresh", fresh)] if fresh else [])
+    keys = sorted({k for _, r in runs for k in r["metrics"]})
+    if not keys:
+        print("bench_history: no comparable metrics in any run")
+        return
+    name_w = max(len(k) for k in keys) + 2
+    header = "metric".ljust(name_w) + "".join(
+        n.rjust(12) for n, _ in runs
+    )
+    print(header)
+    revs = "rev".ljust(name_w) + "".join(
+        (str(r.get("rev") or "-")[:8]).rjust(12) for _, r in runs
+    )
+    print(revs)
+    print("-" * len(header))
+    for key in keys:
+        cells = []
+        for _, run in runs:
+            m = run["metrics"].get(key)
+            cells.append(
+                f"{m.value:.4g}".rjust(12) if m is not None
+                else "-".rjust(12)
+            )
+        print(key.ljust(name_w) + "".join(cells))
+
+
+def main(argv: Optional[list] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument(
+        "--history-glob",
+        default=os.path.join(REPO_ROOT, "BENCH_r*.json"),
+        help="glob of historical run artifacts "
+             "(default: <repo>/BENCH_r*.json)",
+    )
+    ap.add_argument(
+        "--fresh",
+        help="a fresh BENCH_OUT file to gate against the trajectory; "
+             "omitted = trend-only mode (always exits 0)",
+    )
+    ap.add_argument(
+        "--tolerance-scale", type=float, default=1.0,
+        help="multiply every per-metric tolerance (loosen on noisy CI "
+             "runners)",
+    )
+    ap.add_argument("--json", action="store_true",
+                    help="emit the verdict rows as JSON on stdout")
+    args = ap.parse_args(argv)
+
+    history = load_history(args.history_glob)
+    failed_runs = [n for n, r in history if not r["ok"]]
+
+    fresh = None
+    if args.fresh:
+        with open(args.fresh) as f:
+            fresh = normalize(json.load(f))
+
+    if not args.json:
+        print_trend(history, fresh)
+        if failed_runs:
+            print(f"(runs with no parseable result: "
+                  f"{', '.join(failed_runs)})")
+
+    if fresh is None:
+        return 0
+
+    rows = judge(fresh, history, scale=args.tolerance_scale)
+    if args.json:
+        print(json.dumps({"verdicts": rows}, indent=2))
+    regressions = [r for r in rows if r["verdict"] == "regressed"]
+    if not args.json:
+        print()
+        for r in rows:
+            if r["verdict"] == "new":
+                print(f"  NEW        {r['key']} = {r['value']:.4g} "
+                      f"(no comparable baseline)")
+            else:
+                delta = (
+                    f"{r['delta_frac']:+.1%}"
+                    if r["delta_frac"] is not None else "n/a vs 0"
+                )
+                print(
+                    f"  {r['verdict'].upper():<10} {r['key']} = "
+                    f"{r['value']:.4g} vs {r['baseline']:.4g} "
+                    f"[{r['baseline_run']}] "
+                    f"({delta}, {r['direction']} is "
+                    f"better, tol ±{r['rtol']:.0%})"
+                )
+    if regressions:
+        print(
+            f"bench_history: {len(regressions)} regression(s) beyond "
+            f"tolerance", file=sys.stderr,
+        )
+        return 1
+    n_cmp = sum(1 for r in rows if r["verdict"] != "new")
+    print(
+        f"bench_history ok: {n_cmp} metric(s) within tolerance, "
+        f"{len(rows) - n_cmp} new", file=sys.stderr,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

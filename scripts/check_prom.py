@@ -161,8 +161,22 @@ async def _spawn_and_scrape() -> str:
         await engine.close()
 
 
+# families the forensics plane must render with zero-series from the
+# FIRST scrape of a live engine (declared at registration — an engine
+# that drops the declarations would pass the generic checks by simply
+# not rendering them, so --spawn pins them by name)
+REQUIRED_SPAWN_FAMILIES = (
+    "dynamo_tpu_engine_step_anomalies_total",
+    "dynamo_tpu_flight_recorder_dumps_total",
+    "dynamo_tpu_flight_recorder_suppressed_total",
+    "dynamo_tpu_profiler_captures_total",
+    "dynamo_tpu_engine_flight_digests",
+)
+
+
 def main(argv: list[str]) -> int:
-    if argv and argv[0] == "--spawn":
+    spawned = bool(argv) and argv[0] == "--spawn"
+    if spawned:
         import asyncio
 
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -173,6 +187,16 @@ def main(argv: list[str]) -> int:
     else:
         text = sys.stdin.read()
     errors = validate(text)
+    if spawned:
+        declared = {
+            line.split()[2] for line in text.splitlines()
+            if line.startswith("# TYPE ") and len(line.split()) >= 3
+        }
+        for fam in REQUIRED_SPAWN_FAMILIES:
+            if fam not in declared:
+                errors.append(
+                    f"required family {fam} missing from a live scrape"
+                )
     families = len([ln for ln in text.splitlines()
                     if ln.startswith("# TYPE ")])
     if errors:

@@ -87,11 +87,27 @@ def main() -> int:
             f.write("\n")
 
     problems = []
+    failing = []
     for name, out in section["results"].items():
-        problems.extend(check_section(name, out))
+        bad = check_section(name, out)
+        problems.extend(bad)
+        if bad:
+            failing.append(name)
     if problems:
         for p in problems:
             print(f"MALFORMED: {p}", file=sys.stderr)
+        # forensics: dump every registered flight-recorder ring (the
+        # scenario engines register theirs at init and the registry
+        # outlives them) NEXT TO the replayable traces, so the CI
+        # scenario-smoke upload carries the step digests + trace slice
+        # of the failing run, not just its arrival schedule
+        from dynamo_tpu.engine import flight_recorder
+
+        art_dir = os.environ.get("LOADGEN_TRACE_DIR") or None
+        for path in flight_recorder.dump_all(
+            "scenario:" + ",".join(sorted(failing)), directory=art_dir
+        ):
+            print(f"flight-recorder artifact: {path}", file=sys.stderr)
         return 1
     print(
         f"{len(section['results'])} scenario(s) well-formed "

@@ -206,3 +206,70 @@ async def test_chaos_hub_recv_drop_fails_pending_cleanly():
         faults.configure("hub.recv.dropx1")
         with pytest.raises(ConnectionError):
             await asyncio.wait_for(client.ping(), 10)
+
+
+# ---------------------------------------------------------------------
+# scenario: forced SLO breach -> ONE forensic flight-recorder artifact
+# (docs/observability.md "Forensics plane"): a DYN_FAULTS dispatch delay
+# blows every TTFT target; the breach storm must write exactly one
+# artifact (rate limit), carrying the breaching request's trace slice
+# and a deep step-digest window.
+
+
+async def test_chaos_slo_breach_dumps_one_forensic_artifact(tmp_path):
+    import json
+
+    from dynamo_tpu.engine import flight_recorder as flightmod
+    from dynamo_tpu.llm.http.metrics import SloTracker
+    from dynamo_tpu.utils import tracing
+
+    tracing.clear()
+    tracing.enable()
+    try:
+        engine = make_engine(decode_steps=1)
+        # swap in a recorder aimed at the test dir with a cooldown far
+        # longer than the wave — the storm must collapse to ONE dump
+        engine.flight = flightmod.FlightRecorder(
+            capacity=256, cooldown_s=600.0,
+            context_fn=engine._flight_context, directory=str(tmp_path),
+        )
+        slo = SloTracker({"default": {"ttft_s": 1e-06}})  # all breach
+        slo.on_breach = engine.flight.on_slo_breach
+        engine.subscribe_requests(slo.observe)
+        faults.configure("engine.dispatch.delay=0.02")
+        outs = await asyncio.wait_for(
+            asyncio.gather(
+                *(collect(engine, greedy_request(p, 24))
+                  for p in PROMPTS * 2)
+            ),
+            120,
+        )
+        assert all(f == "length" for _, f in outs)  # chaos, not loss
+        arts = sorted(tmp_path.glob("flight_recorder_*.json"))
+        assert len(arts) == 1, [a.name for a in arts]
+        assert engine.flight.suppressed_total >= 1  # the storm was real
+        with open(arts[0]) as f:
+            art = json.load(f)
+        assert art["trigger"] == "slo_breach"
+        rid = art["request_id"]
+        assert rid
+        # the digest window is deep enough to read the incident's past
+        assert len(art["digests"]) >= 32
+        kinds = {
+            flightmod.digest_to_dict(r)["kind"] for r in art["digests"]
+        }
+        assert {"prefill", "decode"} <= kinds
+        # the merged trace slice is the BREACHING request's story
+        evs = [e for e in art["trace"]["traceEvents"] if e["ph"] != "M"]
+        assert evs and all(
+            e["args"].get("request_id") == rid for e in evs
+        )
+        assert any(e["name"] == "request" for e in evs)
+        # engine-side gauges agree with the artifact
+        m = engine.metrics()
+        assert m["flight_dumps"] == 1
+        assert m["flight_digests"] >= 32
+        await engine.close()
+    finally:
+        tracing.disable()
+        tracing.clear()

@@ -26,6 +26,26 @@ async def _prefill_on(engine, prompt):
     engine._finish = capture
     toks, _, _ = await collect(engine, greedy_request(prompt, max_tokens=1))
     engine._finish = orig
+    # quiesce before the caller touches engine.kv directly: the step
+    # pipeline can leave a trailing overshoot dispatch in flight after
+    # the stream completes, and while its worker thread is still inside
+    # the jit call the engine's kv attribute references the DONATED
+    # (deleted) input pool — a direct read races a "deleted array"
+    import asyncio
+
+    # require the clear state to HOLD across consecutive checks: the
+    # overshoot dispatch is created (create_task) a moment before either
+    # `_inflight` is assigned or the worker thread registers in `_ops`,
+    # so a single clear read can land inside that launch window
+    stable = 0
+    for _ in range(2000):
+        if engine._inflight is None and not engine._ops:
+            stable += 1
+            if stable >= 3:
+                break
+        else:
+            stable = 0
+        await asyncio.sleep(0.005)
     return toks[0], pages["ids"], pages["computed"]
 
 
