@@ -91,6 +91,14 @@ PREFIX_FLEET = os.environ.get("BENCH_PREFIX_FLEET", "") not in ("", "0")
 # graceful lease-revoke drain). Pure control-plane: no model, runs the
 # same at any BENCH_MODEL. Emits the `control` BENCH_OUT section.
 CONTROL = os.environ.get("BENCH_CONTROL", "") not in ("", "0")
+# BENCH_FAILOVER=1: request-failover chaos scenario
+# (scripts/failover_chaos.py) — in-process hub + real workers + the
+# journaled failover plane; worker.die severs the serving data plane
+# mid-stream and every greedy SSE stream must complete byte-identical.
+# Scores recovered_frac, the replay TTFT gap, and the continuation
+# economics (recompute vs cache-reuse vs cross-worker pull). Emits the
+# `failover` BENCH_OUT section.
+FAILOVER = os.environ.get("BENCH_FAILOVER", "") not in ("", "0")
 # BENCH_SCENARIOS=1: trace-driven scenario suite (dynamo_tpu/loadgen/,
 # docs/loadgen.md) — one seeded open-loop scenario per workload the
 # engine supports (chat, rag, shared-prefix, bursty+admission,
@@ -175,6 +183,13 @@ ENV_HELP = """bench.py — serving benchmark; configuration via env vars:
                                load spike scored on SLO-attainment
                                recovery (adds the `control` BENCH_OUT
                                section; scripts/control_chaos.py)
+  BENCH_FAILOVER=1             request-failover chaos scenario: a
+                               worker.die mid-stream must resume every
+                               greedy SSE stream byte-identical —
+                               recovered_frac, replay TTFT gap,
+                               recompute-vs-reuse-vs-pull tokens (adds
+                               the `failover` BENCH_OUT section;
+                               scripts/failover_chaos.py)
   BENCH_SCENARIOS=1            trace-driven scenario suite (adds the
                                `scenarios` BENCH_OUT section): seeded
                                open-loop traces replayed per workload
@@ -1154,7 +1169,7 @@ def main() -> None:
             }
     # fleet scenarios LAST (they spawn their own hub + workers; the
     # engine above is done by now, so nothing contends)
-    if PREFIX_FLEET or CONTROL:
+    if PREFIX_FLEET or CONTROL or FAILOVER:
         import sys as _sys
 
         _sys.path.insert(
@@ -1205,6 +1220,21 @@ def main() -> None:
             f"(scale={scenarios_result['scale']['name']})",
             file=_sys.stderr,
         )
+    failover_result = None
+    if FAILOVER:
+        import failover_chaos
+
+        failover_result = failover_chaos.run()
+        print(
+            "failover: recovered_frac={} byte_identical={} gap_p50={}s "
+            "tokens={}".format(
+                failover_result["recovered_frac"],
+                failover_result["byte_identical"],
+                failover_result["replay_ttft_gap_p50_s"],
+                failover_result["tokens"],
+            ),
+            file=_sys.stderr,
+        )
     control_result = None
     if CONTROL:
         import control_chaos
@@ -1244,6 +1274,10 @@ def main() -> None:
                     # BENCH_CONTROL=1: chaos-controller recovery curve
                     # (worker death + spike vs the SLO-driven planner)
                     "control": control_result,
+                    # BENCH_FAILOVER=1: request-failover chaos proof
+                    # (worker.die mid-stream -> byte-identical resume;
+                    # recovered_frac + replay gap + token economics)
+                    "failover": failover_result,
                     # BENCH_SCENARIOS=1: the trace-driven scenario suite
                     # (dynamo_tpu/loadgen/) — {scale, results: {name:
                     # section}}, each section scored by SLO-gated

@@ -229,9 +229,22 @@ class ModelWatcher:
         # chat/completion model types: worker does its own pre/post
         return self._router_engine(entry.service_name, client)
 
-    def _router_engine(self, service: str, client) -> RouterEngine:
-        return RouterEngine(
-            client, self.router_mode, kv_router=self._kv_routers.get(service)
+    def _router_engine(self, service: str, client):
+        from dynamo_tpu.llm.http.failover import FailoverEngine
+
+        # request-level failover (docs/robustness.md "Request
+        # failover"): the journal wrapper replays a mid-stream worker
+        # death onto a healthy instance with the delivered tokens as a
+        # prompt continuation — detection feeds are the typed
+        # StreamBrokenError, this client's breaker-open trips, and
+        # lease-expiry instance-down events. DYN_FAILOVER=0 disables.
+        return FailoverEngine(
+            RouterEngine(
+                client, self.router_mode,
+                kv_router=self._kv_routers.get(service),
+            ),
+            client=client,
+            drt=self._drt,
         )
 
     def _default_pipeline(self, entry, card, client):

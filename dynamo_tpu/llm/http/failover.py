@@ -1,0 +1,811 @@
+"""Request-level failover: journaled replay across worker death.
+
+PR-6's breakers/degrade ladder and the PR-8 control loop recover the
+FLEET after a worker dies — but every stream in flight on that worker
+was simply lost: the frontend surfaced a raw transport error and the
+client re-paid full prefill elsewhere. This module makes the REQUEST
+the unit of fault tolerance (the Llumnix-style live-migration recipe,
+applied at the failure boundary instead of proactively):
+
+- the frontend keeps a bounded in-memory **journal** per live stream
+  (`JournalEntry`): the original token-level payload (prompt token ids,
+  sampling params incl. seed, stop conditions), every token id
+  delivered to the client so far, and the attempt/exclusion state;
+- on a detected worker failure — a mid-stream transport break
+  (`StreamBrokenError` from runtime/client.py), the instance's breaker
+  tripping open, or its hub lease expiring while the socket is still
+  alive — the request **replays** onto a healthy worker with the
+  already-delivered tokens appended to the prompt as a continuation.
+  Greedy streams resume byte-identical; seeded sampling resumes
+  deterministically (the engine keys sampling on (seed, absolute
+  position), not on how the tokens were fed);
+- the **dedup rule** at the journal boundary: the replay prompt is
+  built from exactly the delivered tokens, so the continuation stream
+  can neither repeat nor gap a token — the journal additionally clamps
+  any over-budget tail a replay could produce;
+- the replay routes through the normal router stack with the failed
+  instances excluded (`Context.metadata["failover_exclude"]`), so the
+  KV router's prefix-overlap preference applies: a peer already holding
+  the prefix serves the continuation warm (or pulls it via the
+  kv_export/ingest_prefix path) instead of recomputing it;
+- a per-request **retry budget** plus a process-wide **replay
+  concurrency cap** turn a mass worker death into the PR-6 typed
+  429/503 shed ladder (`PoolExhaustedError` + Retry-After) instead of
+  a replay storm.
+
+`SseRelay` is the client-side leg: SSE responses carry monotonic
+`id:` lines and a bounded per-request replay window, so a dropped
+client reconnects with `Last-Event-ID` + its `x-request-id` and
+resumes without repeats or gaps (docs/robustness.md "Request
+failover").
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import contextlib
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Any, AsyncIterator, Optional
+
+from dynamo_tpu.llm.protocols.common import (
+    FINISH_REASON_LENGTH,
+    EngineOutput,
+    PoolExhaustedError,
+)
+from dynamo_tpu.runtime.pipeline.context import Context
+from dynamo_tpu.runtime.resilience import Backoff, StreamBrokenError
+from dynamo_tpu.utils import counters, tracing
+from dynamo_tpu.utils.logging import get_logger
+
+log = get_logger("dynamo_tpu.failover")
+
+# zero-series at import (PR-7 declare convention): the failover plane's
+# counters exist on /metrics before the first worker ever dies
+for _name in (
+    "failover_replays_total",          # replay attempts launched
+    "failover_recovered_total",        # broken streams finished clean
+    "failover_giveup_total",           # retry budget exhausted
+    "failover_storm_shed_total",       # replay cap hit -> typed shed
+    "failover_journal_overflow_total", # journal full -> uncovered stream
+    "failover_recompute_tokens_total", # replay prefill tokens recomputed
+    "failover_pull_tokens_total",      # replay prefix tokens via kv pull
+    "failover_reused_tokens_total",    # replay prefix tokens cache-warm
+    "failover_sse_resumes_total",      # Last-Event-ID reconnects served
+    "failover_sse_expired_total",      # reconnects outside the window
+):
+    counters.declare(_name)
+
+
+@dataclass
+class FailoverConfig:
+    """Env-tunable failover policy (docs/observability.md env rows)."""
+
+    enabled: bool = True
+    # replays per request before the failure surfaces (the retry budget)
+    max_retries: int = 2
+    # process-wide cap on replays in flight (a replay holds its slot
+    # until the resumed stream's first frame lands — the prefill
+    # recompute burst is the storm cost); over-cap breaks shed with the
+    # typed 503 + Retry-After ladder instead of replaying
+    max_concurrent: int = 8
+    # journal bound: streams beyond this serve WITHOUT failover cover
+    max_streams: int = 4096
+    # break in-flight streams when their instance's breaker trips open
+    break_on_breaker_open: bool = True
+    # Retry-After stamped on storm sheds
+    shed_retry_after_s: float = 1.0
+
+    @classmethod
+    def from_env(cls) -> "FailoverConfig":
+        def _f(name: str, default):
+            raw = os.environ.get(name)
+            if raw is None or raw == "":
+                return default
+            try:
+                return type(default)(float(raw)) if not isinstance(
+                    default, bool
+                ) else raw not in ("0", "false")
+            except ValueError:
+                return default
+
+        return cls(
+            enabled=os.environ.get("DYN_FAILOVER", "1") not in ("0", "false"),
+            max_retries=int(_f("DYN_FAILOVER_RETRIES", 2)),
+            max_concurrent=int(_f("DYN_FAILOVER_CONCURRENCY", 8)),
+            max_streams=int(_f("DYN_FAILOVER_MAX_STREAMS", 4096)),
+            break_on_breaker_open=os.environ.get(
+                "DYN_FAILOVER_BREAKER_BREAKS", "1"
+            ) not in ("0", "false"),
+        )
+
+
+# ---------------------------------------------------------------- journal
+
+
+@dataclass
+class JournalEntry:
+    """One live stream's failover state: what was promised to the
+    client (`emitted`), and the replay bookkeeping."""
+
+    request_id: str
+    payload: dict                       # original PreprocessedRequest dict
+    emitted: list[int] = field(default_factory=list)
+    frames: int = 0                     # frames delivered (SSE event ids
+    #                                     are assigned downstream)
+    attempts: int = 0                   # replays used
+    instance: Optional[int] = None      # worker serving this attempt
+    excluded: set = field(default_factory=set)
+    broken: Optional[asyncio.Event] = None
+    break_reason: Optional[str] = None
+    last_reason: str = "transport"      # why the last replay fired
+    t_break: Optional[float] = None
+    replay_slot: bool = False           # holds a concurrency-cap slot
+    replay_prompt_len: int = 0
+    recovered_counted: bool = False     # failover_recovered_total fired
+
+    def orig_max_tokens(self) -> Optional[int]:
+        sc = self.payload.get("stop_conditions") or {}
+        return sc.get("max_tokens")
+
+    def remaining_tokens(self) -> Optional[int]:
+        mt = self.orig_max_tokens()
+        if mt is None:
+            return None
+        return max(0, int(mt) - len(self.emitted))
+
+    def accept(self, raw: dict) -> dict:
+        """Journal one delivered frame. The dedup clamp: a replayed
+        engine can never push the stream past the ORIGINAL token
+        budget, even if its own adjusted budget disagrees (belt for the
+        by-construction continuation guarantee)."""
+        ids = raw.get("token_ids") or []
+        if ids:
+            cap = self.remaining_tokens()
+            if cap is not None and len(ids) > cap:
+                raw = dict(raw)
+                raw["token_ids"] = ids[:cap]
+                for k in ("log_probs", "top_log_probs", "tokens"):
+                    if raw.get(k):
+                        raw[k] = raw[k][:cap]
+                # engine frames carry finish_reason=None mid-stream
+                # (EngineOutput.to_dict), so setdefault would be a no-op
+                # — the clamped frame must CLOSE the stream
+                if not raw.get("finish_reason"):
+                    raw["finish_reason"] = FINISH_REASON_LENGTH
+                ids = raw["token_ids"]
+            self.emitted.extend(int(t) for t in ids)
+        self.frames += 1
+        # count the recovery at the frame that COMPLETES the promise
+        # (budget exhausted or finish flagged): consumers like the
+        # Backend detokenizer aclose() the stream right at the last
+        # token, so post-loop accounting is not guaranteed to run
+        if (
+            self.attempts
+            and not self.recovered_counted
+            and (raw.get("finish_reason") or self.remaining_tokens() == 0)
+        ):
+            self.recovered_counted = True
+            counters.inc("failover_recovered_total")
+        return raw
+
+    def replay_payload(self) -> dict:
+        """The continuation request: original prompt + every delivered
+        token, with the stop budget shrunk by what was already served.
+        Sampling params (incl. seed) ride unchanged — the engine derives
+        seeded sampling keys from (seed, absolute position), so the
+        continuation draws the exact tokens the dead worker would have."""
+        d = dict(self.payload)
+        d["token_ids"] = list(self.payload["token_ids"]) + list(self.emitted)
+        sc = dict(d.get("stop_conditions") or {})
+        if sc.get("max_tokens") is not None:
+            sc["max_tokens"] = max(0, int(sc["max_tokens"]) - len(self.emitted))
+        if sc.get("min_tokens"):
+            sc["min_tokens"] = max(0, int(sc["min_tokens"]) - len(self.emitted))
+        d["stop_conditions"] = sc
+        return d
+
+
+# process-wide replay accounting (the cap is fleet-front-door-wide, not
+# per-model): slots are acquired at replay decision, released at the
+# resumed stream's first frame (or on give-up)
+_replays_inflight = 0
+
+# recent replay forensics for the failover scenario / debugging:
+# {request_id, reason, gap_s, replay_prompt_tokens, reused_tokens,
+#  pull_tokens, recompute_tokens, attempt}
+_recent: collections.deque = collections.deque(maxlen=256)
+
+
+def recent_replays() -> list[dict]:
+    return list(_recent)
+
+
+def replays_inflight() -> int:
+    return _replays_inflight
+
+
+def reset_stats() -> None:
+    """Test/scenario hook: clear the replay forensics ring."""
+    _recent.clear()
+
+
+def _acquire_slot(cap: int) -> bool:
+    global _replays_inflight
+    if _replays_inflight >= cap:
+        return False
+    _replays_inflight += 1
+    return True
+
+
+def _release_slot(entry: JournalEntry) -> None:
+    global _replays_inflight
+    if entry.replay_slot:
+        entry.replay_slot = False
+        _replays_inflight = max(0, _replays_inflight - 1)
+
+
+# ----------------------------------------------------------------- engine
+
+
+class FailoverEngine:
+    """AsyncEngine wrapper making in-flight requests survive worker
+    death. Sits between the Backend detokenizer and the router engine
+    in the frontend pipeline (llm/http/discovery.py), so the journal
+    sees token-level frames and a replay is invisible upstream — the
+    detokenizer state, SSE stream and usage accounting just continue.
+    """
+
+    def __init__(self, inner, client=None, drt=None,
+                 cfg: Optional[FailoverConfig] = None):
+        self.inner = inner
+        self.client = client
+        self.cfg = cfg or FailoverConfig.from_env()
+        self._backoff = Backoff(base=0.02, cap=0.5)
+        self._live: dict[str, JournalEntry] = {}
+        if drt is not None and self.cfg.enabled:
+            # lease expiry: the instance vanished from discovery while
+            # its socket may still be alive — an expired lease IS a
+            # failed worker (docs/robustness.md)
+            drt.on_instance_down(self._on_instance_down)
+        if (
+            client is not None
+            and self.cfg.enabled
+            and self.cfg.break_on_breaker_open
+            and hasattr(client, "add_breaker_listener")
+        ):
+            client.add_breaker_listener(
+                lambda wid: self._break_instance(wid, "breaker_open")
+            )
+
+    # ------------------------------------------------------ failure feeds
+
+    def _on_instance_down(self, endpoint_id, worker_id: int) -> None:
+        subject = getattr(
+            getattr(self.client, "endpoint_id", None), "subject", None
+        )
+        if subject is not None and getattr(
+            endpoint_id, "subject", None
+        ) != subject:
+            return
+        self._break_instance(worker_id, "lease_expired")
+
+    def _break_instance(self, worker_id: int, reason: str) -> None:
+        """Condemn every live stream bound to `worker_id`: their
+        consumers race this event against the next frame, so a wedged
+        stream on a dead-leased (or breaker-condemned) worker fails
+        over without waiting for a socket timeout."""
+        for entry in list(self._live.values()):
+            if (
+                entry.instance == worker_id
+                and entry.broken is not None
+                and not entry.broken.is_set()
+            ):
+                entry.break_reason = reason
+                entry.broken.set()
+
+    # ---------------------------------------------------------- serve path
+
+    async def generate(self, request: Context) -> AsyncIterator[dict]:
+        payload = request.payload
+        if (
+            not self.cfg.enabled
+            or not isinstance(payload, dict)
+            or not payload.get("token_ids")
+        ):
+            # non-token-level payloads (worker-side pre/post models)
+            # cannot be journal-replayed — pass through untouched
+            return await self.inner.generate(request)
+        if len(self._live) >= self.cfg.max_streams:
+            counters.inc("failover_journal_overflow_total")
+            return await self.inner.generate(request)
+        if request.id in self._live:
+            # client-chosen request ids can collide (a retry racing the
+            # original's drain); overwriting would strip the FIRST
+            # stream's break-detection cover when the second finishes
+            # and pops the shared key — the duplicate serves uncovered
+            counters.inc("failover_journal_overflow_total")
+            log.warning(
+                "duplicate live request id %s; serving without "
+                "failover cover", request.id,
+            )
+            return await self.inner.generate(request)
+        entry = JournalEntry(request_id=request.id, payload=payload)
+        return self._serve(request, entry)
+
+    async def _serve(
+        self, request: Context, entry: JournalEntry
+    ) -> AsyncIterator[dict]:
+        self._live[request.id] = entry
+        try:
+            ctx = request
+            while True:
+                entry.broken = asyncio.Event()
+                entry.break_reason = None
+                # clear the PREVIOUS attempt's instance before routing:
+                # the dead worker's breaker keeps failing (stats
+                # scrapes, other streams) after our replay launched, and
+                # a late breaker-open/lease-expiry event for it must not
+                # condemn the fresh attempt through a stale id match
+                entry.instance = None
+                try:
+                    stream = await self.inner.generate(ctx)
+                except Exception as exc:  # noqa: BLE001 — replay decision
+                    await self._pre_replay(request, entry, exc)
+                    ctx = self._replay_ctx(request, entry)
+                    continue
+                entry.instance = request.metadata.get("served_by")
+                resumed = entry.attempts > 0
+                try:
+                    async for raw in self._race(stream, entry):
+                        if resumed:
+                            self._note_resumed(request, entry, raw)
+                            resumed = False
+                        yield entry.accept(raw)
+                    # unbudgeted streams drain to exhaustion: count here
+                    self._count_recovered(entry)
+                    return
+                except Exception as exc:  # noqa: BLE001 — replay decision
+                    if request.is_killed():
+                        raise
+                    if (
+                        self._replayable(exc)
+                        and entry.remaining_tokens() == 0
+                    ):
+                        # the break landed after the final budgeted
+                        # token but before the finish frame: close the
+                        # stream as the dead engine would have — no
+                        # replay needed, nothing can repeat or gap
+                        entry.recovered_counted = True
+                        counters.inc("failover_recovered_total")
+                        yield EngineOutput.final(FINISH_REASON_LENGTH).to_dict()
+                        return
+                    await self._pre_replay(request, entry, exc)
+                    ctx = self._replay_ctx(request, entry)
+        finally:
+            if self._live.get(request.id) is entry:
+                self._live.pop(request.id, None)
+            _release_slot(entry)
+
+    def _count_recovered(self, entry: JournalEntry) -> None:
+        if entry.attempts and not entry.recovered_counted:
+            entry.recovered_counted = True
+            counters.inc("failover_recovered_total")
+
+    def live_streams(self) -> list[dict]:
+        """Journal snapshot (scenario/debug surface): which instance
+        serves each live stream and how far it has gotten."""
+        return [
+            {
+                "request_id": e.request_id,
+                "instance": e.instance,
+                "emitted": len(e.emitted),
+                "attempts": e.attempts,
+            }
+            for e in self._live.values()
+        ]
+
+    async def _race(
+        self, stream: AsyncIterator[dict], entry: JournalEntry
+    ) -> AsyncIterator[dict]:
+        """Iterate `stream`, racing each frame against the entry's
+        condemned event (lease expiry / breaker open). An abandoned
+        attempt is aclose()d, which sends the worker a kill frame via
+        the client's stream cleanup."""
+        it = stream.__aiter__()
+        broken = entry.broken
+        # ONE condemned-event waiter for the whole attempt (not one per
+        # frame — this loop is the per-token hot path)
+        brk = (
+            asyncio.ensure_future(broken.wait())
+            if broken is not None else None
+        )
+        try:
+            while True:
+                nxt = asyncio.ensure_future(it.__anext__())
+                if brk is not None and not brk.done():
+                    await asyncio.wait(
+                        {nxt, brk}, return_when=asyncio.FIRST_COMPLETED
+                    )
+                if not nxt.done() and brk is not None and brk.done():
+                    nxt.cancel()
+                    with contextlib.suppress(
+                        asyncio.CancelledError, Exception
+                    ):
+                        await nxt
+                    raise StreamBrokenError(
+                        f"stream on instance {entry.instance} condemned "
+                        f"({entry.break_reason})",
+                        instance_id=entry.instance,
+                        reason=entry.break_reason or "condemned",
+                    )
+                try:
+                    item = await nxt
+                except StopAsyncIteration:
+                    return
+                yield item
+        finally:
+            if brk is not None:
+                brk.cancel()
+                with contextlib.suppress(
+                    asyncio.CancelledError, Exception
+                ):
+                    await brk
+            with contextlib.suppress(Exception):
+                await it.aclose()
+
+    # ------------------------------------------------------ replay plumbing
+
+    def _replayable(self, exc: BaseException) -> bool:
+        return isinstance(exc, StreamBrokenError)
+
+    async def _pre_replay(
+        self, request: Context, entry: JournalEntry, exc: BaseException
+    ) -> None:
+        """Gate one replay: typed failure class, per-request retry
+        budget, the process-wide concurrency cap (over-cap = the PR-6
+        typed 503 shed), and a jittered backoff honoring any Retry-After
+        hint clamped to the request deadline. Raises `exc` (or the
+        typed shed) when the replay is not allowed."""
+        if not self._replayable(exc):
+            raise exc
+        if entry.attempts >= self.cfg.max_retries:
+            counters.inc("failover_giveup_total")
+            log.warning(
+                "failover giving up on %s after %d replays (%s)",
+                entry.request_id, entry.attempts, exc,
+            )
+            raise exc
+        if not entry.replay_slot:
+            if not _acquire_slot(self.cfg.max_concurrent):
+                counters.inc("failover_storm_shed_total")
+                raise PoolExhaustedError(
+                    f"failover replay capacity exhausted "
+                    f"({self.cfg.max_concurrent} in flight); request "
+                    f"{entry.request_id} shed instead of queueing a storm",
+                    retry_after_s=self.cfg.shed_retry_after_s,
+                ) from exc
+            entry.replay_slot = True
+        failed = getattr(exc, "instance_id", None)
+        if failed is None:
+            failed = entry.instance
+        if failed is not None:
+            entry.excluded.add(failed)
+        entry.attempts += 1
+        entry.last_reason = getattr(exc, "reason", "transport")
+        entry.t_break = time.perf_counter()
+        counters.inc("failover_replays_total")
+        log.warning(
+            "failover: replaying %s (attempt %d, %d/%s tokens served, "
+            "excluding %s): %s",
+            entry.request_id, entry.attempts, len(entry.emitted),
+            entry.orig_max_tokens(), sorted(entry.excluded), exc,
+        )
+        if tracing.enabled():
+            tracing.instant(
+                "failover.replay", cat="failover", req=entry.request_id,
+                attempt=entry.attempts,
+                reason=getattr(exc, "reason", "transport"),
+                emitted=len(entry.emitted),
+                excluded=sorted(entry.excluded),
+            )
+        delay = self._backoff.delay_hinted(
+            entry.attempts - 1,
+            retry_after_s=getattr(exc, "retry_after_s", None),
+            deadline_epoch=request.metadata.get("deadline"),
+        )
+        if delay is None:
+            # the backoff cannot fit the request deadline: shed now
+            raise exc
+        if delay > 0:
+            await asyncio.sleep(delay)
+
+    def _replay_ctx(self, request: Context, entry: JournalEntry) -> Context:
+        payload = entry.replay_payload()
+        entry.replay_prompt_len = len(payload["token_ids"])
+        md = request.metadata
+        md["failover_exclude"] = sorted(entry.excluded)
+        # stale per-route state: the KV router re-hashes the longer
+        # continuation prompt and re-stamps these for the replay route
+        for k in ("kv_pull_from", "kv_pull_tokens", "kv_seq_hashes",
+                  "kv_local_hashes", "served_by"):
+            md.pop(k, None)
+        return request.map(payload)
+
+    def _note_resumed(
+        self, request: Context, entry: JournalEntry, first_raw: dict
+    ) -> None:
+        """The replayed stream produced its first frame: release the
+        storm slot and account the resume — how long the client stalled
+        (replay TTFT gap) and how the continuation prompt was served
+        (cache-warm reuse / cross-worker pull / recompute)."""
+        _release_slot(entry)
+        gap = (
+            time.perf_counter() - entry.t_break
+            if entry.t_break is not None else None
+        )
+        meta = first_raw.get("meta") or {}
+        reused = int(meta.get("prefix_cached_tokens") or 0)
+        pull_tokens = 0
+        if request.metadata.get("kv_pull_from") is not None:
+            pull_tokens = int(request.metadata.get("kv_pull_tokens") or 0)
+        recompute = max(0, entry.replay_prompt_len - reused - pull_tokens)
+        counters.inc("failover_reused_tokens_total", max(0, reused))
+        counters.inc("failover_pull_tokens_total", pull_tokens)
+        counters.inc("failover_recompute_tokens_total", recompute)
+        record = {
+            "request_id": entry.request_id,
+            "attempt": entry.attempts,
+            "reason": entry.last_reason,
+            "gap_s": round(gap, 4) if gap is not None else None,
+            "replay_prompt_tokens": entry.replay_prompt_len,
+            "reused_tokens": reused,
+            "pull_tokens": pull_tokens,
+            "recompute_tokens": recompute,
+            "emitted_at_break": len(entry.emitted),
+        }
+        _recent.append(record)
+        if tracing.enabled():
+            tracing.instant(
+                "failover.resumed", cat="failover", req=entry.request_id,
+                **{k: v for k, v in record.items() if k != "request_id"},
+            )
+
+
+# -------------------------------------------------------------- SSE relay
+
+
+class RelayGapError(RuntimeError):
+    """A subscriber's next event id was already evicted from the
+    window — resuming would silently gap the stream."""
+
+
+class RelayTakenOverError(RuntimeError):
+    """A newer subscriber (reconnect) took over this window while the
+    old one was still attached — the stale response just ends. A real
+    client that dropped reconnects faster than the server notices the
+    dead socket; the takeover wins the race instead of 409ing it."""
+
+
+class RelayEntry:
+    """One request's bounded SSE replay window."""
+
+    def __init__(self, ctx: Context, window: int,
+                 model: str = "", endpoint: str = ""):
+        self.ctx = ctx
+        # accounting identity for resume exchanges (the original
+        # handler's guard closes "detached" when the client drops; the
+        # resume exchange records the final success/error)
+        self.model = model
+        self.endpoint = endpoint
+        # server-minted resume credential: x-request-id is CLIENT-chosen
+        # (often guessable), so a resume must also present this token —
+        # otherwise any caller could hijack-read another client's
+        # parked/live stream (it rides the X-Resume-Token response
+        # header on the original exchange)
+        self.token = os.urandom(16).hex()
+        self.window = max(1, int(window))
+        self.buf: collections.deque = collections.deque()  # (eid, bytes)
+        self.last_eid = 0
+        self.consumed = 0          # highest eid a live client has taken
+        self.done = False
+        self.ok = False
+        self.attached = False
+        self.epoch = 0  # bumped on takeover; stale subscribers exit
+        self.cond = asyncio.Condition()
+        self.expire_handle: Optional[asyncio.TimerHandle] = None
+        self.pump: Optional[asyncio.Task] = None  # held: weak loop refs
+
+    @property
+    def floor(self) -> int:
+        """Smallest `after` a resume can still serve without a gap."""
+        return self.buf[0][0] - 1 if self.buf else self.last_eid
+
+    async def append(self, frame: bytes) -> int:
+        """Assign the next monotonic event id, prefix the SSE `id:`
+        line, and buffer the frame (evicting beyond the window)."""
+        async with self.cond:
+            while (
+                self.attached
+                and len(self.buf) >= self.window
+                and self.buf[0][0] > self.consumed
+            ):
+                # backpressure: never evict a frame the live client has
+                # not taken — the pump waits like resp.write() used to
+                await self.cond.wait()
+            eid = self.last_eid + 1
+            self.last_eid = eid
+            self.buf.append((eid, b"id: %d\n" % eid + frame))
+            while len(self.buf) > self.window:
+                self.buf.popleft()
+            self.cond.notify_all()
+            return eid
+
+    async def finish(self, ok: bool) -> None:
+        async with self.cond:
+            self.done = True
+            self.ok = ok
+            self.cond.notify_all()
+
+    async def subscribe(self, after: int = 0, epoch: Optional[int] = None):
+        """Yield (eid, frame) for every event past `after`, waiting on
+        the producer; ends when the stream is done and drained. A
+        takeover (epoch bump) raises `RelayTakenOverError` so the stale
+        response ends without touching the window."""
+        if epoch is None:
+            epoch = self.epoch
+        nxt = after + 1
+        while True:
+            async with self.cond:
+                while True:
+                    if self.epoch != epoch:
+                        raise RelayTakenOverError(
+                            "a newer subscriber took over this stream"
+                        )
+                    # eids are contiguous and appended at the tail, so
+                    # everything >= nxt is a tail suffix: walk backwards
+                    # and stop — O(new frames) per wake, not O(window)
+                    # (a caught-up subscriber rescanning 1024 buffered
+                    # frames per token would dominate the SSE hot path)
+                    pending = []
+                    for item in reversed(self.buf):
+                        if item[0] < nxt:
+                            break
+                        pending.append(item)
+                    pending.reverse()
+                    if pending or self.done:
+                        break
+                    await self.cond.wait()
+                if not pending:
+                    return
+                if pending[0][0] != nxt:
+                    raise RelayGapError(
+                        f"event {nxt} already evicted (window floor "
+                        f"{self.floor})"
+                    )
+            for eid, frame in pending:
+                if self.epoch != epoch:
+                    raise RelayTakenOverError(
+                        "a newer subscriber took over this stream"
+                    )
+                yield eid, frame
+                async with self.cond:
+                    self.consumed = max(self.consumed, eid)
+                    self.cond.notify_all()
+            nxt = pending[-1][0] + 1
+
+    def _wake(self) -> None:
+        async def _notify():
+            async with self.cond:
+                self.cond.notify_all()
+
+        with contextlib.suppress(RuntimeError):
+            asyncio.get_running_loop().create_task(_notify())
+
+
+class SseRelay:
+    """Registry of per-request SSE replay windows (`Last-Event-ID`
+    reconnects). Bounded: at most `max_entries` parked/live windows;
+    over the cap new streams serve without reconnect cover."""
+
+    def __init__(
+        self,
+        grace_s: float = 30.0,
+        window_events: int = 1024,
+        max_entries: int = 256,
+    ):
+        self.grace_s = grace_s
+        self.window_events = window_events
+        self.max_entries = max_entries
+        self.entries: dict[str, RelayEntry] = {}
+
+    @classmethod
+    def from_env(cls) -> Optional["SseRelay"]:
+        """DYN_FAILOVER_RECONNECT_S > 0 arms the relay (0 = off: SSE
+        still carries event ids, but a dropped client cannot resume)."""
+        try:
+            grace = float(os.environ.get("DYN_FAILOVER_RECONNECT_S", "0") or 0)
+        except ValueError:
+            grace = 0.0
+        if grace <= 0:
+            return None
+        try:
+            window = int(
+                os.environ.get("DYN_FAILOVER_SSE_WINDOW", "1024") or 1024
+            )
+        except ValueError:
+            window = 1024
+        return cls(grace_s=grace, window_events=window)
+
+    def open(self, ctx: Context, model: str = "",
+             endpoint: str = "") -> Optional[RelayEntry]:
+        if len(self.entries) >= self.max_entries:
+            return None
+        old = self.entries.get(ctx.id)
+        if old is not None and old.expire_handle is not None:
+            # a client reusing its request id for a fresh POST while
+            # the previous exchange sits parked: the stale grace timer
+            # must not fire against the NEW entry (it pops by id)
+            old.expire_handle.cancel()
+            old.expire_handle = None
+        entry = RelayEntry(ctx, self.window_events,
+                           model=model, endpoint=endpoint)
+        entry.attached = True
+        self.entries[ctx.id] = entry
+        return entry
+
+    def get(self, request_id: str) -> Optional[RelayEntry]:
+        return self.entries.get(request_id)
+
+    def attach(self, entry: RelayEntry, after: int = 0) -> int:
+        """Claim the live-subscriber slot for a resume from event
+        `after`. A subscriber that is still formally attached (the
+        server has not yet noticed its dead socket) is TAKEN OVER: its
+        epoch-stale loop exits. `consumed` rewinds to the resume point:
+        the old subscriber may have been YIELDED frames its client
+        never persisted, and the eviction guard must protect everything
+        the resuming client still needs. Returns the new epoch for
+        subscribe()."""
+        if entry.expire_handle is not None:
+            entry.expire_handle.cancel()
+            entry.expire_handle = None
+        entry.epoch += 1
+        entry.attached = True
+        entry.consumed = min(entry.consumed, after)
+        entry._wake()
+        return entry.epoch
+
+    def detach(self, entry: RelayEntry) -> None:
+        """Client gone: free-run the window (evict oldest) and start
+        the grace clock — at expiry the request is killed (if still
+        generating) and the window dropped."""
+        entry.attached = False
+        entry._wake()
+        if entry.expire_handle is not None:
+            entry.expire_handle.cancel()
+        loop = asyncio.get_running_loop()
+        entry.expire_handle = loop.call_later(
+            self.grace_s, self._expire, entry
+        )
+
+    def discard(self, request_id: str) -> None:
+        entry = self.entries.pop(request_id, None)
+        if entry is not None and entry.expire_handle is not None:
+            entry.expire_handle.cancel()
+
+    def _expire(self, entry: RelayEntry) -> None:
+        rid = entry.ctx.id
+        if self.entries.get(rid) is not entry:
+            # the id was reused by a newer exchange after this timer
+            # armed — killing by id would hit the WRONG request
+            return
+        self.entries.pop(rid, None)
+        if not entry.done:
+            log.info(
+                "sse reconnect window expired for %s; killing request",
+                rid,
+            )
+            entry.ctx.kill()
+        entry._wake()

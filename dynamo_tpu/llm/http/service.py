@@ -26,8 +26,13 @@ from typing import Optional
 
 from aiohttp import web
 
+from dynamo_tpu.llm.http.failover import (
+    RelayGapError,
+    RelayTakenOverError,
+    SseRelay,
+)
 from dynamo_tpu.llm.http.metrics import ServiceMetrics
-from dynamo_tpu.utils import tracing
+from dynamo_tpu.utils import counters, tracing
 from dynamo_tpu.llm.protocols.common import (
     FINISH_REASON_TIMEOUT,
     DeadlineExceededError,
@@ -82,6 +87,7 @@ class HttpService:
         request_template=None,
         request_timeout_s: Optional[float] = None,
         admission=None,
+        sse_reconnect_s: Optional[float] = None,
     ):
         self.manager = manager or ModelManager()
         self.metrics = metrics or ServiceMetrics()
@@ -103,6 +109,19 @@ class HttpService:
         # resolved deadline rides Context metadata through the
         # preprocessor into the engine (docs/robustness.md "Deadlines").
         self.request_timeout_s = request_timeout_s
+        # SSE reconnect window (docs/robustness.md "Request failover"):
+        # streams always carry monotonic `id:` lines; with a relay armed
+        # (ctor arg > 0, else DYN_FAILOVER_RECONNECT_S) a dropped client
+        # re-POSTs with `Last-Event-ID` + its `x-request-id` and resumes
+        # the SAME generation from the bounded replay window — no
+        # repeated or gapped events, no re-paid prefill.
+        if sse_reconnect_s is not None:
+            self.sse_relay = (
+                SseRelay(grace_s=sse_reconnect_s)
+                if sse_reconnect_s > 0 else None
+            )
+        else:
+            self.sse_relay = SseRelay.from_env()
         self.app = web.Application()
         self.app.add_routes(
             [
@@ -295,6 +314,14 @@ class HttpService:
     async def _handle_llm(
         self, request: web.Request, kind: str, parse, rid: str
     ) -> web.StreamResponse:
+        # SSE reconnect: a dropped client re-POSTs with Last-Event-ID +
+        # the same x-request-id; the parked stream resumes from the
+        # replay window — before body parsing, admission, or any engine
+        # work (the generation this resumes is already running/parked)
+        if self.sse_relay is not None:
+            last_eid = request.headers.get("Last-Event-ID")
+            if last_eid is not None:
+                return await self._resume_sse(request, rid, last_eid)
         try:
             body = await request.json()
         except (json.JSONDecodeError, UnicodeDecodeError):
@@ -387,9 +414,16 @@ class HttpService:
             return await self._respond_full(ctx, stream, guard, kind)
         except asyncio.CancelledError:
             # client disconnected (aiohttp cancels the handler) → kill the
-            # context so remote engines stop generating for a vanished caller
-            log.info("client disconnected; killing request %s", ctx.id)
-            ctx.kill()
+            # context so remote engines stop generating for a vanished
+            # caller — UNLESS the SSE relay just parked this stream for a
+            # Last-Event-ID reconnect (the grace-expiry clock owns the
+            # kill decision then, llm/http/failover.SseRelay)
+            if ctx.metadata.get("sse_parked"):
+                log.info("request %s parked; not killing on disconnect",
+                         ctx.id)
+            else:
+                log.info("client disconnected; killing request %s", ctx.id)
+                ctx.kill()
             raise
         finally:
             guard.close()
@@ -420,48 +454,211 @@ class HttpService:
             async for x in it:
                 yield x
 
+        entry = (
+            self.sse_relay.open(
+                ctx, model=guard._model, endpoint=guard._endpoint
+            )
+            if self.sse_relay is not None else None
+        )
+        headers = {
+            "Content-Type": "text/event-stream",
+            "Cache-Control": "no-cache",
+            "Connection": "keep-alive",
+            "X-Request-Id": ctx.id,
+        }
+        if entry is not None:
+            # the resume credential: x-request-id is client-chosen (and
+            # guessable), so a Last-Event-ID reconnect must echo this
+            # server-minted token or the parked stream stays private
+            headers["X-Resume-Token"] = entry.token
+        resp = web.StreamResponse(headers=headers)
+        await resp.prepare(request)
+        if entry is None:
+            # direct path (relay off or at capacity): frames carry
+            # monotonic ids but a dropped client cannot resume — the
+            # disconnect kills the request like PR 6 shipped it
+            eid = 0
+            ok = False
+            try:
+                async for fkind, frame in self._sse_frames(ctx, _chained()):
+                    eid += 1
+                    await resp.write(b"id: %d\n" % eid + frame)
+                    if fkind == "done":
+                        ok = True
+                if ok:
+                    guard.mark_ok()
+            except (ConnectionResetError, asyncio.CancelledError):
+                # client went away → kill the context so the engine stops
+                # (reference: openai.rs:433 monitor_for_disconnects)
+                log.info("client disconnected; killing request %s", ctx.id)
+                ctx.kill()
+                raise
+            with contextlib.suppress(ConnectionResetError):
+                await resp.write_eof()
+            return resp
+
+        # relay path: the generation pump is decoupled from the socket —
+        # frames land in the bounded replay window (with backpressure
+        # while this client keeps up), and a client drop PARKS the
+        # stream for Last-Event-ID resume instead of killing it
+        entry.pump = asyncio.create_task(
+            self._relay_pump(ctx, entry, _chained())
+        )
+        try:
+            async for _eid, frame in entry.subscribe(after=0):
+                await resp.write(frame)
+            if entry.ok:
+                guard.mark_ok()
+            # the client saw the stream end: nothing left to resume
+            self.sse_relay.discard(ctx.id)
+        except RelayGapError:
+            # this live subscriber fell behind its own window (slow
+            # reader after a takeover): it cannot continue gapless
+            self.sse_relay.discard(ctx.id)
+            ctx.kill()
+        except RelayTakenOverError:
+            # a reconnect won the race against our dead-socket notice:
+            # just end this response, the window lives on — and this
+            # exchange's verdict is "detached" (the resume records the
+            # final one), not the guard's default "error"
+            guard.status = "detached"
+        except (ConnectionResetError, asyncio.CancelledError):
+            log.info(
+                "client dropped mid-stream; parking %s for reconnect "
+                "(%.0fs window)", ctx.id, self.sse_relay.grace_s,
+            )
+            self.sse_relay.detach(entry)
+            # the generation lives on, parked: _handle_llm's outer
+            # cancel handler must NOT kill it, and this exchange's
+            # accounting verdict is "detached", not "error" (a resume
+            # exchange records the final success/error)
+            ctx.metadata["sse_parked"] = True
+            guard.status = "detached"
+            raise
+        except Exception:
+            self.sse_relay.discard(ctx.id)
+            ctx.kill()
+            raise
+        with contextlib.suppress(ConnectionResetError):
+            await resp.write_eof()
+        return resp
+
+    async def _sse_frames(self, ctx, items):
+        """Encode the engine stream as SSE frames: yields
+        (kind, frame_bytes) with kind in comment/event/data/done/error.
+        Engine faults become an `error` event + kill (the 200 is
+        already on the wire); transport faults raise to the caller."""
+        try:
+            async for item in items:
+                if "__annotation__" in item:
+                    # reference: SSE `event:` lines for annotations; the
+                    # internal "ready" frame becomes an SSE comment
+                    # (spec: lines starting with ':' are ignored)
+                    name, data = item["__annotation__"], item["data"]
+                    if name == "ready":
+                        yield "comment", b": ready\n\n"
+                        continue
+                    yield (
+                        "event",
+                        f"event: {name}\ndata: {json.dumps(data)}\n\n".encode(),
+                    )
+                    continue
+                yield "data", f"data: {json.dumps(item)}\n\n".encode()
+            yield "done", b"data: [DONE]\n\n"
+        except (ConnectionResetError, asyncio.CancelledError):
+            raise
+        except Exception as exc:  # noqa: BLE001 — any mid-stream fault
+            # (engine, data-plane drop past failover, codec) becomes an
+            # SSE error event + kill rather than a truncation
+            log.error("stream error for request %s: %s", ctx.id, exc)
+            ctx.kill()
+            yield (
+                "error",
+                f'event: error\ndata: {json.dumps({"message": str(exc)})}\n\n'.encode(),
+            )
+
+    async def _relay_pump(self, ctx, entry, items) -> None:
+        """Drain the engine stream into the relay window (detached from
+        the client socket — a parked stream keeps generating until the
+        window fills or the reconnect grace expires)."""
+        ok = False
+        try:
+            async for fkind, frame in self._sse_frames(ctx, items):
+                await entry.append(frame)
+                if fkind == "done":
+                    ok = True
+        except asyncio.CancelledError:
+            raise
+        except Exception:  # noqa: BLE001 — the window just ends early
+            log.exception("sse relay pump failed for %s", ctx.id)
+        finally:
+            await entry.finish(ok)
+
+    async def _resume_sse(
+        self, request: web.Request, rid: str, last_eid: str
+    ) -> web.StreamResponse:
+        """Serve a Last-Event-ID reconnect from the parked window —
+        events strictly after the client's last id, then the live tail
+        of the same generation. No repeats, no gaps: a resume point
+        already evicted answers 410 (the client must retry in full)."""
+        try:
+            after = int(last_eid)
+        except ValueError:
+            return _error_response(
+                400, f"invalid Last-Event-ID {last_eid!r} (want an int)"
+            )
+        relay = self.sse_relay
+        entry = relay.get(rid)
+        if entry is None or after < entry.floor:
+            counters.inc("failover_sse_expired_total")
+            return _error_response(
+                410, f"reconnect window expired for request {rid}"
+            )
+        # the server-minted credential from the original exchange's
+        # X-Resume-Token header: without it, any caller presenting a
+        # guessed x-request-id could hijack-read this stream. Answered
+        # as the same 410 — an unauthorized prober learns nothing about
+        # whether the window exists.
+        if request.headers.get("X-Resume-Token") != entry.token:
+            counters.inc("failover_sse_expired_total")
+            return _error_response(
+                410, f"reconnect window expired for request {rid}"
+            )
+        epoch = relay.attach(entry, after=after)
+        counters.inc("failover_sse_resumes_total")
+        # the resume exchange carries the request's FINAL accounting
+        # verdict (the original handler's guard closed "detached" when
+        # the client dropped)
+        guard = self.metrics.inflight_guard(
+            entry.model, entry.endpoint or "completions"
+        )
         resp = web.StreamResponse(
             headers={
                 "Content-Type": "text/event-stream",
                 "Cache-Control": "no-cache",
                 "Connection": "keep-alive",
-                "X-Request-Id": ctx.id,
+                "X-Request-Id": rid,
             }
         )
         await resp.prepare(request)
         try:
-            async for item in _chained():
-                if "__annotation__" in item:
-                    # reference: SSE `event:` lines for annotations; the
-                    # internal "ready" frame becomes an SSE comment (spec:
-                    # lines starting with ':' are ignored by clients)
-                    name, data = item["__annotation__"], item["data"]
-                    if name == "ready":
-                        await resp.write(b": ready\n\n")
-                        continue
-                    await resp.write(
-                        f"event: {name}\ndata: {json.dumps(data)}\n\n".encode()
-                    )
-                    continue
-                await resp.write(f"data: {json.dumps(item)}\n\n".encode())
-            await resp.write(b"data: [DONE]\n\n")
-            guard.mark_ok()
+            async for _eid, frame in entry.subscribe(after=after, epoch=epoch):
+                await resp.write(frame)
+            if entry.ok:
+                guard.mark_ok()
+            relay.discard(rid)
+        except RelayGapError:
+            counters.inc("failover_sse_expired_total")
+            relay.discard(rid)
+            entry.ctx.kill()
+        except RelayTakenOverError:
+            guard.status = "detached"  # an even newer reconnect owns it
         except (ConnectionResetError, asyncio.CancelledError):
-            # client went away → kill the context so the engine stops
-            # (reference: openai.rs:433 monitor_for_disconnects)
-            log.info("client disconnected; killing request %s", ctx.id)
-            ctx.kill()
+            relay.detach(entry)
+            guard.status = "detached"
             raise
-        except Exception as exc:  # noqa: BLE001 — the 200 is already on the
-            # wire, so ANY mid-stream fault (engine, data-plane drop, codec)
-            # becomes an SSE error event + kill rather than an aiohttp
-            # unhandled-exception truncation
-            log.error("stream error for request %s: %s", ctx.id, exc)
-            ctx.kill()
-            with contextlib.suppress(ConnectionResetError):
-                await resp.write(
-                    f'event: error\ndata: {json.dumps({"message": str(exc)})}\n\n'.encode()
-                )
+        finally:
+            guard.close()
         with contextlib.suppress(ConnectionResetError):
             await resp.write_eof()
         return resp

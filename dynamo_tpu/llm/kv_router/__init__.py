@@ -158,6 +158,7 @@ class KvRouter:
         token_ids: list[int],
         hashes: Optional[list[int]] = None,
         allow_pull: bool = True,
+        exclude: Optional[set] = None,
     ) -> SchedulingDecision:
         """Pick the worker for these tokens (reference:
         kv_router.rs:129-141 `schedule`). Pass `hashes` when the caller
@@ -165,11 +166,19 @@ class KvRouter:
         once and also ships the chain to the worker — the prompt must
         never be hashed twice on the hot path). `allow_pull=False` for
         callers that cannot deliver the pull decision to a worker (the
-        router-as-engine path returns only worker_id/overlap)."""
+        router-as-engine path returns only worker_id/overlap).
+        `exclude` is a HARD exclusion (failover replays must never
+        route back to the instance whose death they are recovering
+        from, even while its lease is live and its cached prefix makes
+        it the overlap favorite) — unlike the soft health filter, an
+        all-excluded pool raises instead of falling back."""
         if hashes is None:
             hashes = compute_block_hashes(token_ids, self.block_size)
         overlaps = self.indexer.find_matches(hashes)
-        candidates = self._healthy_candidates(self.client.instance_ids())
+        ids = self.client.instance_ids()
+        if exclude:
+            ids = [w for w in ids if w not in exclude]
+        candidates = self._healthy_candidates(ids)
         workers = self.aggregator.endpoints_for(candidates)
         decision = await self.scheduler.schedule(
             workers, overlaps, isl_tokens=len(token_ids)
@@ -320,8 +329,14 @@ class KvPushRouter(PushRouter):
         # (and whose puller re-uses it for the export request)
         tbs = TokenBlockSequence(list(token_ids), self.router.block_size)
         seq_hashes = tbs.sequence_hashes()
+        # failover replays carry the instances that already failed this
+        # request; routing must not send the continuation back there
+        exclude = set(
+            (context.metadata.get("failover_exclude") or ())
+            if context is not None else ()
+        )
         decision = await self.router.schedule(
-            list(token_ids), hashes=seq_hashes
+            list(token_ids), hashes=seq_hashes, exclude=exclude or None
         )
         context = context or Context(payload)
         context.metadata["kv_block_size"] = self.router.block_size

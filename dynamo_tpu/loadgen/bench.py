@@ -44,7 +44,7 @@ DEFAULT_SET = (
     "chat", "rag", "shared_prefix", "bursty",
     "long_context", "moe", "vision", "structured",
 )
-FLEET_SET = ("prefix_fleet", "control_chaos")
+FLEET_SET = ("prefix_fleet", "control_chaos", "failover")
 
 
 def scale_from_env() -> Scale:

@@ -629,3 +629,56 @@ async def control_chaos_scenario(scale: Scale) -> dict:
         "kind": "fleet_adapter",
         "fleet": raw,
     }
+
+
+@scenario(
+    "failover", "request_failover",
+    "FLEET request-failover proof: hub + real workers + the journaled "
+    "replay plane; worker.die severs the serving data plane mid-stream "
+    "and every greedy SSE stream must complete byte-identical — scored "
+    "recovered_frac, replay TTFT gap, recompute-vs-reuse-vs-pull "
+    "continuation tokens (scripts/failover_chaos.py, thin adapter)",
+    fleet=True,
+)
+async def failover_scenario(scale: Scale) -> dict:
+    import json as _json
+
+    _scripts_on_path()
+    import failover_chaos
+
+    raw = await failover_chaos.run_scenario()
+    ok = failover_chaos.proof_ok(raw)
+    if scale.trace_dir:
+        # the replay journal is the forensic artifact a red CI run
+        # needs next to the flight-recorder dumps: which streams broke,
+        # where, and how their continuations were served
+        os.makedirs(scale.trace_dir, exist_ok=True)
+        with open(
+            os.path.join(scale.trace_dir, "failover_journal.json"), "w"
+        ) as f:
+            _json.dump(
+                {
+                    "proof_ok": ok,
+                    "replays": [
+                        r
+                        for leg in raw["legs"].values()
+                        for r in leg["replays"]
+                    ],
+                    "legs": raw["legs"],
+                },
+                f, indent=2,
+            )
+    out = {
+        "scenario": "failover",
+        "workload": "request_failover",
+        "kind": "fleet_adapter",
+        "fleet": raw,
+    }
+    if not ok:
+        out["error"] = (
+            "request-failover proof failed: "
+            f"byte_identical={raw['byte_identical']} "
+            f"recovered_frac={raw['recovered_frac']} "
+            f"tokens={raw['tokens']}"
+        )
+    return out

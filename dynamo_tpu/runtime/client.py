@@ -27,6 +27,7 @@ from dynamo_tpu.runtime.resilience import (
     TRANSIENT_ERRORS,
     Backoff,
     CircuitBreaker,
+    StreamBrokenError,
 )
 from dynamo_tpu.utils import counters, tracing
 from dynamo_tpu.utils.logging import get_logger
@@ -63,6 +64,9 @@ class Client:
         self._rr_index = 0
         self._breakers: dict[int, CircuitBreaker] = {}
         self._backoff = Backoff(base=0.05, cap=1.0)
+        # breaker-open listeners (failover plane): called with the
+        # worker id whose breaker just tripped closed -> open
+        self._breaker_listeners: list = []
 
     @classmethod
     async def new_dynamic(cls, drt, endpoint_id: EndpointId) -> "Client":
@@ -135,9 +139,23 @@ class Client:
         br = self._breakers.get(worker_id)
         if br is None:
             br = self._breakers[worker_id] = CircuitBreaker(
-                name=f"{self.endpoint_id.subject}/{worker_id:x}"
+                name=f"{self.endpoint_id.subject}/{worker_id:x}",
+                on_open=lambda wid=worker_id: self._notify_breaker_open(wid),
             )
         return br
+
+    def add_breaker_listener(self, fn) -> None:
+        """Register `fn(worker_id)` for closed->open breaker trips —
+        the failover plane breaks in-flight streams still bound to a
+        transport-condemned instance (docs/robustness.md)."""
+        self._breaker_listeners.append(fn)
+
+    def _notify_breaker_open(self, worker_id: int) -> None:
+        for fn in self._breaker_listeners:
+            try:
+                fn(worker_id)
+            except Exception:  # noqa: BLE001 — listener bugs stay local
+                log.exception("breaker-open listener failed for %x", worker_id)
 
     def breaker_open(self, worker_id: int) -> bool:
         """Non-mutating health read for routers: True while the breaker
@@ -205,7 +223,11 @@ class Client:
         tp = ctx.metadata.setdefault(
             "traceparent", tracing.make_traceparent(ctx.id)
         )
-        tried: set[int] = set()
+        # failover replays name the instances that already failed this
+        # request (llm/http/failover.py) — never route a replay back to
+        # the worker whose death it is recovering from, even while its
+        # lease is still live
+        tried: set[int] = set(ctx.metadata.get("failover_exclude") or ())
         attempt = 0
         while True:
             info = self._pick(mode, instance_id, exclude=tried)
@@ -225,7 +247,16 @@ class Client:
                 if mode == "direct" or attempt >= self.max_attempts:
                     raise
                 counters.inc("client_retries_total")
-                delay = self._backoff.delay(attempt - 1)
+                # a shedding peer's Retry-After hint floors the jittered
+                # delay; the request deadline caps it (None = the retry
+                # cannot finish in budget — surface the failure now)
+                delay = self._backoff.delay_hinted(
+                    attempt - 1,
+                    retry_after_s=getattr(exc, "retry_after_s", None),
+                    deadline_epoch=ctx.metadata.get("deadline"),
+                )
+                if delay is None:
+                    raise
                 log.warning(
                     "request to %s %x failed (%s); retrying elsewhere "
                     "in %.3fs", self.endpoint_id.subject, info.worker_id,
@@ -234,6 +265,10 @@ class Client:
                 await asyncio.sleep(delay)
                 continue
             br.record_success()
+            # which instance serves this stream: the failover plane keys
+            # lease-expiry/breaker break-detection AND replay exclusion
+            # off this (it also survives into trace attrs via rpc.send)
+            ctx.metadata["served_by"] = info.worker_id
             if tracing.enabled():
                 tracing.instant(
                     "rpc.send", cat="rpc", req=ctx.id,
@@ -242,13 +277,39 @@ class Client:
                 )
             break
 
+        worker_id = info.worker_id
+
         async def _stream() -> AsyncIterator[Any]:
             monitor = asyncio.create_task(_propagate_cancel(ctx, handle))
+            done = False
             try:
-                async for raw in handle:
-                    yield unpack_payload(raw)
+                try:
+                    async for raw in handle:
+                        yield unpack_payload(raw)
+                    done = True
+                except ConnectionError as exc:
+                    # mid-stream transport break: NOT retried here (the
+                    # handle is not idempotent once the worker started
+                    # generating) — surface a TYPED error carrying the
+                    # serving instance so the failover plane can journal-
+                    # replay it, and teach the breaker (a dead worker
+                    # stops being picked before its lease expires)
+                    self.breaker(worker_id).record_failure()
+                    counters.inc("client_stream_broken_total")
+                    raise StreamBrokenError(
+                        f"stream from {self.endpoint_id.subject} "
+                        f"{worker_id:x} broke mid-flight: {exc}",
+                        instance_id=worker_id,
+                    ) from exc
             finally:
                 monitor.cancel()
+                if not done:
+                    # abandoned early (failover gave up on this attempt,
+                    # or the consumer closed the generator): stop the
+                    # worker-side sequence so it does not generate for a
+                    # stream nobody is draining
+                    with contextlib.suppress(Exception):
+                        await handle.kill()
 
         return _stream()
 

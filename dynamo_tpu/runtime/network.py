@@ -28,11 +28,13 @@ accepting, signals stop on in-flight contexts, and waits for them to finish.
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import itertools
 from typing import AsyncIterator, Awaitable, Callable, Optional
 
 from dynamo_tpu.runtime.hub import codec
 from dynamo_tpu.runtime.pipeline.context import Context
+from dynamo_tpu.utils import faults
 from dynamo_tpu.utils.logging import get_logger
 
 log = get_logger("dynamo_tpu.network")
@@ -45,6 +47,7 @@ class DataPlaneServer:
     """Serves all endpoints of one worker process on a single TCP port."""
 
     def __init__(self, host: str = "0.0.0.0", advertise_host: str = "127.0.0.1"):
+        faults.load_env()  # arms the dataplane.die chaos point when set
         self._host = host
         self.advertise_host = advertise_host
         self.port: int = 0
@@ -155,6 +158,22 @@ class DataPlaneServer:
         except (asyncio.CancelledError, ConnectionError):
             pass
 
+    def _die_abruptly(self) -> None:
+        """Injected worker death (`dataplane.die` fault point): sever every
+        live connection WITHOUT end/err frames and stop accepting — on
+        the wire this is indistinguishable from the process being
+        SIGKILLed, which is exactly what the failover chaos proof needs
+        (docs/robustness.md "Request failover"). The read-loop EOF path
+        kills the in-flight contexts, like a real death would."""
+        log.warning("injected worker death: aborting %d data-plane conns",
+                    len(self._conns))
+        self._closing = True
+        if self._server:
+            self._server.close()
+        for writer in list(self._conns):
+            with contextlib.suppress(Exception):
+                writer.transport.abort()
+
     async def _serve_stream(
         self, conn_id: int, sid: int, msg: dict, outbox: asyncio.Queue
     ) -> None:
@@ -177,10 +196,20 @@ class DataPlaneServer:
             async for item in stream:
                 if ctx.is_killed():
                     break
+                # chaos: a fired `dataplane.die` kills the whole data
+                # plane mid-stream (FaultError -> abrupt abort below).
+                # Distinct from the `worker.die` point control_worker-
+                # style victims consult per REQUEST: this one counts
+                # streamed FRAMES and is process-agnostic, so arming it
+                # fleet-wide would kill every worker -- scenarios arm it
+                # one-shot (x1) or target a victim directly.
+                faults.fire("dataplane.die")
                 outbox.put_nowait({"i": sid, "k": "data", "p": item})
             outbox.put_nowait({"i": sid, "k": "end"})
         except asyncio.CancelledError:
             raise
+        except faults.FaultError:
+            self._die_abruptly()
         except Exception as exc:  # noqa: BLE001 — propagated to the caller
             log.error("stream handler error on %s", msg["ep"], exc_info=exc)
             outbox.put_nowait({"i": sid, "k": "err", "e": str(exc)})

@@ -34,6 +34,27 @@ log = get_logger("dynamo_tpu.resilience")
 TRANSIENT_ERRORS = (ConnectionError, OSError, asyncio.TimeoutError)
 
 
+class StreamBrokenError(ConnectionError):
+    """A response stream died MID-FLIGHT (transport break, injected
+    worker death, or a lease-expiry break forced by the failover plane)
+    — as opposed to a handle-establishment failure, which the client
+    retries transparently. Carries the instance that was serving so
+    failover detection and per-instance breakers key off the typed
+    error instead of string-matching transport messages
+    (docs/robustness.md "Request failover")."""
+
+    def __init__(
+        self,
+        message: str,
+        instance_id: Optional[int] = None,
+        reason: str = "transport",
+    ):
+        super().__init__(message)
+        self.instance_id = instance_id
+        # "transport" | "lease_expired" | "breaker_open" | "injected"
+        self.reason = reason
+
+
 class Backoff:
     """Capped exponential backoff with full jitter:
     delay(n) = U(0, min(cap, base * factor**n))."""
@@ -56,6 +77,31 @@ class Backoff:
             0.0, min(self.cap, self.base * self.factor ** attempt)
         )
 
+    def delay_hinted(
+        self,
+        attempt: int,
+        retry_after_s: Optional[float] = None,
+        deadline_epoch: Optional[float] = None,
+        now: Optional[float] = None,
+    ) -> Optional[float]:
+        """Jittered delay honoring a peer's Retry-After hint.
+
+        A 429/503-shedding peer already computed when capacity returns
+        (`retry_after_s` rides the typed DeadlineExceededError /
+        PoolExhaustedError) — retrying sooner just re-sheds, so the hint
+        FLOORS the jittered delay. `deadline_epoch` (absolute epoch
+        seconds, the PR-6 request deadline) CAPS it: a delay that cannot
+        finish inside the caller's budget returns None, meaning "do not
+        retry — shed now"."""
+        d = self.delay(attempt)
+        if retry_after_s is not None and retry_after_s > 0:
+            d = max(d, float(retry_after_s))
+        if deadline_epoch is not None:
+            remaining = deadline_epoch - (now if now is not None else time.time())
+            if d >= remaining:
+                return None
+        return d
+
 
 class CircuitBreaker:
     """Per-endpoint breaker: closed -> open after `threshold` consecutive
@@ -71,11 +117,16 @@ class CircuitBreaker:
         cooldown_s: float = 5.0,
         clock: Callable[[], float] = time.monotonic,
         name: str = "",
+        on_open: Optional[Callable[[], None]] = None,
     ):
         self.threshold = threshold
         self.cooldown_s = cooldown_s
         self._clock = clock
         self.name = name
+        # fired on the closed -> open transition only (not on half-open
+        # probe refailures): the failover plane uses it to break streams
+        # still flowing to an endpoint the transport has condemned
+        self.on_open = on_open
         self._failures = 0
         self._opened_at: Optional[float] = None
         self._probing = False  # a half-open probe is in flight
@@ -139,3 +190,9 @@ class CircuitBreaker:
                     "breaker.open", cat="transport", endpoint=self.name,
                     failures=self._failures,
                 )
+            if self.on_open is not None:
+                try:
+                    self.on_open()
+                except Exception:  # noqa: BLE001 — listeners must not
+                    # poison failure accounting
+                    log.exception("breaker %s on_open hook failed", self.name)

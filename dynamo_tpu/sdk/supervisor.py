@@ -306,11 +306,23 @@ class Supervisor:
             else:
                 chip_env.update(TpuAllocator.env_for([]))
             env = {CONFIG_ENV_VAR: config.to_env(), **chip_env}
+            # per-service restart policy riding the spec (chaos
+            # deployments park crashed victims with a long backoff so
+            # recovery is attributable to the planner, not the restart
+            # loop; crash-loopy services can cap their restarts)
+            restart_kw = {}
+            if svc_cfg.get("restart_backoff_s") is not None:
+                restart_kw["restart_backoff_s"] = float(
+                    svc_cfg["restart_backoff_s"]
+                )
+            if svc_cfg.get("max_restarts") is not None:
+                restart_kw["max_restarts"] = int(svc_cfg["max_restarts"])
             self.watchers[spec.name] = Watcher(
                 name=f"{spec.namespace}_{spec.name}",
                 args=_worker_args(entry_ident, spec.name),
                 env=env,
                 numprocesses=workers,
+                **restart_kw,
             )
         return self
 

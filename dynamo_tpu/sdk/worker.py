@@ -90,7 +90,19 @@ async def amain(entry_ident: str, service_name: str, worker_id: int) -> None:
     spec = find_spec(entry_cls, service_name)
     cfg = ServiceConfig.from_env().for_service(spec.name)
 
-    drt = await DistributedRuntime.from_settings()  # DYN_HUB_ADDR
+    # DYN_LEASE_TTL: how fast a hard-killed worker vanishes from
+    # discovery (chaos/failover scenarios shrink it so recovery clocks
+    # measure the CONTROLLER, not the lease horizon)
+    kw = {}
+    if os.environ.get("DYN_LEASE_TTL"):
+        try:
+            kw["lease_ttl"] = float(os.environ["DYN_LEASE_TTL"])
+        except ValueError:
+            # a typo'd knob must not crash-loop the worker under its
+            # supervisor; the default TTL is always safe
+            log.warning("ignoring malformed DYN_LEASE_TTL=%r",
+                        os.environ["DYN_LEASE_TTL"])
+    drt = await DistributedRuntime.from_settings(**kw)  # DYN_HUB_ADDR
     stop_evt = asyncio.Event()
     loop = asyncio.get_running_loop()
     for sig in (signal.SIGINT, signal.SIGTERM):
@@ -124,11 +136,20 @@ async def amain(entry_ident: str, service_name: str, worker_id: int) -> None:
             await result
 
     comp = drt.namespace(spec.namespace).component(spec.name)
+    # a service exposing `dynamo_stats_handler` rides its load/SLO
+    # gauges on the endpoint's stats replies — the KvMetricsAggregator
+    # scrapes them, which is how @service workers feed the planner's
+    # attainment fold and the router's saturation view (the reference's
+    # ForwardPassMetrics path; docs/control.md)
+    stats = getattr(instance, "dynamo_stats_handler", None)
     served = []
     for ep_name in spec.endpoints:
         ep = comp.endpoint(ep_name)
         served.append(
-            await ep.serve_engine(_BoundEngine(getattr(instance, ep_name)))
+            await ep.serve_engine(
+                _BoundEngine(getattr(instance, ep_name)),
+                stats_handler=stats,
+            )
         )
         log.info("%s[%d]: serving %s", spec.name, worker_id, ep.subject)
 

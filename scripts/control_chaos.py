@@ -33,6 +33,15 @@ it prints the JSON and exits non-zero if the loop failed to close
 registered in the loadgen scenario registry as the ``control_chaos``
 adapter (docs/loadgen.md), so ``scripts/run_scenarios.py --scenarios
 all`` runs this proof too.
+
+``--connector operator`` (or ``run_scenario(connector="operator")``)
+drives the SAME scenario through the planner's OTHER scale connector:
+the worker pool is deployed as a ``deploy/graphs/*`` spec
+(scripts/control_graph.py) reconciled by the ``GraphOperator``, and
+the planner scales by editing the spec in hub KV
+(``OperatorConnector`` — the reference's planner-patches-CRD mode).
+The recovery and revoke-before-stop drain contracts are asserted on
+the reconciled watcher exactly as on the supervisor path.
 """
 
 from __future__ import annotations
@@ -118,35 +127,77 @@ def _attain_min(planner) -> float:
     return min((v["min"] for v in att.values()), default=1.0)
 
 
-async def run_scenario(**overrides) -> dict:
+async def run_scenario(connector: str = "supervisor", **overrides) -> dict:
     p = {**_defaults(), **overrides}
     hub = HubServer()
     await hub.start("127.0.0.1", 0)
     hub_addr = f"127.0.0.1:{hub.port}"
 
-    sup = Supervisor(hub_addr=hub_addr)
-    sup.watchers[WATCHER] = Watcher(
-        name=WATCHER,
-        args=[sys.executable, WORKER_SCRIPT],
-        env={
-            "CHAOS_NS": NS,
-            "CHAOS_COMPONENT": COMPONENT,
-            "CHAOS_SERVICE_S": str(p["service_s"]),
-            "CHAOS_LANES": str(p["lanes"]),
-            "CHAOS_TTFT_S": str(p["ttft_s"]),
-            "CHAOS_VICTIM": "0",
-            # deterministic death: wid 0 exits on its N-th request
-            "DYN_FAULTS": f"worker.die.fail@{p['die_at_hit']}",
-            # keep jax (transitively imported) off any tunneled TPU
-            "JAX_PLATFORMS": os.environ.get("JAX_PLATFORMS", "cpu"),
-        },
-        numprocesses=p["workers0"],
-        # the dead victim must STAY dead for the scenario: recovery is
-        # the planner's job here, not the restart loop's
-        restart_backoff_s=120.0,
-    )
-    watcher = sup.watchers[WATCHER]
-    await sup.start()
+    worker_env = {
+        "CHAOS_NS": NS,
+        "CHAOS_COMPONENT": COMPONENT,
+        "CHAOS_SERVICE_S": str(p["service_s"]),
+        "CHAOS_LANES": str(p["lanes"]),
+        "CHAOS_TTFT_S": str(p["ttft_s"]),
+        "CHAOS_VICTIM": "0",
+        # deterministic death: wid 0 exits on its N-th request
+        "DYN_FAULTS": f"worker.die.fail@{p['die_at_hit']}",
+        # keep jax (transitively imported) off any tunneled TPU
+        "JAX_PLATFORMS": os.environ.get("JAX_PLATFORMS", "cpu"),
+    }
+    op = None
+    hub_client = None
+    if connector == "supervisor":
+        sup = Supervisor(hub_addr=hub_addr)
+        sup.watchers[WATCHER] = Watcher(
+            name=WATCHER,
+            args=[sys.executable, WORKER_SCRIPT],
+            env=dict(worker_env),
+            numprocesses=p["workers0"],
+            # the dead victim must STAY dead for the scenario: recovery
+            # is the planner's job here, not the restart loop's
+            restart_backoff_s=120.0,
+        )
+        watcher = sup.watchers[WATCHER]
+        await sup.start()
+    elif connector == "operator":
+        # the planner-patches-spec mode: deploy the SAME chaos pool as
+        # a graph spec; the GraphOperator reconciles replica edits
+        import json as _json
+
+        from dynamo_tpu.runtime.hub.client import HubClient
+        from dynamo_tpu.sdk.operator import GRAPH_PREFIX, GraphOperator
+
+        graph_entry = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "control_graph.py"
+        ) + ":ChaosDecoder"
+        # DYN_LEASE_TTL: dead victims must vanish from discovery on the
+        # recovery clock's timescale (control_worker.py pins its own)
+        op = GraphOperator(
+            hub_addr, extra_env={**worker_env, "DYN_LEASE_TTL": "1.5"}
+        )
+        await op.start()
+        hub_client = await HubClient.connect(hub_addr)
+        spec = {
+            "entry": graph_entry,
+            "services": {COMPONENT: {
+                "workers": p["workers0"],
+                "restart_backoff_s": 120.0,
+            }},
+        }
+        await hub_client.kv_put(
+            GRAPH_PREFIX + "chaos", _json.dumps(spec).encode()
+        )
+        for _ in range(200):
+            if "chaos" in op.deployments:
+                break
+            await asyncio.sleep(0.05)
+        if "chaos" not in op.deployments:
+            raise RuntimeError("operator never reconciled the chaos spec")
+        _, sup = op.deployments["chaos"]
+        watcher = sup.watchers[COMPONENT]
+    else:
+        raise ValueError(f"unknown connector {connector!r}")
 
     observer = await DistributedRuntime.from_settings(hub_addr=hub_addr)
     client = await (
@@ -175,9 +226,16 @@ async def run_scenario(**overrides) -> dict:
         # reads as phantom (decay would re-add and overshoot the budget)
         desired_decay_rounds=8,
     )
-    planner = Planner(
-        observer, SupervisorConnector(sup, {COMPONENT: WATCHER}), cfg
-    )
+    if connector == "supervisor":
+        conn = SupervisorConnector(sup, {COMPONENT: WATCHER})
+    else:
+        from dynamo_tpu.sdk.operator import OperatorConnector
+
+        conn = OperatorConnector(
+            hub_client, "chaos", {COMPONENT: COMPONENT},
+            max_replicas=p["max_budget"],
+        )
+    planner = Planner(observer, conn, cfg)
     ups0 = counters.get("planner_scale_up_total")
     downs0 = counters.get("planner_scale_down_total")
     await planner.start()
@@ -255,7 +313,12 @@ async def run_scenario(**overrides) -> dict:
     await planner.stop()
     drain_events = list(watcher.events)
     await observer.shutdown()
-    await sup.stop()
+    if op is not None:
+        await op.stop()  # tears down the reconciled supervisor
+    else:
+        await sup.stop()
+    if hub_client is not None:
+        await hub_client.close()
     await hub.stop()
 
     # ---------------------------------------------------------------- score
@@ -283,6 +346,7 @@ async def run_scenario(**overrides) -> dict:
     post = [s["attain_min"] for s in timeline[-4:]]
     return {
         "scenario": {
+            "connector": connector,
             "workers_initial": p["workers0"],
             "chip_budget": p["max_budget"],
             "base_rps": p["base_rps"],
@@ -336,8 +400,18 @@ def run(**overrides) -> dict:
     return asyncio.run(run_scenario(**overrides))
 
 
-def main() -> int:
-    out = run()
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument(
+        "--connector", default="supervisor",
+        choices=["supervisor", "operator"],
+        help="planner scale connector: direct Supervisor calls, or "
+             "spec edits reconciled by the GraphOperator",
+    )
+    args = ap.parse_args(argv)
+    out = run(connector=args.connector)
     print(json.dumps(out, indent=2))
     ok = (
         out["scaling"]["ups"] >= 1
@@ -345,12 +419,15 @@ def main() -> int:
         and out["drain"]["clean"]
     )
     if not ok:
-        print("control loop FAILED to close", file=sys.stderr)
+        print(
+            f"control loop FAILED to close ({args.connector} connector)",
+            file=sys.stderr,
+        )
         return 1
     print(
-        f"control loop closed: recovered in {out['time_to_recover_s']}s, "
-        f"goodput retained {out['goodput']['retained']}, "
-        f"drain clean", file=sys.stderr,
+        f"control loop closed ({args.connector} connector): recovered in "
+        f"{out['time_to_recover_s']}s, goodput retained "
+        f"{out['goodput']['retained']}, drain clean", file=sys.stderr,
     )
     return 0
 

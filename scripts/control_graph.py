@@ -1,0 +1,100 @@
+"""Chaos-controller graph entry: the control_worker.py behavior as a
+``@service`` class, deployable through the GraphOperator
+(``deploy/graphs/*`` spec -> Supervisor.for_graph -> sdk/worker.py).
+
+scripts/control_chaos.py uses this for its ``--connector operator``
+leg: the planner scales by editing the deployment spec in hub KV
+(``OperatorConnector``), the operator reconciles it into the live
+watcher, and the SAME drain/recovery contract proven for the
+SupervisorConnector path is asserted on the reconciled processes.
+
+Behavior (mirrors scripts/control_worker.py):
+
+- each request occupies one of ``CHAOS_LANES`` parallel lanes for
+  ``CHAOS_SERVICE_S`` seconds, so lost capacity produces real queueing
+  delay;
+- a rolling `SloTracker` judges every request against the
+  ``CHAOS_TTFT_S`` target and rides the stats replies via the sdk
+  worker's ``dynamo_stats_handler`` hook — the planner's attainment
+  input;
+- the designated victim (``CHAOS_VICTIM`` == worker id) consults the
+  ``worker.die`` fault point per request and hard-exits when it fires
+  (``DYN_FAULTS=worker.die.fail@N``);
+- the lease-revoke graceful-drain contract comes free from
+  sdk/worker.py (DYN_WATCHER_NAME is stamped by the Watcher).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+from dynamo_tpu.llm.http.metrics import SloTracker  # noqa: E402
+from dynamo_tpu.sdk import endpoint, service  # noqa: E402
+from dynamo_tpu.utils import faults  # noqa: E402
+
+NS = os.environ.get("CHAOS_NS", "chaos")
+COMPONENT = os.environ.get("CHAOS_COMPONENT", "backend")
+
+
+@service(name=COMPONENT, namespace=NS)
+class ChaosDecoder:
+    def __init__(self):
+        self.worker_id = int(self.dynamo_context.get("worker_id", 0))
+        self.victim = self.worker_id == int(
+            os.environ.get("CHAOS_VICTIM", "-1")
+        )
+        self.service_s = float(os.environ.get("CHAOS_SERVICE_S", "0.04"))
+        self.lanes_n = int(os.environ.get("CHAOS_LANES", "4"))
+        self.slo = SloTracker(
+            {"default": {
+                "ttft_s": float(os.environ.get("CHAOS_TTFT_S", "0.2"))
+            }},
+            window_s=float(os.environ.get("CHAOS_SLO_WINDOW_S", "3.0")),
+        )
+        self.lanes = asyncio.Semaphore(self.lanes_n)
+        self.state = {"waiting": 0, "active": 0, "served": 0}
+
+    @endpoint()
+    async def generate(self, request):
+        if self.victim:
+            # deterministic death: DYN_FAULTS=worker.die.fail@N (the
+            # data-plane server armed the registry via load_env)
+            try:
+                faults.fire("worker.die")
+            except faults.FaultError:
+                os._exit(1)
+        t0 = time.monotonic()
+        state, slo, lanes = self.state, self.slo, self.lanes
+        service_s, wid = self.service_s, self.worker_id
+
+        async def stream():
+            state["waiting"] += 1
+            async with lanes:
+                state["waiting"] -= 1
+                state["active"] += 1
+                try:
+                    await asyncio.sleep(service_s)
+                finally:
+                    state["active"] -= 1
+            lat = time.monotonic() - t0
+            state["served"] += 1
+            slo.observe({"tenant": "default", "ttft_s": lat})
+            yield {"ttft_s": round(lat, 5), "worker": wid}
+
+        return stream()
+
+    def dynamo_stats_handler(self) -> dict:
+        return {
+            "request_active_slots": self.state["active"],
+            "request_total_slots": self.lanes_n,
+            "num_requests_waiting": self.state["waiting"],
+            "gpu_cache_usage_perc": self.state["active"] / self.lanes_n,
+            "slo_attainment": self.slo.snapshot(),
+        }

@@ -10,6 +10,7 @@ client-disconnect scenarios) — see .github/workflows/pre-merge.yml.
 """
 
 import asyncio
+import contextlib
 import time
 
 import pytest
@@ -273,3 +274,179 @@ async def test_chaos_slo_breach_dumps_one_forensic_artifact(tmp_path):
     finally:
         tracing.disable()
         tracing.clear()
+
+
+# ---------------------------------------------------------------------
+# scenario: worker death mid-stream -> request-level journaled failover
+# (llm/http/failover.py over the REAL data plane). The `dataplane.die`
+# fault point (runtime/network.py) severs every connection of the
+# serving worker's data plane WITHOUT end/err frames — on the wire
+# indistinguishable from a SIGKILLed process — and the frontend must
+# resume the stream on the healthy worker with zero duplicated or
+# skipped tokens. The real-JaxEngine SSE variant of this proof is
+# scripts/failover_chaos.py (the `failover` BENCH_OUT section).
+
+
+def _arith_next(t: int) -> int:
+    return (t * 31 + 7) % 997
+
+
+def _arith_ref(prompt, n):
+    toks, last = [], prompt[-1]
+    for _ in range(n):
+        last = _arith_next(last)
+        toks.append(last)
+    return toks
+
+
+class _DetWorkerEngine:
+    """Deterministic continuation-safe stand-in engine served over the
+    real data plane: output depends only on the prompt tail (a greedy
+    model's contract), so serving prompt+emitted resumes the exact
+    sequence. Paced so a kill lands while frames are in flight."""
+
+    def __init__(self, pace_s: float = 0.01):
+        self.pace_s = pace_s
+
+    async def generate(self, ctx):
+        pre = ctx.payload
+
+        async def stream():
+            last = pre["token_ids"][-1]
+            for _ in range(pre["stop_conditions"]["max_tokens"]):
+                if self.pace_s:
+                    await asyncio.sleep(self.pace_s)
+                last = _arith_next(last)
+                yield {"token_ids": [last]}
+            yield {"token_ids": [], "finish_reason": "length"}
+
+        return stream()
+
+
+@contextlib.asynccontextmanager
+async def _failover_fleet(n_workers=2, pace_s=0.01, cfg=None):
+    """Hub + n real workers on the data plane + a frontend FailoverEngine
+    over the discovery client (the exact ModelWatcher wiring)."""
+    from dynamo_tpu.llm.http.discovery import RouterEngine
+    from dynamo_tpu.llm.http.failover import FailoverEngine
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+    from .helpers import hub_server
+
+    async with hub_server() as hub:
+        addr = f"127.0.0.1:{hub.port}"
+        drts = []
+        try:
+            for _ in range(n_workers):
+                drt = await DistributedRuntime.from_settings(hub_addr=addr)
+                drts.append(drt)
+                ep = drt.namespace("cf").component("be").endpoint("generate")
+                await ep.serve_engine(_DetWorkerEngine(pace_s))
+            fe = await DistributedRuntime.from_settings(hub_addr=addr)
+            drts.append(fe)
+            client = await (
+                fe.namespace("cf").component("be").endpoint("generate").client()
+            )
+            for _ in range(200):
+                if len(client.instance_ids()) >= n_workers:
+                    break
+                await asyncio.sleep(0.05)
+            assert len(client.instance_ids()) >= n_workers
+            yield FailoverEngine(
+                RouterEngine(client, "round_robin"),
+                client=client, drt=fe, cfg=cfg,
+            )
+        finally:
+            for drt in drts:
+                try:
+                    await drt.shutdown()
+                except Exception:  # noqa: BLE001 — teardown best-effort
+                    pass
+
+
+async def _collect_failover(eng, prompt, osl):
+    pre = greedy_request(prompt, max_tokens=osl)
+    pre.stop_conditions.ignore_eos = True
+    ctx = Context(pre.to_dict())
+    toks, finish = [], None
+    async for f in await eng.generate(ctx):
+        toks.extend(f.get("token_ids") or [])
+        if f.get("finish_reason"):
+            finish = f["finish_reason"]
+    return toks, finish
+
+
+async def test_chaos_worker_death_midstream_failover_byte_identical():
+    """DYN_FAULTS-style worker.die mid-stream: the greedy stream
+    completes byte-identical to the no-fault run — the journal replay
+    neither repeats nor gaps a token (ISSUE 15 acceptance)."""
+    from dynamo_tpu.llm.http import failover as fomod
+
+    fomod.reset_stats()
+    prompt, osl = [5, 17, 42, 9], 12
+    want = _arith_ref(prompt, osl)
+    async with _failover_fleet(n_workers=2) as eng:
+        # no-fault reference over the very same fleet
+        ref, finish = await asyncio.wait_for(
+            _collect_failover(eng, prompt, osl), 30
+        )
+        assert ref == want and finish == "length"
+        # arm the kill: the 5th streamed frame severs the serving
+        # worker's whole data plane (all conns aborted, no err frames)
+        faults.configure("dataplane.die.fail@5x1")
+        toks, finish = await asyncio.wait_for(
+            _collect_failover(eng, prompt, osl), 60
+        )
+    assert toks == want, "failover resume repeated or gapped a token"
+    assert finish == "length"
+    assert counters.get("failover_replays_total") == 1.0
+    assert counters.get("failover_recovered_total") == 1.0
+    rec = fomod.recent_replays()[-1]
+    assert rec["reason"] == "transport"
+    assert 0 < rec["emitted_at_break"] < osl
+    assert rec["replay_prompt_tokens"] == len(prompt) + rec["emitted_at_break"]
+    assert rec["gap_s"] is not None
+
+
+async def test_chaos_mass_worker_death_sheds_typed_not_replay_storm():
+    """Mass worker death: every worker's data plane dies under a wave of
+    live streams. The failover plane must degrade into the PR-6 typed
+    shed ladder — over-cap replays shed with PoolExhaustedError
+    (503 + Retry-After), the rest surface typed transport errors —
+    and every request RESOLVES; nothing hangs, no unbounded replays."""
+    from dynamo_tpu.llm.http.failover import FailoverConfig
+    from dynamo_tpu.llm.protocols.common import PoolExhaustedError
+
+    n_req = 6
+    cfg = FailoverConfig(
+        max_retries=1, max_concurrent=1, shed_retry_after_s=1.0
+    )
+    async with _failover_fleet(n_workers=2, pace_s=0.02, cfg=cfg) as eng:
+        # unlimited count from the 8th frame on: the first fire kills
+        # one worker's plane, the next frame on the survivor kills the
+        # other — total fleet death while all streams are mid-flight
+        faults.configure("dataplane.die.fail@8")
+
+        async def one(i):
+            try:
+                toks, fin = await _collect_failover(eng, [3 + i, 9], 10)
+                return "ok"
+            except PoolExhaustedError as exc:
+                assert exc.retry_after_s > 0  # the 503 ladder's hint
+                return "shed"
+            except (ConnectionError, RuntimeError):
+                return "error"  # typed transport surface, not a hang
+
+        outs = await asyncio.wait_for(
+            asyncio.gather(*(one(i) for i in range(n_req))), 60
+        )
+    assert len(outs) == n_req  # every stream resolved
+    assert "ok" not in outs, outs  # the whole fleet was dead
+    assert outs.count("shed") >= 1, (
+        f"no typed storm shed: {outs}, "
+        f"shed={counters.get('failover_storm_shed_total')}"
+    )
+    assert counters.get("failover_storm_shed_total") >= 1.0
+    # the retry budget bounds replays per request; the concurrency cap
+    # (proven in tests/test_failover.py) bounds them in flight
+    assert counters.get("failover_replays_total") <= float(n_req)

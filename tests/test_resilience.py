@@ -9,7 +9,11 @@ import pytest
 
 from dynamo_tpu.runtime.client import Client, NoInstancesError
 from dynamo_tpu.runtime.component import EndpointId, InstanceInfo
-from dynamo_tpu.runtime.resilience import Backoff, CircuitBreaker
+from dynamo_tpu.runtime.resilience import (
+    Backoff,
+    CircuitBreaker,
+    StreamBrokenError,
+)
 from dynamo_tpu.utils import counters
 
 
@@ -35,6 +39,36 @@ def test_backoff_jitter_spreads():
     b = Backoff(base=1.0, cap=10.0, rng=random.Random(2))
     ds = {round(b.delay(0), 6) for _ in range(16)}
     assert len(ds) > 8, "full jitter must not produce lockstep delays"
+
+
+def test_backoff_honors_retry_after_hint():
+    """A shedding peer's Retry-After FLOORS the jittered delay —
+    retrying sooner than the peer said just re-sheds."""
+    b = Backoff(base=0.01, cap=0.05, rng=random.Random(3))
+    for _ in range(16):
+        assert b.delay_hinted(0, retry_after_s=2.0) >= 2.0
+    # no hint: plain jitter
+    assert b.delay_hinted(0) <= 0.05
+
+
+def test_backoff_hint_clamped_to_deadline():
+    """The request deadline CAPS the hinted delay: a retry that cannot
+    finish in budget returns None (shed now, don't sleep past it)."""
+    import time
+
+    b = Backoff(base=0.01, cap=0.05, rng=random.Random(4))
+    now = time.time()
+    # hint says 5s, deadline in 1s -> no retry
+    assert b.delay_hinted(
+        0, retry_after_s=5.0, deadline_epoch=now + 1.0, now=now
+    ) is None
+    # hint says 0.5s, deadline in 10s -> honored
+    d = b.delay_hinted(
+        0, retry_after_s=0.5, deadline_epoch=now + 10.0, now=now
+    )
+    assert d is not None and d >= 0.5
+    # expired deadline -> never retry
+    assert b.delay_hinted(0, deadline_epoch=now - 1.0, now=now) is None
 
 
 # ------------------------------------------------------- CircuitBreaker
@@ -90,6 +124,52 @@ def test_breaker_probe_claim_expires():
     assert not br.allow()
     t[0] = 10.0
     assert br.allow(), "stale probe claim must expire"
+
+
+def test_breaker_probe_claim_expiry_race():
+    """The expiry RACE: a stale probe's late report lands after a new
+    probe claimed the expired slot. The late failure restarts the
+    cooldown (the endpoint just proved sick) but must not wedge the
+    breaker, and the LIVE probe's outcome still decides the state."""
+    t = [0.0]
+    br = CircuitBreaker(threshold=1, cooldown_s=5.0, clock=lambda: t[0])
+    br.record_failure()        # open at t=0
+    t[0] = 5.0
+    assert br.allow()          # probe A claims, then hangs
+    t[0] = 10.0
+    assert br.allow()          # claim expired: probe B takes the slot
+    # probe A's late failure report: cooldown restarts from here...
+    br.record_failure()
+    assert br.state == "open"
+    t[0] = 14.0
+    assert not br.allow(), "late failure restarted the cooldown"
+    # ...but probe B's success still closes the breaker — the race
+    # cannot strand it open forever
+    br.record_success()
+    assert br.state == "closed"
+    assert br.allow()
+    # and the mirrored race: B succeeds FIRST, A's stale failure after
+    br2 = CircuitBreaker(threshold=3, cooldown_s=5.0, clock=lambda: t[0])
+    for _ in range(3):
+        br2.record_failure()
+    t[0] += 5.0
+    assert br2.allow()
+    br2.record_success()       # live probe closes
+    br2.record_failure()       # stale report: ONE failure, not a trip
+    assert br2.state == "closed", (
+        "a single stale failure after close must not re-open"
+    )
+
+
+def test_breaker_on_open_fires_once_per_trip():
+    opened = []
+    br = CircuitBreaker(threshold=2, on_open=lambda: opened.append(1))
+    br.record_failure()
+    assert not opened
+    br.record_failure()        # closed -> open: hook fires
+    assert len(opened) == 1
+    br.record_failure()        # already open: no re-fire
+    assert len(opened) == 1
 
 
 # ------------------------------------------------- Client integration

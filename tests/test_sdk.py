@@ -134,3 +134,21 @@ async def test_scale_up_down():
             await drt.shutdown()
     finally:
         await sup.stop()
+
+
+def test_for_graph_honors_restart_policy_keys():
+    """Spec-level restart policy (chaos deployments park crashed
+    victims; crash-loopy services cap restarts) rides the service
+    config into the Watcher."""
+    entry = load_entry(ENTRY)
+    cfg = ServiceConfig({
+        "EchoBackend": {"restart_backoff_s": 120.0, "max_restarts": 1},
+    })
+    sup = Supervisor.for_graph(ENTRY, entry, config=cfg)
+    w = sup.watchers["EchoBackend"]
+    assert w.restart_backoff_s == 120.0
+    assert w.max_restarts == 1
+    # unconfigured services keep the defaults
+    front = sup.watchers["EchoFrontend"]
+    assert front.restart_backoff_s == 1.0
+    assert front.max_restarts == 5
