@@ -99,6 +99,15 @@ CONTROL = os.environ.get("BENCH_CONTROL", "") not in ("", "0")
 # economics (recompute vs cache-reuse vs cross-worker pull). Emits the
 # `failover` BENCH_OUT section.
 FAILOVER = os.environ.get("BENCH_FAILOVER", "") not in ("", "0")
+# BENCH_KV_CAPACITY=1: KV-tier capacity census (scripts/kv_capacity.py)
+# — bf16/int8/int4 page bytes measured off live pools, max resident
+# streams at a fixed byte budget (BENCH_KV_CAPACITY_MB), a saturating
+# decode wave per quantized tier, and the margin-stable greedy
+# token-match quality bound vs the f32-KV reference. Emits the
+# `kv_capacity` BENCH_OUT section; spawns its own tiny engines, so it
+# runs the same at any BENCH_MODEL.
+KV_CAPACITY = os.environ.get("BENCH_KV_CAPACITY", "") not in ("", "0")
+KV_CAPACITY_MB = float(os.environ.get("BENCH_KV_CAPACITY_MB", "64"))
 # BENCH_SCENARIOS=1: trace-driven scenario suite (dynamo_tpu/loadgen/,
 # docs/loadgen.md) — one seeded open-loop scenario per workload the
 # engine supports (chat, rag, shared-prefix, bursty+admission,
@@ -129,7 +138,11 @@ ENV_HELP = """bench.py — serving benchmark; configuration via env vars:
   BENCH_MODEL                  preset override (auto-picked from HBM)
   BENCH_ISL / BENCH_OSL        input/output sequence lengths (512 / 64)
   BENCH_DECODE_STEPS           decode steps per jit dispatch (16)
-  BENCH_QUANT / BENCH_KV_QUANT weights / KV cache quant: int8|none (int8)
+  BENCH_QUANT                  weights quant: int8|none (int8)
+  BENCH_KV_QUANT               KV cache quant: int8|int4|none (int8);
+                               int4 nibble-packs two values per pool
+                               byte — quarter of bf16's KV bytes
+                               (docs/kv_cache.md "int4 packed tier")
   BENCH_FAST=1                 headline wave + prefix probe only
   BENCH_CONCURRENCY            concurrent requests (128 big / 256 small)
   BENCH_PREFILL_GROUP          prefill group token budget
@@ -162,7 +175,8 @@ ENV_HELP = """bench.py — serving benchmark; configuration via env vars:
                                with every section's numbers keyed as
                                {headline, spec, mixed, mixed_spec,
                                pipeline_ab, prefix_ab, prefix_fleet,
-                               control, goodput} (sections not run are
+                               control, failover, kv_capacity,
+                               scenarios, goodput} (sections not run are
                                null; goodput + prefix_ab always
                                present: SLO-gated throughput, the
                                per-request prefix/offload ledgers and
@@ -190,6 +204,14 @@ ENV_HELP = """bench.py — serving benchmark; configuration via env vars:
                                recompute-vs-reuse-vs-pull tokens (adds
                                the `failover` BENCH_OUT section;
                                scripts/failover_chaos.py)
+  BENCH_KV_CAPACITY=1          KV-tier capacity census: bf16/int8/int4
+                               page bytes off live pools + max resident
+                               streams at a fixed budget, per-tier
+                               decode waves, and the margin-stable
+                               greedy token-match quality bound (adds
+                               the `kv_capacity` BENCH_OUT section;
+                               scripts/kv_capacity.py)
+  BENCH_KV_CAPACITY_MB         census byte budget in MiB (64)
   BENCH_SCENARIOS=1            trace-driven scenario suite (adds the
                                `scenarios` BENCH_OUT section): seeded
                                open-loop traces replayed per workload
@@ -1048,7 +1070,7 @@ def main() -> None:
 
         print(f"bench: {headline_note}", file=_sys.stderr)
     qtag = f" {QUANT}" if QUANT else ""
-    qtag += " int8kv" if KV_QUANT else ""
+    qtag += f" {KV_QUANT}kv" if KV_QUANT else ""
     headline = {
                 "metric": f"{cfg.name}{qtag} serving "
                 f"decode throughput (ISL={ISL} OSL={OSL} conc={concurrency})",
@@ -1169,7 +1191,7 @@ def main() -> None:
             }
     # fleet scenarios LAST (they spawn their own hub + workers; the
     # engine above is done by now, so nothing contends)
-    if PREFIX_FLEET or CONTROL or FAILOVER:
+    if PREFIX_FLEET or CONTROL or FAILOVER or KV_CAPACITY:
         import sys as _sys
 
         _sys.path.insert(
@@ -1252,6 +1274,25 @@ def main() -> None:
             ),
             file=_sys.stderr,
         )
+    kv_capacity_result = None
+    if KV_CAPACITY:
+        import kv_capacity
+
+        kv_capacity_result = kv_capacity.run(budget_mb=KV_CAPACITY_MB)
+        cap = kv_capacity_result["capacity"]
+        print(
+            "kv_capacity: streams bf16={} int8={} int4={} "
+            "(x{} vs bf16) int4_match={}".format(
+                cap["tiers"]["bf16"]["resident_streams"],
+                cap["tiers"]["int8"]["resident_streams"],
+                cap["tiers"]["int4"]["resident_streams"],
+                cap["capacity_ratio_int4_vs_bf16"],
+                kv_capacity_result["quality"]["tiers"]["int4"][
+                    "greedy_token_match"
+                ],
+            ),
+            file=_sys.stderr,
+        )
 
     print(json.dumps(headline))
     if BENCH_OUT:
@@ -1278,6 +1319,12 @@ def main() -> None:
                     # (worker.die mid-stream -> byte-identical resume;
                     # recovered_frac + replay gap + token economics)
                     "failover": failover_result,
+                    # BENCH_KV_CAPACITY=1: KV-tier capacity census —
+                    # per-tier page bytes + resident streams at a
+                    # fixed byte budget, per-tier decode waves, and
+                    # the margin-stable greedy token-match quality
+                    # bound vs the f32-KV reference
+                    "kv_capacity": kv_capacity_result,
                     # BENCH_SCENARIOS=1: the trace-driven scenario suite
                     # (dynamo_tpu/loadgen/) — {scale, results: {name:
                     # section}}, each section scored by SLO-gated

@@ -38,7 +38,17 @@ class EngineConfig:
     # quantize_kv_rows). Decode attention streams every live page each
     # step, so this halves the dominant HBM traffic of the decode phase;
     # all attention math still runs f32 after in-kernel dequantization.
+    # "int4" packs two 4-bit values per byte (ops/quant.py
+    # quantize_kv_rows_int4): pools shrink to a QUARTER of bf16, with
+    # grouped symmetric scales (kv_quant_group features per scale group).
     kv_quantization: Optional[str] = None
+    # int4 scale-group size in features per kv head; None = head_dim (one
+    # scale per token per kv head, same granularity as the int8 tier —
+    # the only grouping the pallas kernels support). Smaller power-of-two
+    # divisors of head_dim tighten the quality bound on the gather
+    # backend at the cost of more scale channels. Ignored unless
+    # kv_quantization == "int4".
+    kv_quant_group: Optional[int] = None
 
     # HBM->host KV offload tier (reference: lib/llm/src/kv reuse/manager):
     # 0 disables; else pages whose refcount hits 0 are write-through

@@ -262,11 +262,42 @@ class JaxEngine:
         # quantization touches the pool write and the cached-prefix
         # gather); only the pp stage executor keeps model-dtype KV
         self._kv_quant = config.kv_quantization
-        if self._kv_quant is not None and self._kv_quant != "int8":
+        if self._kv_quant is not None and self._kv_quant not in ("int8", "int4"):
             raise ValueError(
                 f"unknown kv_quantization {config.kv_quantization!r}; "
-                "expected 'int8'"
+                "expected 'int8' or 'int4'"
             )
+        # int4 tier: two nibbles per pool byte (ops/quant.
+        # quantize_kv_rows_int4) — a QUARTER of bf16's page bytes, with
+        # grouped scales. _kv_int4_groups = scale groups per kv head
+        # (head_dim // kv_quant_group); 0 on the int8/bf16 tiers.
+        self._kv_int4_groups = 0
+        if self._kv_quant == "int4":
+            hd_ = self.model_cfg.head_dim
+            grp = config.kv_quant_group or hd_
+            if grp <= 0 or hd_ % grp:
+                raise ValueError(
+                    f"kv_quant_group={config.kv_quant_group} must divide "
+                    f"head_dim={hd_}"
+                )
+            self._kv_int4_groups = hd_ // grp
+            if self._kv_int4_groups > 1 and self._attn_pallas:
+                # the int4 pallas kernels fold scales with a per-head
+                # repeat: only one scale group per head fits that layout.
+                # Finer groups are a gather-backend refinement.
+                if config.attn_backend == "pallas":
+                    raise ValueError(
+                        f"kv_quant_group={grp} (< head_dim) with "
+                        "attn_backend='pallas' is unsupported: the int4 "
+                        "kernels need one scale group per kv head — drop "
+                        "kv_quant_group or use attn_backend='gather'"
+                    )
+                log.warning(
+                    "kv_quantization='int4' with kv_quant_group=%d (< "
+                    "head_dim): falling back to gather attention — the "
+                    "pallas kernels need one scale group per head", grp,
+                )
+                self._attn_pallas = False
         if self._kv_quant and mc.pp > 1:
             raise ValueError("kv_quantization unsupported with pp>1 (v1)")
         if self._kv_quant and self._attn_pallas and config.page_size % 128:
@@ -410,6 +441,7 @@ class JaxEngine:
             self.model_cfg, num_slots, dtype=self._dtype,
             kv_quant=self._kv_quant, page_size=self.page_size,
             tp=config.mesh.tp, packed=self._kv_packed,
+            kv_quant_group=config.kv_quant_group,
         )
         if self._pp:
             from dynamo_tpu.parallel.pipeline import (
@@ -475,15 +507,17 @@ class JaxEngine:
         if config.host_kv_pages:
             from dynamo_tpu.engine.offload import HostKvPool
 
+            _kw = self.model_cfg.num_kv_heads * self.model_cfg.head_dim
             self.host_pool = HostKvPool(
                 config.host_kv_pages,
                 self.model_cfg.num_layers,
                 self.page_size,
-                self.model_cfg.num_kv_heads * self.model_cfg.head_dim,
+                # int4 pool rows are nibble-packed: half the byte width
+                _kw // 2 if self._kv_quant == "int4" else _kw,
                 dtype=np.int8 if self._kv_quant else self._dtype.dtype,
                 on_event=self._emit_event,
                 scale_width=(
-                    self.model_cfg.num_kv_heads if self._kv_quant else None
+                    self._kv_scale_channels() if self._kv_quant else None
                 ),
             )
 
@@ -726,9 +760,11 @@ class JaxEngine:
         # disagg KV transfer: in-place scatter of received blocks / gather
         # of computed blocks (reference: the NIXL read/write data plane,
         # patch nixl.py — here device<->host staged, see llm/disagg);
-        # wire format is layer-stacked [L, T, K*Hd] (+ [L, T, K] scales
-        # when the source engine runs an int8 KV cache)
+        # wire format is layer-stacked [L, T, K*Hd] (+ [L, T, S] scales
+        # when the source engine runs a quantized KV cache; int4 wire
+        # rows are the nibble-packed bytes, [L, T, K*Hd/2])
         kh = self.model_cfg.num_kv_heads
+        s_ch = self._kv_scale_channels()
         kv_tp = config.mesh.tp
         from dynamo_tpu.ops.quant import (
             gather_kv_scales,
@@ -795,8 +831,8 @@ class JaxEngine:
                 for l in range(len(kv.k)):
                     kpg = pack_kv_slots(nk[l].reshape(n_pg, _eng_ps, -1))
                     vpg = pack_kv_slots(nv[l].reshape(n_pg, _eng_ps, -1))
-                    kt = scales_to_page_tiles(nks[l], _eng_ps, kh, kv_tp)
-                    vt = scales_to_page_tiles(nvs[l], _eng_ps, kh, kv_tp)
+                    kt = scales_to_page_tiles(nks[l], _eng_ps, s_ch, kv_tp)
+                    vt = scales_to_page_tiles(nvs[l], _eng_ps, s_ch, kv_tp)
                     ok, ov, oks, ovs = wr(
                         kv.k[l], kv.v[l], page_table, kpg, vpg,
                         kv.ks[l], kv.vs[l], kt, vt,
@@ -813,11 +849,11 @@ class JaxEngine:
                 k=tuple(x.at[slots].set(nk[l]) for l, x in enumerate(kv.k)),
                 v=tuple(x.at[slots].set(nv[l]) for l, x in enumerate(kv.v)),
                 ks=tuple(
-                    scatter_kv_scales(x, slots, nks[l], kh, kv_tp)
+                    scatter_kv_scales(x, slots, nks[l], s_ch, kv_tp)
                     for l, x in enumerate(kv.ks)
                 ) if kv.quantized else None,
                 vs=tuple(
-                    scatter_kv_scales(x, slots, nvs[l], kh, kv_tp)
+                    scatter_kv_scales(x, slots, nvs[l], s_ch, kv_tp)
                     for l, x in enumerate(kv.vs)
                 ) if kv.quantized else None,
             )
@@ -838,10 +874,10 @@ class JaxEngine:
             if kv.quantized:
                 out = out + (
                     jnp.stack([
-                        gather_kv_scales(x, slots, kh, kv_tp) for x in kv.ks
+                        gather_kv_scales(x, slots, s_ch, kv_tp) for x in kv.ks
                     ]),
                     jnp.stack([
-                        gather_kv_scales(x, slots, kh, kv_tp) for x in kv.vs
+                        gather_kv_scales(x, slots, s_ch, kv_tp) for x in kv.vs
                     ]),
                 )
             return out
@@ -853,23 +889,48 @@ class JaxEngine:
         from dynamo_tpu.ops.quant import dequantize_kv_rows as _dq
         from dynamo_tpu.ops.quant import quantize_kv_rows as _q
 
-        self._kv_quantize_fn = jax.jit(lambda a: _q(a, kh))
-        self._kv_dequantize_fn = jax.jit(
-            lambda a, s: _dq(a, s, out_dtype=self._dtype)
-        )
+        if self._kv_quant == "int4":
+            from dynamo_tpu.ops.quant import (
+                dequantize_kv_rows_int4 as _dq4,
+                quantize_kv_rows_int4 as _q4,
+            )
+
+            _grp = self.model_cfg.head_dim // self._kv_int4_groups
+            self._kv_quantize_fn = jax.jit(lambda a: _q4(a, kh, _grp))
+            self._kv_dequantize_fn = jax.jit(
+                lambda a, s: _dq4(a, s, kh, out_dtype=self._dtype)
+            )
+        else:
+            self._kv_quantize_fn = jax.jit(lambda a: _q(a, kh))
+            self._kv_dequantize_fn = jax.jit(
+                lambda a, s: _dq(a, s, out_dtype=self._dtype)
+            )
 
     # ------------------------------------------------------------------
     # sizing
+
+    def _kv_scale_channels(self) -> int:
+        """Scale channels per token (S): K on the int8 tier, K * groups
+        on the int4 tier, K (unused) otherwise."""
+        kh = self.model_cfg.num_kv_heads
+        return kh * self._kv_int4_groups if self._kv_int4_groups else kh
 
     def _auto_num_pages(self) -> int:
         cfg, m = self.config, self.model_cfg
         tp = self.config.mesh.tp
         if self._kv_quant:
-            # int8 data pages + [SUBL, S] f32 scale tiles per pool
+            # quantized data pages (int8: 1 byte/feature; int4: packed
+            # nibbles, 1 byte per TWO features — exactly a quarter of
+            # bf16) + [SUBL, S] f32 scale tiles per pool
             from dynamo_tpu.ops.quant import kv_scale_subl
 
             data = cfg.page_size * m.num_kv_heads * m.head_dim
-            scales = kv_scale_subl(m.num_kv_heads, tp) * cfg.page_size * 4
+            if self._kv_quant == "int4":
+                data //= 2
+            scales = (
+                kv_scale_subl(self._kv_scale_channels(), tp)
+                * cfg.page_size * 4
+            )
             page_bytes = m.num_layers * 2 * (data + scales) // tp
         else:
             page_bytes = (
@@ -1103,6 +1164,7 @@ class JaxEngine:
                 interpret=self._attn_interpret, mesh=self._attn_mesh,
                 block_tables=btables, q_pos0=positions[:, 0],
                 lengths=last_idx + 1, kv_tp=self.config.mesh.tp,
+                int4_groups=self._kv_int4_groups,
             )
         elif self._sp:
             # long-context mode: ring attention over sp; on a prefix-
@@ -1119,11 +1181,13 @@ class JaxEngine:
                 ),
                 prefix_cols=sp_cached * self.page_size,
                 kv_tp=self.config.mesh.tp,
+                int4_groups=self._kv_int4_groups,
             )
         else:
             attn = llama.AttnSpec.gather(
                 slot_matrix, page_size=self.page_size,
                 kv_tp=self.config.mesh.tp,
+                int4_groups=self._kv_int4_groups,
             )
         hidden, kv = llama.forward(
             params, self.model_cfg, tokens, positions, kv, write_slots, attn,
@@ -1218,6 +1282,7 @@ class JaxEngine:
                     interpret=self._attn_interpret,
                     mesh=self._attn_mesh,
                     kv_tp=self.config.mesh.tp,
+                    int4_groups=self._kv_int4_groups,
                 )
             else:
                 page_idx = jnp.minimum(positions // s, w - 1)
@@ -1233,7 +1298,8 @@ class JaxEngine:
                     active & (positions < max_len), wslots, 0
                 ).astype(jnp.int32)
                 attn = llama.AttnSpec.gather(
-                    smat, page_size=s, kv_tp=self.config.mesh.tp
+                    smat, page_size=s, kv_tp=self.config.mesh.tp,
+                    int4_groups=self._kv_int4_groups,
                 )
             if self._pp:
                 hidden, kv = self._pp_forward(
@@ -1346,13 +1412,15 @@ class JaxEngine:
                 q_pos0=positions[:, 0],
                 lengths=jnp.where(active, draft_len + 1, 0),
                 kv_tp=self.config.mesh.tp,
+                int4_groups=self._kv_int4_groups,
             )
         else:
             smat = (
                 block_tables[:, :, None] * s + jnp.arange(s, dtype=jnp.int32)
             ).reshape(b, -1)
             attn = llama.AttnSpec.gather(
-                smat, page_size=s, kv_tp=self.config.mesh.tp
+                smat, page_size=s, kv_tp=self.config.mesh.tp,
+                int4_groups=self._kv_int4_groups,
             )
         hidden, kv = llama.forward(
             params, self.model_cfg, tokens, positions, kv,
@@ -1430,6 +1498,7 @@ class JaxEngine:
                 interpret=self._attn_interpret, mesh=self._attn_mesh,
                 block_tables=tbl[:, :w_b], q_pos0=positions[:, 0],
                 lengths=last_idx + 1, kv_tp=self.config.mesh.tp,
+                int4_groups=self._kv_int4_groups,
             )
         else:
             smat = (
@@ -1439,6 +1508,7 @@ class JaxEngine:
             attn = llama.AttnSpec.gather(
                 smat, page_size=self.page_size,
                 lengths=last_idx + 1, kv_tp=self.config.mesh.tp,
+                int4_groups=self._kv_int4_groups,
             )
         hidden, kv = llama.forward(
             params, self.model_cfg, tokens, positions, kv,
@@ -1622,9 +1692,12 @@ class JaxEngine:
         """Decode-side disagg entry: like generate(), but the prompt's KV
         (computed by a remote prefill worker) is injected instead of
         computed, and `first_token` (sampled remotely) seeds decode.
-        `ks_arr`/`vs_arr` [L, T, K] are present when the prefill worker
-        serves an int8 KV cache (wire stays int8 — half the transfer
-        bytes); injection converts to this engine's KV dtype as needed."""
+        `ks_arr`/`vs_arr` [L, T, S] are present when the prefill worker
+        serves a quantized KV cache (the wire stays the packed bytes —
+        half the transfer at int8, a quarter at int4 [L, T, K*Hd/2]);
+        injection converts a bf16/int8 mix to this engine's KV dtype as
+        needed, while cross-tier quantized mixes raise
+        KvQuantMismatchError (see _convert_wire_kv)."""
         payload = request.payload
         pre = (
             PreprocessedRequest.from_dict(payload)
@@ -1632,7 +1705,10 @@ class JaxEngine:
             else payload
         )
         m = self.model_cfg
-        want = (m.num_layers, len(pre.token_ids), m.num_kv_heads * m.head_dim)
+        kw = m.num_kv_heads * m.head_dim
+        # a quantized wire may be int4 nibble-packed: half-width rows
+        int4_wire = ks_arr is not None and k_arr.shape[-1] * 2 == kw
+        want = (m.num_layers, len(pre.token_ids), kw // 2 if int4_wire else kw)
         for name, arr in (("k", k_arr), ("v", v_arr)):
             if tuple(arr.shape) != want:
                 raise ValueError(
@@ -1641,7 +1717,8 @@ class JaxEngine:
         if (ks_arr is None) != (vs_arr is None):
             raise ValueError("remote KV scales must come as a k/v pair")
         if ks_arr is not None:
-            want_s = (m.num_layers, len(pre.token_ids), m.num_kv_heads)
+            s_ch = self._kv_scale_channels() if int4_wire else m.num_kv_heads
+            want_s = (m.num_layers, len(pre.token_ids), s_ch)
             for name, arr in (("ks", ks_arr), ("vs", vs_arr)):
                 if tuple(arr.shape) != want_s:
                     raise ValueError(
@@ -1660,8 +1737,9 @@ class JaxEngine:
         """Prefill-side disagg entry: compute the prompt's KV (+ first
         token), extract it, and keep the pages in the prefix cache for
         future hits. Returns (first_token, k, v, ks, vs) with k/v shaped
-        [L, T, Kh*Hd]; ks/vs are [L, T, Kh] scale arrays on an int8-KV
-        engine (the wire format then stays int8), else None.
+        [L, T, Kh*Hd]; ks/vs are [L, T, S] scale arrays on a quantized
+        engine (the wire stays the pool's packed bytes — int8, or
+        nibble-packed int4 rows [L, T, Kh*Hd/2]), else None.
 
         `device_arrays=True` skips the host copy and returns jax arrays
         — the send side of the device-path transfer
@@ -1719,14 +1797,17 @@ class JaxEngine:
         paged pool AND the prefix cache — the decode-side landing point
         of a device-path transfer (engine/xproc_kv.py): `k`/`v` are
         [L, T, K*Hd] arrays (jax arrays stay on device end to end;
-        `ks`/`vs` [L, T, K] dense scales from an int8-KV source).
+        `ks`/`vs` [L, T, S] dense scales from a quantized source — int8
+        rows, or nibble-packed int4 rows [L, T, K*Hd/2]).
 
         Only whole pages are ingested (the prefix cache is page-
         granular); returns the number of tokens now cached. A following
         `generate()` with this prompt rides the prefix cache, recomputes
         the remaining tail, and continues bit-identically to a local
-        serve. Mixed KV dtypes convert exactly like the host-staged wire
-        (quantize/dequantize on injection)."""
+        serve. bf16/int8 mixes convert exactly like the host-staged wire
+        (quantize/dequantize on injection); cross-tier quantized mixes
+        raise KvQuantMismatchError (_convert_wire_kv) — packed bytes are
+        quantized exactly once and never requantized pool-to-pool."""
         full_pages = len(token_ids) // self.page_size
         if full_pages == 0:
             return 0
@@ -1790,9 +1871,10 @@ class JaxEngine:
         """Extract this engine's cached KV for a prompt's longest cached
         prefix — the SOURCE side of a cross-worker prefix pull
         (docs/kv_cache.md). Returns (n_tokens, k, v, ks, vs) with k/v
-        numpy [L, T, Kh*Hd] (int8 + [L, T, Kh] scales on an int8-KV
-        engine — the wire stays int8, half the bytes), or None when no
-        full page of the prompt is cached.
+        numpy [L, T, Kh*Hd] (quantized engines keep the wire on the pool
+        bytes + [L, T, S] scales: int8 rows at half bf16's bytes, int4
+        nibble-packed rows [L, T, Kh*Hd/2] at a quarter), or None when
+        no full page of the prompt is cached.
 
         Matched pages are PINNED for the duration of the extract so the
         gather cannot race an eviction; pins drop before returning (the
@@ -1822,9 +1904,37 @@ class JaxEngine:
     def _convert_wire_kv(self, nk, nv, nks, nvs, put=lambda a: a):
         """Normalize a disagg KV payload to this engine's KV dtype — ONE
         ladder for the host-staged and device-path planes: quantize a
-        model-dtype wire entering an int8 pool, pass int8+scales through,
-        dequantize an int8 wire entering a model-dtype pool. `put` lands
-        arrays on the engine's mesh sharding first when needed."""
+        model-dtype wire entering a quantized pool, pass a MATCHING-tier
+        quantized wire (int8 or nibble-packed int4) through byte-
+        identical, dequantize an int8 wire entering a model-dtype pool.
+        Cross-tier quantized pairs (int8 wire -> int4 pool and every
+        other combination that would need a requantization hop) raise
+        KvQuantMismatchError: quantized pools carry bytes quantized
+        exactly once at KV-write time, so there is no lossless
+        conversion between tiers. `put` lands arrays on the engine's
+        mesh sharding first when needed."""
+        kw = self.model_cfg.num_kv_heads * self.model_cfg.head_dim
+        wire = None  # the payload's tier, inferred from the row width
+        if nks is not None:
+            wire = "int4" if int(np.shape(nk)[-1]) * 2 == kw else "int8"
+        if wire is not None and wire != (self._kv_quant or "int8"):
+            from dynamo_tpu.llm.protocols.common import KvQuantMismatchError
+
+            raise KvQuantMismatchError(
+                f"wire KV payload is {wire} but this engine's pool tier "
+                f"is {self._kv_quant or self.config.dtype}: cross-tier "
+                "injection would requantize already-quantized bytes — "
+                "both sides need matching kv_quantization"
+            )
+        if wire == "int4" and int(np.shape(nks)[-1]) != self._kv_scale_channels():
+            from dynamo_tpu.llm.protocols.common import KvQuantMismatchError
+
+            raise KvQuantMismatchError(
+                f"int4 wire KV carries {int(np.shape(nks)[-1])} scale "
+                f"channels but this engine's pools use "
+                f"{self._kv_scale_channels()} (kv_quant_group mismatch) "
+                "— both sides need matching kv_quantization grouping"
+            )
         nk, nv = put(jnp.asarray(nk)), put(jnp.asarray(nv))
         if self._kv_quant and nks is None:
             nk, nks = self._kv_quantize_fn(nk)
@@ -4495,11 +4605,14 @@ class JaxEngine:
         tiles across layers) — the H2D cost side of the restore gate."""
         m = self.model_cfg
         kw = m.num_kv_heads * m.head_dim
+        if self._kv_quant == "int4":
+            kw //= 2  # nibble-packed rows: one byte per two features
         per_pool = self.page_size * kw * (
             1 if self._kv_quant else self._dtype.dtype.itemsize
         )
         scales = (
-            self.page_size * m.num_kv_heads * 4 * 2 if self._kv_quant else 0
+            self.page_size * self._kv_scale_channels() * 4 * 2
+            if self._kv_quant else 0
         )
         return m.num_layers * (2 * per_pool + scales)
 

@@ -64,15 +64,34 @@ def device_transfer_kv(
         _expand_slots(dst_page_ids, dst_engine.page_size, n_tokens)
     )
 
-    if bool(src_engine._kv_quant) != bool(dst_engine._kv_quant):
-        raise ValueError(
-            "device-path KV transfer needs matching kv_quantization on "
-            "both engines (mixed pairs go through the host-staged plane, "
-            "which converts on injection)"
+    if src_engine._kv_quant != dst_engine._kv_quant:
+        # exact tier compare: bf16/int8/int4 are three distinct packed
+        # representations; a cross-tier move would be a requantization
+        # hop (quantized pools carry bytes quantized exactly once)
+        from dynamo_tpu.llm.protocols.common import KvQuantMismatchError
+
+        raise KvQuantMismatchError(
+            f"device-path KV transfer needs matching kv_quantization on "
+            f"both engines (src={src_engine._kv_quant!r}, "
+            f"dst={dst_engine._kv_quant!r}; mixed bf16/quantized pairs go "
+            f"through the host-staged plane, which converts on injection)"
+        )
+    if (
+        src_engine._kv_quant == "int4"
+        and src_engine._kv_int4_groups != dst_engine._kv_int4_groups
+    ):
+        from dynamo_tpu.llm.protocols.common import KvQuantMismatchError
+
+        raise KvQuantMismatchError(
+            f"device-path KV transfer needs matching kv_quantization "
+            f"scale grouping (src int4 groups="
+            f"{src_engine._kv_int4_groups}, dst="
+            f"{dst_engine._kv_int4_groups})"
         )
 
-    # 1. gather on the source mesh: [L, n, kw] stacked rows (+ [L, n, K]
-    # scale rows on int8-KV engines — int8 over the wire, half the bytes)
+    # 1. gather on the source mesh: [L, n, kw] stacked rows (+ [L, n, S]
+    # scale rows on quantized engines — packed bytes over the wire: half
+    # the bytes at int8, a quarter at int4)
     with src_engine._kv_lock:
         rows = src_engine._extract_fn(src_engine.kv, src_slots)
 

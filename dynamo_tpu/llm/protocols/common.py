@@ -46,6 +46,18 @@ class PoolExhaustedError(RuntimeError):
         self.retry_after_s = retry_after_s
 
 
+class KvQuantMismatchError(ValueError):
+    """Two KV planes disagree on kv_quantization (bf16 vs int8 vs int4).
+
+    Quantized KV moves pool-to-pool on the PACKED representation —
+    quantize exactly once at KV-write time, never a requantization hop —
+    so a cross-tier transfer has no lossless conversion. Raised by the
+    device-path transfer (engine/kv_transfer.py), the cross-process wire
+    (engine/xproc_kv.py) and wire-payload injection instead of silently
+    dequant/requantizing. A ValueError subclass: callers that treated
+    the old untyped mismatch as a 400-class error keep working."""
+
+
 @dataclass
 class StopConditions:
     """reference: lib/llm/src/protocols/common.rs:205."""

@@ -85,13 +85,27 @@ def run_suite(
     scale: Optional[Scale] = None,
 ) -> dict:
     """Run the selected scenarios sequentially (each in a fresh event
-    loop) and return the ``scenarios`` section dict."""
+    loop) and return the ``scenarios`` section dict.
+
+    Every scenario entry carries a ``compile`` census — the delta of the
+    process-global jit compile counters (engine/telemetry.py) across the
+    scenario. The census is the variant-explosion tripwire: a change
+    that mints a new jit variant family per shape (the failure mode a
+    quantized-KV tier can introduce if its flag leaks into trace-level
+    dynamism) shows up as a step change here long before it shows up as
+    a latency regression, and CI scenario-smoke gates on it."""
+    from dynamo_tpu.engine import telemetry
+
+    # engines install the listener at init, but the first scenario's
+    # FIRST engine would miss nothing only by luck — install up front
+    telemetry.install_compile_listener()
     names = names if names is not None else names_from_env()
     scale = scale or scale_from_env()
     results: dict[str, dict] = {}
     for name in names:
         spec = SCENARIOS[name]
         t0 = time.perf_counter()
+        c0 = telemetry.compile_stats()
         print(f"scenario {name} [{spec.workload}] ...", file=sys.stderr)
         try:
             out = asyncio.run(spec.fn(scale))
@@ -102,6 +116,13 @@ def run_suite(
                 "workload": spec.workload,
                 "error": f"{type(exc).__name__}: {exc}",
             }
+        c1 = telemetry.compile_stats()
+        out["compile"] = {
+            "events": c1["compile_events"] - c0["compile_events"],
+            "time_s": round(
+                c1["compile_time_s"] - c0["compile_time_s"], 4
+            ),
+        }
         out["scenario_wall_s"] = round(time.perf_counter() - t0, 2)
         results[name] = out
         if "error" in out:
@@ -119,7 +140,18 @@ def run_suite(
             f"scenario {name}: {line} [{out['scenario_wall_s']}s]",
             file=sys.stderr,
         )
+    total = telemetry.compile_stats()
     return {
         "scale": scale.to_dict(),
         "results": results,
+        # suite-level census: cumulative process counters plus the
+        # per-scenario deltas in one place for the bench-history diff
+        "compile_census": {
+            "per_scenario": {
+                n: (r.get("compile") or {}).get("events")
+                for n, r in results.items()
+            },
+            "total_events": total["compile_events"],
+            "total_compile_time_s": total["compile_time_s"],
+        },
     }

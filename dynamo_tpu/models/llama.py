@@ -67,7 +67,7 @@ class AttnSpec:
     def __init__(self, slot_matrix=None, block_tables=None, lengths=None,
                  write_pos=None, page_size: int = 16, interpret: bool = False,
                  mesh=None, write_tables=None, q_pos0=None, ring: bool = False,
-                 kv_tp: int = 1, prefix_cols: int = 0):
+                 kv_tp: int = 1, prefix_cols: int = 0, int4_groups: int = 0):
         self.slot_matrix = slot_matrix
         self.block_tables = block_tables
         self.lengths = lengths
@@ -91,19 +91,26 @@ class AttnSpec:
         # ring cached-prefix gather width in SLOTS (static bucket over
         # the group's cached pages; bounds the per-layer prefix gather)
         self.prefix_cols = prefix_cols
+        # int4 nibble-packed KV pools (static): 0 = off (bf16/int8 per
+        # the pools' dtypes), n > 0 = int4 with n scale groups per head
+        # (S = K*n scale channels; the pallas kernels require n == 1,
+        # i.e. per-token-per-kv-head scales — finer groups are
+        # gather-backend only, enforced at engine init)
+        self.int4_groups = int4_groups
 
     @classmethod
     def gather(cls, slot_matrix, write_tables=None, page_size: int = 16,
                interpret: bool = False, mesh=None, block_tables=None,
-               q_pos0=None, lengths=None, kv_tp: int = 1):
+               q_pos0=None, lengths=None, kv_tp: int = 1,
+               int4_groups: int = 0):
         return cls(slot_matrix=slot_matrix, write_tables=write_tables,
                    page_size=page_size, interpret=interpret, mesh=mesh,
                    block_tables=block_tables, q_pos0=q_pos0, lengths=lengths,
-                   kv_tp=kv_tp)
+                   kv_tp=kv_tp, int4_groups=int4_groups)
 
     @classmethod
     def ring(cls, slot_matrix, mesh, page_size: int = 16, q_pos0=None,
-             prefix_cols: int = 0, kv_tp: int = 1):
+             prefix_cols: int = 0, kv_tp: int = 1, int4_groups: int = 0):
         """sp-sharded long-context prefill: ring attention over the chunk.
         `q_pos0` [B] marks a cached-prefix continuation — the chunk is
         the uncached tail and the cached pool rows (gathered over the
@@ -113,11 +120,12 @@ class AttnSpec:
         scale-pool row layout is tp-blocked (ops/quant.kv_scale_subl)."""
         return cls(slot_matrix=slot_matrix, mesh=mesh, page_size=page_size,
                    ring=True, q_pos0=q_pos0, prefix_cols=prefix_cols,
-                   kv_tp=kv_tp)
+                   kv_tp=kv_tp, int4_groups=int4_groups)
 
     @classmethod
     def pallas_decode(cls, block_tables, lengths, page_size, write_pos=None,
-                      interpret=False, mesh=None, kv_tp: int = 1):
+                      interpret=False, mesh=None, kv_tp: int = 1,
+                      int4_groups: int = 0):
         return cls(
             block_tables=block_tables,
             lengths=lengths,
@@ -126,6 +134,7 @@ class AttnSpec:
             interpret=interpret,
             mesh=mesh,
             kv_tp=kv_tp,
+            int4_groups=int4_groups,
         )
 
 
@@ -134,13 +143,14 @@ jax.tree_util.register_pytree_node(
     lambda s: (
         (s.slot_matrix, s.block_tables, s.lengths, s.write_pos,
          s.write_tables, s.q_pos0),
-        (s.page_size, s.interpret, s.mesh, s.ring, s.kv_tp, s.prefix_cols),
+        (s.page_size, s.interpret, s.mesh, s.ring, s.kv_tp, s.prefix_cols,
+         s.int4_groups),
     ),
     lambda aux, children: AttnSpec(
         slot_matrix=children[0], block_tables=children[1], lengths=children[2],
         write_pos=children[3], write_tables=children[4], q_pos0=children[5],
         page_size=aux[0], interpret=aux[1], mesh=aux[2], ring=aux[3],
-        kv_tp=aux[4], prefix_cols=aux[5],
+        kv_tp=aux[4], prefix_cols=aux[5], int4_groups=aux[6],
     ),
 )
 
@@ -192,15 +202,29 @@ class KVCache(NamedTuple):
 def init_kv_cache(
     cfg: ModelConfig, num_slots: int, dtype=jnp.bfloat16,
     kv_quant: str | None = None, page_size: int = 16, tp: int = 1,
-    packed: bool = False,
+    packed: bool = False, kv_quant_group: int | None = None,
 ) -> KVCache:
     shape = (num_slots, cfg.num_kv_heads * cfg.head_dim)
     if kv_quant is not None:
-        if kv_quant != "int8":
+        if kv_quant not in ("int8", "int4"):
             raise ValueError(
-                f"unknown kv_quant {kv_quant!r}; expected 'int8'"
+                f"unknown kv_quant {kv_quant!r}; expected 'int8' or 'int4'"
             )
         from dynamo_tpu.ops.quant import init_kv_scale_pool
+
+        # scale channels: int8 = one per kv head; int4 = K * groups-per-
+        # head (kv_quant_group features share a scale, default head_dim)
+        s_ch = cfg.num_kv_heads
+        if kv_quant == "int4":
+            from dynamo_tpu.ops.quant import int4_scale_channels
+
+            s_ch = int4_scale_channels(
+                cfg.num_kv_heads, cfg.head_dim, kv_quant_group
+            )
+            if shape[1] % 2:
+                raise ValueError("int4 KV needs an even K*Hd")
+            # nibble-packed data rows are HALF the int8 width
+            shape = (num_slots, shape[1] // 2)
 
         num_pages = num_slots // page_size
         if packed:
@@ -208,7 +232,7 @@ def init_kv_cache(
             # f32-class DMA tiling for the pallas kernels, which bitcast
             # back to int8 in VMEM. Serving-path (pallas) engines only.
             if num_slots % 4:
-                raise ValueError("packed int8 KV needs num_slots % 4 == 0")
+                raise ValueError("packed quantized KV needs num_slots % 4 == 0")
             pshape = (num_slots // 4, shape[1])
             return KVCache(
                 k=tuple(
@@ -218,11 +242,11 @@ def init_kv_cache(
                     jnp.zeros(pshape, jnp.int32) for _ in range(cfg.num_layers)
                 ),
                 ks=tuple(
-                    init_kv_scale_pool(num_pages, page_size, cfg.num_kv_heads, tp)
+                    init_kv_scale_pool(num_pages, page_size, s_ch, tp)
                     for _ in range(cfg.num_layers)
                 ),
                 vs=tuple(
-                    init_kv_scale_pool(num_pages, page_size, cfg.num_kv_heads, tp)
+                    init_kv_scale_pool(num_pages, page_size, s_ch, tp)
                     for _ in range(cfg.num_layers)
                 ),
             )
@@ -230,11 +254,11 @@ def init_kv_cache(
             k=tuple(jnp.zeros(shape, jnp.int8) for _ in range(cfg.num_layers)),
             v=tuple(jnp.zeros(shape, jnp.int8) for _ in range(cfg.num_layers)),
             ks=tuple(
-                init_kv_scale_pool(num_pages, page_size, cfg.num_kv_heads, tp)
+                init_kv_scale_pool(num_pages, page_size, s_ch, tp)
                 for _ in range(cfg.num_layers)
             ),
             vs=tuple(
-                init_kv_scale_pool(num_pages, page_size, cfg.num_kv_heads, tp)
+                init_kv_scale_pool(num_pages, page_size, s_ch, tp)
                 for _ in range(cfg.num_layers)
             ),
         )
@@ -268,28 +292,41 @@ def _attn_block(
         h //= tpn
         kh //= tpn
     quant = kv_ks is not None
+    # int4 tier: nibble-packed half-width pools with s_ch = K * groups
+    # scale channels; quantize-once rows at KV-write time, same as int8
+    int4 = quant and attn.int4_groups > 0
+    s_ch = kh * attn.int4_groups if int4 else kh
+
+    def _quant_rows(rows):
+        """Quantize fresh KV rows for the pool's tier (int8 or int4)."""
+        if int4:
+            from dynamo_tpu.ops.quant import quantize_kv_rows_int4
+
+            return quantize_kv_rows_int4(rows, kh, hd // attn.int4_groups)
+        return quantize_kv_rows(rows, kh)
 
     def _write_rows(kv_k, kv_v, kv_ks, kv_vs, kr, vr):
         """Row-scatter this chunk's KV into the pools (ring and gather
-        modes); int8 pools quantize the rows and scatter the scales in
-        the tp-blocked pool layout."""
+        modes); quantized pools quantize the rows and scatter the scales
+        in the tp-blocked pool layout."""
         if kv_k.dtype == jnp.int32:
-            # int32-PACKED int8 pools (ops/quant.pack_kv_slots) carry 4
-            # quantized bytes per element: a row scatter of unpacked
-            # values here would silently corrupt whole pages. Packed
-            # pools are written only by the pallas page-scatter kernels.
+            # int32-PACKED quantized pools (ops/quant.pack_kv_slots)
+            # carry 4 quantized bytes per element: a row scatter of
+            # unpacked values here would silently corrupt whole pages.
+            # Packed pools are written only by the pallas page-scatter
+            # kernels.
             raise ValueError(
                 "row-scatter KV write reached an int32-packed pool; "
-                "packed pools (pallas+int8 serving) must go through the "
-                "paged write kernel, not the gather/ring path"
+                "packed pools (pallas quantized serving) must go through "
+                "the paged write kernel, not the gather/ring path"
             )
         if quant:
             from dynamo_tpu.ops.quant import scatter_kv_scales
 
-            kr, krs = quantize_kv_rows(kr, kh)
-            vr, vrs = quantize_kv_rows(vr, kh)
-            kv_ks = scatter_kv_scales(kv_ks, write_slots, krs, kh, attn.kv_tp)
-            kv_vs = scatter_kv_scales(kv_vs, write_slots, vrs, kh, attn.kv_tp)
+            kr, krs = _quant_rows(kr)
+            vr, vrs = _quant_rows(vr)
+            kv_ks = scatter_kv_scales(kv_ks, write_slots, krs, s_ch, attn.kv_tp)
+            kv_vs = scatter_kv_scales(kv_vs, write_slots, vrs, s_ch, attn.kv_tp)
         kv_k, kv_v = write_kv_slots(kv_k, kv_v, write_slots, kr, vr)
         return kv_k, kv_v, kv_ks, kv_vs
 
@@ -314,20 +351,23 @@ def _attn_block(
             fused_paged_decode_attention,
             page_size=attn.page_size,
             interpret=attn.interpret,
+            int4=int4,
         )
         new_k = k[:, 0].reshape(b, kh * hd)
         new_v = v[:, 0].reshape(b, kh * hd)
         if quant:
             # quantize the new rows at trace time; the kernel injects the
-            # int8 rows + scale columns into their pages in VMEM. Dense
-            # [B, K] scales are padded into the pool's sublane-row layout
-            # so each tp shard receives an aligned [B, >=8] block.
+            # quantized rows + scale columns into their pages in VMEM.
+            # Dense [B, S] scales are padded into the pool's sublane-row
+            # layout so each tp shard receives an aligned [B, >=8] block.
+            # (The pallas kernels require int4_groups == 1, so S == K and
+            # the sublane layout is identical to the int8 tier's.)
             from dynamo_tpu.ops.quant import _scale_rows, kv_scale_subl
 
-            new_k, nks_dense = quantize_kv_rows(new_k, kh)
-            new_v, nvs_dense = quantize_kv_rows(new_v, kh)
-            subl = kv_scale_subl(kh, attn.kv_tp)
-            rows = _scale_rows(kh, attn.kv_tp)
+            new_k, nks_dense = _quant_rows(new_k)
+            new_v, nvs_dense = _quant_rows(new_v)
+            subl = kv_scale_subl(s_ch, attn.kv_tp)
+            rows = _scale_rows(s_ch, attn.kv_tp)
             new_ks = jnp.ones((b, subl), jnp.float32).at[:, rows].set(nks_dense)
             new_vs = jnp.ones((b, subl), jnp.float32).at[:, rows].set(nvs_dense)
         if attn.mesh is not None:
@@ -384,8 +424,8 @@ def _attn_block(
         v2 = v.reshape(b, t, kh * hd)
         ks2 = vs2 = None
         if quant:
-            k2, ks2 = quantize_kv_rows(k2, kh)
-            v2, vs2 = quantize_kv_rows(v2, kh)
+            k2, ks2 = _quant_rows(k2)
+            v2, vs2 = _quant_rows(v2)
         if t_pad != t:
             k2 = jnp.pad(k2, ((0, 0), (0, t_pad - t), (0, 0)))
             v2 = jnp.pad(v2, ((0, 0), (0, t_pad - t), (0, 0)))
@@ -410,10 +450,10 @@ def _attn_block(
             from dynamo_tpu.ops.quant import scales_to_page_tiles
 
             ks_pages = scales_to_page_tiles(
-                ks2.reshape(b * t_pad, kh), ps, kh, attn.kv_tp
+                ks2.reshape(b * t_pad, s_ch), ps, s_ch, attn.kv_tp
             )
             vs_pages = scales_to_page_tiles(
-                vs2.reshape(b * t_pad, kh), ps, kh, attn.kv_tp
+                vs2.reshape(b * t_pad, s_ch), ps, s_ch, attn.kv_tp
             )
         wr = functools.partial(
             paged_kv_write, page_size=ps, interpret=attn.interpret
@@ -454,7 +494,7 @@ def _attn_block(
 
             fl = functools.partial(
                 flash_prefill_attention,
-                page_size=ps, interpret=attn.interpret,
+                page_size=ps, interpret=attn.interpret, int4=int4,
             )
             if attn.mesh is not None:
                 P = jax.sharding.PartitionSpec
@@ -485,6 +525,7 @@ def _attn_block(
             out = paged_attention(
                 q, kv_k, kv_v, attn.slot_matrix, positions,
                 k_scales=kv_ks, v_scales=kv_vs, scale_tp=attn.kv_tp,
+                int4_groups=attn.int4_groups or None,
             )
     elif attn.ring and attn.mesh is not None:
         # sp-sharded long-context prefill: KV lands in the (sp-replicated)
@@ -516,16 +557,30 @@ def _attn_block(
                 from dynamo_tpu.ops.quant import gather_kv_scales
 
                 flat = sm.reshape(-1)
-                pk = dequantize_kv_rows(
-                    kv_k[flat],
-                    gather_kv_scales(kv_ks, flat, kh, attn.kv_tp),
-                    out_dtype=x.dtype,
-                ).reshape(b, c, kh, hd)
-                pv = dequantize_kv_rows(
-                    kv_v[flat],
-                    gather_kv_scales(kv_vs, flat, kh, attn.kv_tp),
-                    out_dtype=x.dtype,
-                ).reshape(b, c, kh, hd)
+                if int4:
+                    from dynamo_tpu.ops.quant import dequantize_kv_rows_int4
+
+                    pk = dequantize_kv_rows_int4(
+                        kv_k[flat],
+                        gather_kv_scales(kv_ks, flat, s_ch, attn.kv_tp),
+                        kh, out_dtype=x.dtype,
+                    ).reshape(b, c, kh, hd)
+                    pv = dequantize_kv_rows_int4(
+                        kv_v[flat],
+                        gather_kv_scales(kv_vs, flat, s_ch, attn.kv_tp),
+                        kh, out_dtype=x.dtype,
+                    ).reshape(b, c, kh, hd)
+                else:
+                    pk = dequantize_kv_rows(
+                        kv_k[flat],
+                        gather_kv_scales(kv_ks, flat, kh, attn.kv_tp),
+                        out_dtype=x.dtype,
+                    ).reshape(b, c, kh, hd)
+                    pv = dequantize_kv_rows(
+                        kv_v[flat],
+                        gather_kv_scales(kv_vs, flat, kh, attn.kv_tp),
+                        out_dtype=x.dtype,
+                    ).reshape(b, c, kh, hd)
             else:
                 pk = kv_k[sm].reshape(b, c, kh, hd)
                 pv = kv_v[sm].reshape(b, c, kh, hd)
@@ -553,6 +608,7 @@ def _attn_block(
             rg = functools.partial(
                 ragged_paged_attention,
                 page_size=attn.page_size, interpret=attn.interpret,
+                int4=int4,
             )
             if attn.mesh is not None:
                 P = jax.sharding.PartitionSpec
@@ -586,6 +642,7 @@ def _attn_block(
                 paged_decode_attention,
                 page_size=attn.page_size,
                 interpret=attn.interpret,
+                int4=int4,
             )
             if attn.mesh is not None:
                 P = jax.sharding.PartitionSpec
@@ -619,6 +676,7 @@ def _attn_block(
                 q, kv_k, kv_v, attn.slot_matrix, positions,
                 k_scales=kv_ks, v_scales=kv_vs, scale_tp=attn.kv_tp,
                 q_lens=attn.lengths,
+                int4_groups=attn.int4_groups or None,
             )
     proj = mm(out.reshape(b, t, h * hd), lp["wo"])
     if tp_axis is not None:

@@ -82,6 +82,7 @@ def paged_attention(
     v_scales: jnp.ndarray | None = None,  # (ops/quant pool layout)
     scale_tp: int = 1,
     q_lens: jnp.ndarray | None = None,    # [B] valid query rows per row
+    int4_groups: int | None = None,       # int4 pools: scale groups per head
 ) -> jnp.ndarray:
     """Gathered-slot attention. Gathered slot j holds absolute position j of
     the sequence, so causality is `j <= positions[b, t]`; padded queries and
@@ -97,16 +98,40 @@ def paged_attention(
     With scale pools the caches hold per-token-per-kv-head symmetric int8
     (ops/quant.quantize_kv_rows; pool layout ops/quant.init_kv_scale_pool);
     rows are dequantized after the gather — this path is the correctness
-    oracle for the int8 pallas kernels."""
+    oracle for the int8 pallas kernels.
+
+    `int4_groups` switches the pools to the nibble-packed int4 tier
+    (ops/quant.quantize_kv_rows_int4): the caches hold HALF-width packed
+    rows [N, K*Hd/2] and the scale pools carry S = K * int4_groups
+    channels; the gather streams the packed bytes and dequantizes after
+    — the correctness oracle for the int4 pallas kernels."""
     b, t, h, hd = q.shape
-    kh = k_cache.shape[1] // hd
+    int4 = int4_groups is not None
+    kh = (2 if int4 else 1) * k_cache.shape[1] // hd
     g = h // kh
     scale = hd ** -0.5
 
     c = slot_matrix.shape[1]
-    k = k_cache[slot_matrix].reshape(b, c, kh, hd)  # [B, C, K, Hd]
-    v = v_cache[slot_matrix].reshape(b, c, kh, hd)
-    if k_scales is not None:
+    if int4:
+        from dynamo_tpu.ops.quant import (
+            dequantize_kv_rows_int4,
+            gather_kv_scales,
+        )
+
+        flat = slot_matrix.reshape(-1)
+        s_ch = kh * int4_groups
+        ks = gather_kv_scales(k_scales, flat, s_ch, scale_tp).reshape(b, c, s_ch)
+        vs = gather_kv_scales(v_scales, flat, s_ch, scale_tp).reshape(b, c, s_ch)
+        k = dequantize_kv_rows_int4(
+            k_cache[slot_matrix], ks, kh, q.dtype
+        ).reshape(b, c, kh, hd)
+        v = dequantize_kv_rows_int4(
+            v_cache[slot_matrix], vs, kh, q.dtype
+        ).reshape(b, c, kh, hd)
+    else:
+        k = k_cache[slot_matrix].reshape(b, c, kh, hd)  # [B, C, K, Hd]
+        v = v_cache[slot_matrix].reshape(b, c, kh, hd)
+    if not int4 and k_scales is not None:
         from dynamo_tpu.ops.quant import gather_kv_scales
 
         flat = slot_matrix.reshape(-1)

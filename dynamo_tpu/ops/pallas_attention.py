@@ -286,6 +286,7 @@ def _decode_kernel_q(
     nbuf: int,
     ablate: str = "",  # perf bisection: "noscale_dma" | "noscale_mul"
     packed: bool = False,
+    int4: bool = False,
 ):
     """int8 variant of `_decode_kernel`: pages are int8 plus transposed
     f32 scale pages [SUBL>=8, page_size] (ops/quant.py pool layout — the
@@ -309,13 +310,33 @@ def _decode_kernel_q(
     rows are CYCLIC (row r ↔ kv head r % SUBL) so the tile-repeat's row
     order matches by construction. K-scales multiply the scores;
     V-scales multiply the softmax probs ((p*vs) @ v_int8 == p @
-    dequant(v)). Design notes otherwise as in `_decode_kernel`."""
+    dequant(v)). Design notes otherwise as in `_decode_kernel`.
+
+    `int4`: the pools are nibble-packed at HALF width (kwp = K*Hd/2,
+    ops/quant.quantize_kv_rows_int4 planar layout: a head's packed byte j
+    = feature j low nibble | feature j+Hd/2 high nibble). The query
+    arrives in PLANAR column order — its lo-half features block-diagonal
+    over the first kwp columns, hi-half over the last kwp — so scores
+    are TWO half-width dots against the nibble planes and the unpacked
+    row never materializes. The PV product accumulates [p@lo | p@hi]
+    planar in the same [HK, kw] accumulator; the caller un-permutes.
+    Both int8→int32 page packing and the fused-write byte injection are
+    byte-level and compose unchanged at half width."""
     t_blk = pages_per_block * page_size
     hk = qb_ref.shape[1]
-    kw = qb_ref.shape[2]
+    kw = qb_ref.shape[2]            # full (planar) width when int4
+    kwp = kw // 2 if int4 else kw   # pool row width
     subl = ksnew_ref.shape[1]
     g = hk // subl
     n_work = n_work_ref[0]
+
+    def nibbles(x):
+        # packed int4 bytes -> (lo, hi) f32 nibble planes; (x^8)-8
+        # sign-extends the low nibble, arithmetic >> the high one
+        xi = x.astype(jnp.int32)
+        lo = (((xi & 15) ^ 8) - 8).astype(jnp.float32)
+        hi = (xi >> 4).astype(jnp.float32)
+        return lo, hi
 
     def start_work_dma(w, slot):
         seq = work_seq_ref[w]
@@ -413,22 +434,22 @@ def _decode_kernel_q(
         if packed:
             # int32 domain: the token's row is byte lane off%4 of packed
             # row off//4; mask-merge the new int8 row's bytes in place
-            kb32 = k_buf[slot].reshape(t_blk // 4, kw)
-            vb32 = v_buf[slot].reshape(t_blk // 4, kw)
+            kb32 = k_buf[slot].reshape(t_blk // 4, kwp)
+            vb32 = v_buf[slot].reshape(t_blk // 4, kwp)
             shift = jax.lax.rem(off, 4) * 8
             mask = 0xFF << shift
-            row32 = jax.lax.broadcasted_iota(jnp.int32, (t_blk // 4, kw), 0)
+            row32 = jax.lax.broadcasted_iota(jnp.int32, (t_blk // 4, kwp), 0)
             inj = do_write & (row32 == jax.lax.div(off, 4))
             nk32 = (knew_ref[seq].astype(jnp.int32) & 0xFF) << shift
             nv32 = (vnew_ref[seq].astype(jnp.int32) & 0xFF) << shift
             kb32 = jnp.where(inj, (kb32 & ~mask) | nk32, kb32)
             vb32 = jnp.where(inj, (vb32 & ~mask) | nv32, vb32)
-            kb = pltpu.bitcast(kb32, jnp.int8)   # [t_blk, kw]
+            kb = pltpu.bitcast(kb32, jnp.int8)   # [t_blk, kwp]
             vb = pltpu.bitcast(vb32, jnp.int8)
         else:
-            kb = k_buf[slot].reshape(t_blk, kw)
-            vb = v_buf[slot].reshape(t_blk, kw)
-            row = jax.lax.broadcasted_iota(jnp.int32, (t_blk, kw), 0)
+            kb = k_buf[slot].reshape(t_blk, kwp)
+            vb = v_buf[slot].reshape(t_blk, kwp)
+            row = jax.lax.broadcasted_iota(jnp.int32, (t_blk, kwp), 0)
             kb = jnp.where(do_write & (row == off), knew_ref[seq], kb)
             vb = jnp.where(do_write & (row == off), vnew_ref[seq], vb)
         p_loc = jax.lax.div(off, page_size)
@@ -440,11 +461,11 @@ def _decode_kernel_q(
         @pl.when(do_write)
         def _store_back():
             if packed:
-                k_buf[slot] = kb32.reshape(pages_per_block, page_size // 4, kw)
-                v_buf[slot] = vb32.reshape(pages_per_block, page_size // 4, kw)
+                k_buf[slot] = kb32.reshape(pages_per_block, page_size // 4, kwp)
+                v_buf[slot] = vb32.reshape(pages_per_block, page_size // 4, kwp)
             else:
-                k_buf[slot] = kb.reshape(pages_per_block, page_size, kw)
-                v_buf[slot] = vb.reshape(pages_per_block, page_size, kw)
+                k_buf[slot] = kb.reshape(pages_per_block, page_size, kwp)
+                v_buf[slot] = vb.reshape(pages_per_block, page_size, kwp)
             ks_buf[slot] = ksb
             vs_buf[slot] = vsb
             # select the written page's [SUBL, S] scale tile (static
@@ -504,11 +525,24 @@ def _decode_kernel_q(
         # K-scales fold into the score lanes afterwards (one VPU repeat).
         # (probed: casting to bf16 instead of f32 here is ~4% SLOWER —
         # int8->bf16 goes through f32 plus a truncate on the VPU)
-        s = jax.lax.dot_general(
-            qb_ref[seq].astype(jnp.float32), kb.astype(jnp.float32),
-            dimension_numbers=(((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )  # [HK, T_blk]
+        if int4:
+            klo, khi = nibbles(kb)               # [t_blk, kwp] planes
+            qbs = qb_ref[seq].astype(jnp.float32)
+            s = jax.lax.dot_general(
+                qbs[:, :kwp], klo,
+                dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) + jax.lax.dot_general(
+                qbs[:, kwp:], khi,
+                dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # [HK, T_blk]
+        else:
+            s = jax.lax.dot_general(
+                qb_ref[seq].astype(jnp.float32), kb.astype(jnp.float32),
+                dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # [HK, T_blk]
         if ablate != "noscale_mul":
             s = s * pltpu.repeat(ksb, g, 0)
 
@@ -522,16 +556,36 @@ def _decode_kernel_q(
         alpha = jnp.exp(m_prev - m_next)
         l_next = alpha * l_prev + l_curr
 
-        # V-scales fold into the probs: (p * vs) @ v_int8 == p @ dequant(v)
+        # V-scales fold into the probs: (p * vs) @ v_int == p @ dequant(v)
         pv_in = (
             p_blk if ablate == "noscale_mul"
             else p_blk * pltpu.repeat(vsb, g, 0)
         )
-        o_curr = jax.lax.dot_general(
-            pv_in, vb.astype(jnp.float32),
-            dimension_numbers=(((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
+        if int4:
+            # planar accumulator: lo-plane columns first, hi after — the
+            # caller un-permutes to natural feature order
+            vlo, vhi = nibbles(vb)
+            o_curr = jnp.concatenate(
+                [
+                    jax.lax.dot_general(
+                        pv_in, vlo,
+                        dimension_numbers=(((1,), (0,)), ((), ())),
+                        preferred_element_type=jnp.float32,
+                    ),
+                    jax.lax.dot_general(
+                        pv_in, vhi,
+                        dimension_numbers=(((1,), (0,)), ((), ())),
+                        preferred_element_type=jnp.float32,
+                    ),
+                ],
+                axis=1,
+            )
+        else:
+            o_curr = jax.lax.dot_general(
+                pv_in, vb.astype(jnp.float32),
+                dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
         acc = acc * alpha + o_curr
         m_prev, l_prev = m_next, l_next
 
@@ -563,7 +617,7 @@ def _decode_kernel_q(
 @functools.partial(
     jax.jit,
     static_argnames=["page_size", "pages_per_block", "nbuf", "interpret",
-                     "ablate", "alias_caches"],
+                     "ablate", "alias_caches", "int4"],
 )
 def fused_paged_decode_attention(
     q: jax.Array,             # [B, H, Hd] (rope applied, unscaled)
@@ -587,6 +641,7 @@ def fused_paged_decode_attention(
     interpret: bool = False,
     ablate: str = "",
     alias_caches: bool = True,
+    int4: bool = False,
 ):
     """Flash paged decode attention fused with the KV-cache update.
 
@@ -600,11 +655,16 @@ def fused_paged_decode_attention(
     # int32-PACKED pools (quant.pack_kv_slots layout): 4 token rows per
     # int32 row — f32-class DMA tiling; the kernel bitcasts back to int8
     packed = quant and k_cache.dtype == jnp.int32
-    num_slots, kw = k_cache.shape
+    num_slots, kw = k_cache.shape   # kw = pool row width (K*Hd/2 at int4)
     if packed:
         num_slots *= 4
-    assert kw % hd == 0
-    kh = kw // hd
+    # int4 pools are nibble-packed at half width, so kh cannot be derived
+    # from the pool shape — hence the explicit static flag
+    kwf = 2 * kw if int4 else kw    # full logical width K*Hd
+    if int4:
+        assert quant, "int4 pools require scale pools"
+    assert kwf % hd == 0
+    kh = kwf // hd
     assert h % kh == 0
     g = h // kh
     num_pages = num_slots // page_size
@@ -692,6 +752,7 @@ def fused_paged_decode_attention(
             nbuf=nbuf,
             ablate=ablate,
             packed=packed,
+            int4=int4,
         )
         # CYCLIC query-row layout (HK = SUBL*G rows): row r carries query
         # head (r%SUBL)*G + r//SUBL in kv column block r%SUBL — so the
@@ -707,10 +768,26 @@ def fused_paged_decode_attention(
             (q * scale)[:, jnp.where(valid_row, head_of_row, 0), :],
             0,
         ).astype(q.dtype)                                     # [B, HK, Hd]
-        qt = jnp.tile(q_rows, (1, 1, kh))                     # [B, HK, K*Hd]
-        colh = (jnp.arange(kw, dtype=jnp.int32) // hd)[None, None, :]
         rowh = (r % subl).astype(jnp.int32)[None, :, None]
-        qbq = jnp.where(colh == rowh, qt, 0).astype(q.dtype)
+        if int4:
+            # PLANAR query layout: the head's lo-half features block-
+            # diagonal over the first kw (= K*Hd/2) columns, hi-half over
+            # the last kw — matching the pool's nibble planes so the
+            # kernel scores with two half-width dots
+            hd2 = hd // 2
+            colh2 = (jnp.arange(kw, dtype=jnp.int32) // hd2)[None, None, :]
+
+            def _half(qh):                       # [B, HK, Hd/2] -> kw cols
+                return jnp.where(colh2 == rowh, jnp.tile(qh, (1, 1, kh)), 0)
+
+            qbq = jnp.concatenate(
+                [_half(q_rows[..., :hd2]), _half(q_rows[..., hd2:])],
+                axis=2,
+            ).astype(q.dtype)                                 # [B, HK, K*Hd]
+        else:
+            qt = jnp.tile(q_rows, (1, 1, kh))                 # [B, HK, K*Hd]
+            colh = (jnp.arange(kw, dtype=jnp.int32) // hd)[None, None, :]
+            qbq = jnp.where(colh == rowh, qt, 0).astype(q.dtype)
         # inputs: 0..5 = scalar prefetch, 6 = qb, 7..10 = new rows/scales,
         # 11..14 = page pools — aliased onto outputs 1..4
         aliases = {11: 1, 12: 2, 13: 3, 14: 4} if alias_caches else {}
@@ -718,7 +795,7 @@ def fused_paged_decode_attention(
             kernel,
             grid_spec=grid_spec,
             out_shape=[
-                jax.ShapeDtypeStruct((b, hk, kw), q.dtype),
+                jax.ShapeDtypeStruct((b, hk, kwf), q.dtype),
                 jax.ShapeDtypeStruct(k_pages.shape, k_pages.dtype),
                 jax.ShapeDtypeStruct(v_pages.shape, v_pages.dtype),
                 jax.ShapeDtypeStruct(ks_pages.shape, jnp.float32),
@@ -734,7 +811,17 @@ def fused_paged_decode_attention(
         # undo the cyclic layout: row r = j*SUBL + k keeps column block k
         # (kw spans kh blocks; padding rows k >= kh have no block and are
         # dropped); head (k*G + j) <- (j, k)
-        out = out_full.astype(jnp.float32).reshape(b, g, subl, kh, hd)
+        out_full = out_full.astype(jnp.float32)
+        if int4:
+            # planar -> natural feature order first: the accumulator is
+            # [lo-plane cols | hi-plane cols]; a head's true features are
+            # its lo block then its hi block concatenated
+            out_full = (
+                out_full.reshape(b, hk, 2, kh, hd // 2)
+                .transpose(0, 1, 3, 2, 4)
+                .reshape(b, hk, kwf)
+            )
+        out = out_full.reshape(b, g, subl, kh, hd)
         out = jnp.einsum("bjkkd->bjkd", out[:, :, :kh])       # [B, G, K, Hd]
         out = out.transpose(0, 2, 1, 3).reshape(b, h, hd).astype(q.dtype)
         pool_rows = num_slots // 4 if packed else num_slots
@@ -827,6 +914,7 @@ def paged_decode_attention(
     page_size: int,
     pages_per_block: int = 4,
     interpret: bool = False,
+    int4: bool = False,
 ) -> jax.Array:
     """Read-only flash paged decode attention (KV already written);
     returns [B, H, Hd] in q.dtype."""
@@ -835,7 +923,8 @@ def paged_decode_attention(
     quant = k_scales is not None
     subl = k_scales.shape[1] if quant else 0
     # new-token rows are always dense int8 in quant mode, even when the
-    # pools themselves are int32-packed
+    # pools themselves are int32-packed (int4: nibble-packed half width,
+    # matching the pool row width kw)
     row_dtype = jnp.int8 if quant else k_cache.dtype
     res = fused_paged_decode_attention(
         q,
@@ -854,6 +943,7 @@ def paged_decode_attention(
         pages_per_block=pages_per_block,
         interpret=interpret,
         alias_caches=False,
+        int4=int4,
     )
     return res[0]
 
@@ -870,6 +960,7 @@ def ragged_paged_attention(
     *,
     page_size: int,
     interpret: bool = False,
+    int4: bool = False,
 ) -> jax.Array:
     """Read-only paged attention with PER-ROW query lengths — the kernel
     behind the mixed prefill+decode step AND the pallas spec-verify path
@@ -890,4 +981,5 @@ def ragged_paged_attention(
     return flash_prefill_attention(
         q, k_cache, v_cache, block_tables, q_pos0, q_lens,
         k_scales, v_scales, page_size=page_size, interpret=interpret,
+        int4=int4,
     )

@@ -61,6 +61,7 @@ def _kernel(
     quant: bool = False,
     subl: int = 0,
     packed: bool = False,
+    int4: bool = False,
 ):
     k_refs = page_refs[:ppb]
     v_refs = page_refs[ppb:2 * ppb]
@@ -92,6 +93,16 @@ def _kernel(
     scale = hd ** -0.5
     tg = t_tile * g
     blk = ppb * page
+    hd2 = hd // 2  # int4: packed bytes per head (planar nibble planes)
+
+    def nibbles(x):
+        # packed int4 byte [n, hd2] -> (lo, hi) f32 [n, hd2]: low nibble
+        # = features 0..hd2-1 (sign-extend via (x^8)-8), high nibble =
+        # features hd2..hd-1 (arithmetic >> sign-extends for free)
+        xi = x.astype(jnp.int32)
+        lo = (((xi & 15) ^ 8) - 8).astype(jnp.float32)
+        hi = (xi >> 4).astype(jnp.float32)
+        return lo, hi
 
     @pl.when(kb == 0)
     def _init():
@@ -122,17 +133,33 @@ def _kernel(
             q_k = q_ref[0, k]                                  # [TG, Hd]
             qf = q_k.astype(jnp.float32) * scale
             for j in range(ppb):
-                if packed:
-                    k_j = kbs[j][:, k * hd:(k + 1) * hd]       # [page, Hd]
+                if int4:
+                    # packed int4 page: a head's slice is hd/2 bytes whose
+                    # nibble planes are its low/high feature halves —
+                    # score with two half-width dots, no unpacked row
+                    kp = (kbs[j] if packed else k_refs[j][0])[
+                        :, k * hd2:(k + 1) * hd2
+                    ]                                          # [page, Hd/2]
+                    klo, khi = nibbles(kp)
+                    s_j = jax.lax.dot_general(
+                        qf[:, :hd2], klo, (((1,), (1,)), ((), ())),
+                        preferred_element_type=jnp.float32,
+                    ) + jax.lax.dot_general(
+                        qf[:, hd2:], khi, (((1,), (1,)), ((), ())),
+                        preferred_element_type=jnp.float32,
+                    )
                 else:
-                    k_j = k_refs[j][0, :, k * hd:(k + 1) * hd]
-                s_j = jax.lax.dot_general(
-                    qf, k_j.astype(jnp.float32),
-                    (((1,), (1,)), ((), ())),
-                    preferred_element_type=jnp.float32,
-                )
+                    if packed:
+                        k_j = kbs[j][:, k * hd:(k + 1) * hd]   # [page, Hd]
+                    else:
+                        k_j = k_refs[j][0, :, k * hd:(k + 1) * hd]
+                    s_j = jax.lax.dot_general(
+                        qf, k_j.astype(jnp.float32),
+                        (((1,), (1,)), ((), ())),
+                        preferred_element_type=jnp.float32,
+                    )
                 if quant:
-                    # int8 pages: K-scales fold into the score lanes
+                    # int8/int4 pages: K-scales fold into the score lanes
                     s_j = s_j * head_scale(ks_refs[j], k)
                 s_ref[:, j * page:(j + 1) * page] = s_j
             s = jnp.where(valid, s_ref[...], _NEG_INF)         # [TG, BLK]
@@ -145,19 +172,40 @@ def _kernel(
             m_ref[:, k] = m_new
             pv = jnp.zeros((tg, hd), jnp.float32)
             for j in range(ppb):
-                if packed:
-                    v_j = vbs[j][:, k * hd:(k + 1) * hd]       # [page, Hd]
-                else:
-                    v_j = v_refs[j][0, :, k * hd:(k + 1) * hd]
                 p_j = p[:, j * page:(j + 1) * page]
                 if quant:
-                    # (p * vs) @ v_int8 == p @ dequant(v)
+                    # (p * vs) @ v_int == p @ dequant(v)
                     p_j = p_j * head_scale(vs_refs[j], k)
-                pv = pv + jax.lax.dot_general(
-                    p_j, v_j.astype(jnp.float32),
-                    (((1,), (0,)), ((), ())),
-                    preferred_element_type=jnp.float32,
-                )
+                if int4:
+                    # planar PV: [p@lo | p@hi] IS the natural feature
+                    # order (lo plane = features 0..hd2-1)
+                    vp = (vbs[j] if packed else v_refs[j][0])[
+                        :, k * hd2:(k + 1) * hd2
+                    ]
+                    vlo, vhi = nibbles(vp)
+                    pv = pv + jnp.concatenate(
+                        [
+                            jax.lax.dot_general(
+                                p_j, vlo, (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32,
+                            ),
+                            jax.lax.dot_general(
+                                p_j, vhi, (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32,
+                            ),
+                        ],
+                        axis=1,
+                    )
+                else:
+                    if packed:
+                        v_j = vbs[j][:, k * hd:(k + 1) * hd]   # [page, Hd]
+                    else:
+                        v_j = v_refs[j][0, :, k * hd:(k + 1) * hd]
+                    pv = pv + jax.lax.dot_general(
+                        p_j, v_j.astype(jnp.float32),
+                        (((1,), (0,)), ((), ())),
+                        preferred_element_type=jnp.float32,
+                    )
             acc_ref[k] = acc_ref[k] * alpha[:, None] + pv
 
     @pl.when(kb == wb - 1)
@@ -169,7 +217,9 @@ def _kernel(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("page_size", "t_tile", "pages_per_block", "interpret"),
+    static_argnames=(
+        "page_size", "t_tile", "pages_per_block", "interpret", "int4"
+    ),
 )
 def flash_prefill_attention(
     q: jax.Array,             # [B, T, H, Hd] rope applied, unscaled
@@ -189,6 +239,7 @@ def flash_prefill_attention(
     t_tile: int = 128,
     pages_per_block: int = 4,
     interpret: bool = False,
+    int4: bool = False,
 ) -> jax.Array:
     """Causal chunked-prefill attention over gathered pages; rows past
     t_valid produce zeros. Returns [B, T, H, Hd] in q.dtype. With scale
@@ -208,8 +259,12 @@ def flash_prefill_attention(
     if packed:
         num_slots *= 4
     page_rows = page_size // 4 if packed else page_size
-    kh = kw // hd
+    # int4: the pool is nibble-packed at HALF width (kw = K*Hd/2), so kh
+    # cannot be derived from kw alone — hence the explicit static flag
+    kh = (2 * kw if int4 else kw) // hd
     g = h // kh
+    if int4:
+        assert quant, "int4 pools require scale pools"
     ppb = pages_per_block
     t_tile = min(t_tile, max(t, 8))
 
@@ -301,6 +356,7 @@ def flash_prefill_attention(
         functools.partial(
             _kernel, t_tile=t_tile, page=page_size, kh=kh, g=g, hd=hd,
             wb=wb, ppb=ppb, quant=quant, subl=subl, packed=packed,
+            int4=int4,
         ),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, kh, t_pad * g, hd), q.dtype),

@@ -230,6 +230,98 @@ def scales_to_page_tiles(
     )
 
 
+# --------------------------------------------------------------------------
+# int4 packed KV tier: two 4-bit values per int8 byte, half the int8
+# tier's KV bytes.
+#
+# Packing is PLANAR per kv head: within one head's Hd features, packed
+# byte j holds feature j in its low nibble and feature j + Hd/2 in its
+# high nibble, so a packed row is [..., K*Hd/2] int8 and a head's packed
+# slice splits into its low/high feature halves by plain slicing — the
+# kernels score against the two nibble planes with two half-width dots
+# and never materialize the unpacked row in registers. Values are
+# clipped to [-7, 7] (symmetric, -8 unused so negation stays exact) with
+# grouped absmax scales: `group_size` consecutive features share one f32
+# scale. Default group_size = Hd reproduces the int8 tier's per-token-
+# per-kv-head granularity (S == K scale channels — the layout the scale
+# POOL above and the pallas scale-fold require); finer groups mean more
+# scale channels and are gather-path only. The int32 page packing above
+# composes unchanged — it is byte-level and width-agnostic — so the
+# pallas (8, 128) DMA-tiling story carries over at half width.
+
+
+def int4_scale_channels(
+    num_kv_heads: int, head_dim: int, group_size: int | None = None
+) -> int:
+    """Scale channels S for an int4 pool (= K * groups-per-head)."""
+    g = head_dim if group_size is None else group_size
+    if g <= 0 or head_dim % g != 0:
+        raise ValueError(
+            f"kv_quant_group {g} must divide head_dim {head_dim}"
+        )
+    return num_kv_heads * (head_dim // g)
+
+
+def quantize_kv_rows_int4(
+    rows: jnp.ndarray, num_kv_heads: int, group_size: int | None = None
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """KV rows [..., K*Hd] float -> (packed int8 [..., K*Hd/2], scales
+    f32 [..., S]) with S = K * (Hd // group_size); symmetric per-group
+    absmax, scale = amax/7 (sentinel 1.0 for zero groups)."""
+    shape = rows.shape
+    hd = shape[-1] // num_kv_heads
+    g = hd if group_size is None else group_size
+    s = int4_scale_channels(num_kv_heads, hd, g)
+    rf = rows.astype(jnp.float32).reshape(
+        *shape[:-1], num_kv_heads, hd // g, g
+    )
+    amax = jnp.max(jnp.abs(rf), axis=-1)
+    scales = jnp.where(amax > 0, amax / 7.0, 1.0)
+    q = (
+        jnp.clip(jnp.round(rf / scales[..., None]), -7, 7)
+        .astype(jnp.int32)
+        .reshape(*shape[:-1], num_kv_heads, hd)
+    )
+    lo, hi = q[..., : hd // 2], q[..., hd // 2 :]
+    packed = ((hi << 4) | (lo & 0xF)).astype(jnp.int8)
+    return (
+        packed.reshape(*shape[:-1], shape[-1] // 2),
+        scales.reshape(*shape[:-1], s),
+    )
+
+
+def unpack_int4_kv(packed: jnp.ndarray, num_kv_heads: int) -> jnp.ndarray:
+    """Packed int8 [..., K*Hd/2] -> int8 [..., K*Hd], values in [-7, 7]
+    (planar-pack inverse; low nibbles are each head's first Hd/2
+    features). Sign-extends the low nibble via the (x ^ 8) - 8 trick;
+    the high nibble sign-extends for free under arithmetic shift."""
+    shape = packed.shape
+    hd2 = shape[-1] // num_kv_heads
+    b = packed.astype(jnp.int32).reshape(*shape[:-1], num_kv_heads, hd2)
+    lo = ((b & 15) ^ 8) - 8
+    hi = b >> 4
+    full = jnp.concatenate([lo, hi], axis=-1)
+    return full.reshape(*shape[:-1], 2 * shape[-1]).astype(jnp.int8)
+
+
+def dequantize_kv_rows_int4(
+    packed: jnp.ndarray,
+    scales: jnp.ndarray,
+    num_kv_heads: int,
+    out_dtype=jnp.float32,
+) -> jnp.ndarray:
+    """(packed int8 [..., K*Hd/2], scales [..., S]) -> float [..., K*Hd].
+    Group size is implied by S (= K * Hd / S features per scale)."""
+    shape = packed.shape
+    hd = 2 * shape[-1] // num_kv_heads
+    gph = scales.shape[-1] // num_kv_heads  # groups per head
+    q = unpack_int4_kv(packed, num_kv_heads).astype(jnp.float32)
+    qg = q.reshape(*shape[:-1], num_kv_heads, gph, hd // gph)
+    sg = scales.reshape(*shape[:-1], num_kv_heads, gph)
+    f = qg * sg[..., None]
+    return f.reshape(*shape[:-1], 2 * shape[-1]).astype(out_dtype)
+
+
 def mm(x: jnp.ndarray, w) -> jnp.ndarray:
     """The model's matmul: quantized or plain depending on the leaf."""
     if is_quantized(w):

@@ -206,6 +206,42 @@ def normalize(doc: dict) -> dict:
             metrics["control.goodput_retained"] = Metric(
                 v, True, cctx, rtol=0.25, atol=0.1
             )
+    kvcap = doc.get("kv_capacity")
+    if isinstance(kvcap, dict):
+        note_prov(kvcap)
+        # scale = the census's own descriptor (budget/ISL/OSL/head_dim/
+        # group): a different budget or shape is a different experiment
+        kctx = _ctx("kv_capacity", _scenario_key(kvcap))
+        cap = kvcap.get("capacity") or {}
+        v = _num(cap.get("capacity_ratio_int4_vs_bf16"))
+        if v is not None:
+            # pure pool-byte arithmetic + floor division — deterministic
+            # at fixed shape, so the tolerance is tight
+            metrics["kv_capacity.int4_vs_bf16_streams"] = Metric(
+                v, True, kctx, rtol=0.02
+            )
+        v = _num(cap.get("data_ratio_int4_vs_bf16"))
+        if v is not None:
+            # exact by construction (4.0): any drift means the packed
+            # pool layout changed under the allocator
+            metrics["kv_capacity.int4_data_ratio"] = Metric(
+                v, True, kctx, rtol=0.001
+            )
+        for tier in ("int8", "int4"):
+            v = _num(
+                ((kvcap.get("quality") or {}).get("tiers") or {})
+                .get(tier, {}).get("greedy_token_match")
+            )
+            if v is not None:
+                metrics[f"kv_capacity.{tier}_token_match"] = Metric(
+                    v, True, kctx, rtol=0.03, atol=0.02
+                )
+        v = _num((kvcap.get("throughput") or {}).get("int4_vs_int8"))
+        if v is not None:
+            # CPU wall-clock at tiny scale: wide tolerance, trend only
+            metrics["kv_capacity.int4_vs_int8_toks"] = Metric(
+                v, True, kctx, rtol=0.50, atol=0.2
+            )
     scenarios = doc.get("scenarios")
     if isinstance(scenarios, dict):
         note_prov(scenarios)

@@ -81,6 +81,12 @@ def _defaults() -> dict:
         pull_threshold_pages=2,
         poll_interval=0.25,   # aggregator scrape cadence
         chip_hour_usd=float(os.environ.get("BENCH_CHIP_HOUR_USD", "1.20")),
+        # KV pool tier for the fleet workers (None = engine-dtype KV).
+        # BENCH_PREFIX_FLEET_KV=int8|int4 runs the SAME routing/pull
+        # economics on quantized pools — the cross-worker pulls then
+        # move packed bytes (quantize-once: export/ingest carries the
+        # pool representation, never a requantization hop)
+        kv_quant=(os.environ.get("BENCH_PREFIX_FLEET_KV") or None),
     )
 
 
@@ -129,6 +135,7 @@ async def run_scenario(**overrides) -> dict:
             # kernels — the gather oracle runs identically on CPU CI
             # and on-TPU bench rigs
             attn_backend="gather",
+            kv_quantization=d["kv_quant"],
         )
 
     hub = HubServer()
@@ -343,7 +350,10 @@ async def run_scenario(**overrides) -> dict:
                 for k in ("tenants", "page", "prefix_pages", "suffix",
                           "osl", "warm_per_tenant", "pull_requests",
                           "max_batch")
-            },
+                # kv_quant joins the descriptor ONLY when set: the
+                # bench-history context must stay byte-identical for
+                # the existing unquantized baselines
+            } | ({"kv_quant": d["kv_quant"]} if d["kv_quant"] else {}),
             "ttft_cold_p50_s": p50(cold_recs),
             "ttft_warm_p50_s": p50(warm_recs),
             "ttft_pull_p50_s": p50(pull_recs),

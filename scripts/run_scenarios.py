@@ -22,6 +22,17 @@ sys.path.insert(
     0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 )
 
+# Compile-census flat budget (jit compiles per scenario). The FIRST
+# scenario in a cold process pays the whole tiny-engine variant set
+# (~80 on CPU today); warm scenarios reuse the process jit cache and
+# sit an order of magnitude lower (~11). A change that mints a new
+# variant family per shape — e.g. a KV-quant flag leaking into
+# trace-level dynamism instead of staying a static aux — multiplies the
+# cold set and trips this long before it reads as a latency regression.
+COMPILE_EVENTS_BUDGET = int(
+    os.environ.get("LOADGEN_COMPILE_BUDGET", "150")
+)
+
 
 def check_section(name: str, out: dict) -> list[str]:
     """Contract violations for one scenario section ([] = well-formed)."""
@@ -50,6 +61,16 @@ def check_section(name: str, out: dict) -> list[str]:
         bad.append(f"{name}: {reqs['errors']} request errors")
     if (out.get("trace") or {}).get("sha256") is None:
         bad.append(f"{name}: missing trace identity")
+    comp = out.get("compile") or {}
+    if comp.get("events") is None:
+        bad.append(f"{name}: missing compile census")
+    elif comp["events"] > COMPILE_EVENTS_BUDGET:
+        bad.append(
+            f"{name}: compile census blew the flat budget — "
+            f"{comp['events']} jit compiles in one scenario "
+            f"(budget {COMPILE_EVENTS_BUDGET}); a new variant family "
+            "is being minted per shape"
+        )
     return bad
 
 

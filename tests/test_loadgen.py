@@ -227,9 +227,21 @@ async def test_tiny_scenario_emits_wellformed_section():
 
     from dynamo_tpu.loadgen.scenarios import SCENARIOS, tiny_scale
 
+    from dynamo_tpu.engine import telemetry
+
     with tempfile.TemporaryDirectory() as d:
         scale = tiny_scale(n=6, rate_rps=40.0, trace_dir=d)
+        # the contract includes a compile census; run_suite stamps it
+        # around each scenario — do the same here (the listener is
+        # process-global and idempotent)
+        telemetry.install_compile_listener()
+        c0 = telemetry.compile_stats()
         out = await SCENARIOS["shared_prefix"].fn(scale)
+        c1 = telemetry.compile_stats()
+        out["compile"] = {
+            "events": c1["compile_events"] - c0["compile_events"],
+            "time_s": round(c1["compile_time_s"] - c0["compile_time_s"], 4),
+        }
         assert check_section("shared_prefix", out) == []
         assert out["scenario"] == "shared_prefix"
         assert out["workload"] == "shared_prefix"

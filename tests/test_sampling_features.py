@@ -534,3 +534,89 @@ async def test_n_gt_1_partial_fanout_failure_kills_admitted_siblings():
     assert all(c.is_stopped() for c in seen_ctxs), (
         "admitted sibling contexts must be killed on partial fan-out failure"
     )
+
+
+# ------------------------------------------------- count-buffer saturation
+
+
+def test_count_buffers_saturate_past_int8_range():
+    """The penalty count buffers are int8: a token repeated more than 127
+    times in one stream must SATURATE at 127, not wrap negative. A wrap
+    flips `seen = cnt > 0` to False and turns every penalty into a
+    REWARD for the most-repeated token — the exact failure a 200-repeat
+    stream used to hit. Pins both accumulators (per-step bump_counts and
+    the admission-time count_tokens prompt scatter) and the penalty
+    direction at the saturated count."""
+    from dynamo_tpu.ops.sampling import bump_counts, count_tokens
+
+    B, V = 2, 32
+    tok = 7
+    counts = jnp.zeros((B, V), jnp.int8)
+    tokens = jnp.asarray([tok, tok], jnp.int32)
+    active = jnp.asarray([True, False])
+    step = jax.jit(bump_counts)
+    for _ in range(200):  # a 200-repeat stream
+        counts = step(counts, tokens, active)
+    out = np.asarray(counts)
+    assert out[0, tok] == 127, f"wrapped: count={out[0, tok]}"
+    assert out[1, tok] == 0  # inactive rows never bump
+    assert (out >= 0).all()
+    # admission path: a 200-token prompt of one repeated id saturates too
+    counts2 = count_tokens(
+        jnp.zeros((B, V), jnp.int8),
+        jnp.asarray(0),
+        jnp.full((200,), tok, jnp.int32),
+    )
+    assert np.asarray(counts2)[0, tok] == 127
+    # and count_tokens ON TOP of an almost-saturated row stays pinned
+    counts3 = count_tokens(
+        counts, jnp.asarray(0), jnp.full((200,), tok, jnp.int32)
+    )
+    assert np.asarray(counts3)[0, tok] == 127
+    # penalties at the saturated count still PENALIZE (never boost)
+    logits = jnp.zeros((B, V))
+    pen = apply_penalties(
+        logits, counts,
+        freq_pen=jnp.asarray([0.5, 0.5]),
+        pres_pen=jnp.asarray([0.5, 0.5]),
+        rep_pen=jnp.asarray([1.5, 1.5]),
+    )
+    assert float(pen[0, tok]) < float(logits[0, tok])
+    assert float(pen[0, tok + 1]) == 0.0  # untouched elsewhere
+
+
+async def test_engine_200_repeat_stream_counts_stay_saturated():
+    """End-to-end regression for the int8 count wrap, driven past the
+    wrap point: a stream whose token id 99 occurs 150 times (prompt
+    scatter) plus decode steps. Saturated at 127, a huge frequency
+    penalty keeps 99 suppressed for the whole stream; a wrapped count
+    (-106) would flip the penalty into a +boost and greedy would emit 99
+    every step. Also reads the count buffer back: no negative entries."""
+    import asyncio
+
+    engine = make_engine(max_model_len=256, max_batch_size=2)
+    tok = 99
+    prompt = [tok] * 150 + [20, 21]
+    tokens, _ = await collect(
+        engine,
+        request(prompt, max_tokens=50, greedy=True, frequency_penalty=100.0),
+    )
+    assert len(tokens) == 50
+    assert tok not in tokens, (
+        "saturated count must keep penalizing token 99 — a wrapped int8 "
+        "count would reward it instead"
+    )
+    # the count buffer itself: saturated at 127, nothing wrapped negative.
+    # (one loop tick lets the pipelined in-flight step rebind the donated
+    # buffer before we read it)
+    counts = None
+    for _ in range(100):
+        try:
+            counts = np.asarray(engine._counts)
+            break
+        except RuntimeError:
+            await asyncio.sleep(0.02)
+    assert counts is not None
+    assert (counts >= 0).all(), "int8 count buffer wrapped negative"
+    assert counts.max() == 127, f"expected saturation, got {counts.max()}"
+    await engine.close()

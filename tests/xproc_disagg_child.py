@@ -25,13 +25,17 @@ import subprocess
 import sys
 
 
-def run_pair(kv_quant: bool) -> list[str]:
+def run_pair(kv_quant) -> list[str]:
     """Spawn the two-worker pair (rank 0 prefill, rank 1 decode) and
     return both ranks' outputs; raises on nonzero exit. Shared by
     tests/test_xproc_disagg.py and __graft_entry__.dryrun_multichip
     (pytest-free on purpose: the dryrun runs outside any test harness).
     On a hang BOTH ranks are killed and both outputs still collected —
-    the logs are the only diagnostic for a distributed stall."""
+    the logs are the only diagnostic for a distributed stall.
+
+    `kv_quant`: False = bf16 wire, True/"int8" = int8 KV engines,
+    "int4" = nibble-packed int4 KV engines (quarter-width wire)."""
+    mode = "int8" if kv_quant is True else (kv_quant or None)
     here = os.path.dirname(os.path.abspath(__file__))
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
@@ -45,7 +49,7 @@ def run_pair(kv_quant: bool) -> list[str]:
     procs = [
         subprocess.Popen(
             [sys.executable, os.path.abspath(__file__), coordinator,
-             str(rank)] + (["int8"] if kv_quant else []),
+             str(rank)] + ([mode] if mode else []),
             env=env,
             stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT,
@@ -71,7 +75,8 @@ def run_pair(kv_quant: bool) -> list[str]:
 
 def main() -> None:
     coordinator, rank = sys.argv[1], int(sys.argv[2])
-    kv_quant = len(sys.argv) > 3 and sys.argv[3] == "int8"
+    kv_mode = sys.argv[3] if len(sys.argv) > 3 else None  # int8 | int4
+    kv_quant = kv_mode is not None
     import jax
 
     jax.config.update("jax_platforms", "cpu")
@@ -109,7 +114,7 @@ def main() -> None:
             model=cfg,
             dtype="float32",
             mesh=MeshConfig(tp=tp),
-            kv_quantization="int8" if kv_quant else None,
+            kv_quantization=kv_mode,
             page_size=8,
             num_pages=64,
             max_batch_size=4,
@@ -126,6 +131,8 @@ def main() -> None:
     )
     L = cfg.num_layers
     kwid = cfg.num_kv_heads * cfg.head_dim
+    if kv_mode == "int4":
+        kwid //= 2  # nibble-packed rows: quarter of bf16 over the wire
     shape = (len(prompt), L, kwid)  # transfer lanes over the token dim
     sshape = (len(prompt), L, cfg.num_kv_heads) if kv_quant else None
     kv_dtype = np.int8 if kv_quant else np.float32
@@ -184,7 +191,8 @@ def main() -> None:
         assert got == ref, f"xproc continuation diverged: {got} vs {ref}"
         print(
             f"rank 1: xproc disagg ok — {cached} tokens rode the "
-            f"device-path KV (tp 1->2{', int8 wire' if kv_quant else ''}), "
+            f"device-path KV (tp 1->2"
+            f"{f', {kv_mode} wire' if kv_quant else ''}), "
             f"greedy bit-identical {got}",
             flush=True,
         )
