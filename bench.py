@@ -108,6 +108,15 @@ FAILOVER = os.environ.get("BENCH_FAILOVER", "") not in ("", "0")
 # runs the same at any BENCH_MODEL.
 KV_CAPACITY = os.environ.get("BENCH_KV_CAPACITY", "") not in ("", "0")
 KV_CAPACITY_MB = float(os.environ.get("BENCH_KV_CAPACITY_MB", "64"))
+# BENCH_TP_OVERLAP=1: TP comm/compute overlap ledger
+# (scripts/tp_overlap_bench.py) — per-layer step wall serialized-psum vs
+# the ring executor (parallel/tp_overlap.py) plus the measured
+# collective-byte ledger: exposed bytes EXACTLY 0.5x, total wire bytes
+# conserved, greedy argmax byte-identical to tp=1. Runs as a SUBPROCESS
+# (it needs its own 8-virtual-device CPU mesh, and this process already
+# initialized jax against the real backend); emits the `tp_overlap`
+# BENCH_OUT section. Independent of BENCH_MODEL.
+TP_OVERLAP = os.environ.get("BENCH_TP_OVERLAP", "") not in ("", "0")
 # BENCH_SCENARIOS=1: trace-driven scenario suite (dynamo_tpu/loadgen/,
 # docs/loadgen.md) — one seeded open-loop scenario per workload the
 # engine supports (chat, rag, shared-prefix, bursty+admission,
@@ -212,6 +221,14 @@ ENV_HELP = """bench.py — serving benchmark; configuration via env vars:
                                the `kv_capacity` BENCH_OUT section;
                                scripts/kv_capacity.py)
   BENCH_KV_CAPACITY_MB         census byte budget in MiB (64)
+  BENCH_TP_OVERLAP=1           TP comm/compute overlap ledger: per-layer
+                               step wall serialized-psum vs the ring
+                               executor + measured collective bytes
+                               (exposed EXACTLY 0.5x, total conserved)
+                               + greedy byte-identity vs tp=1 (adds the
+                               `tp_overlap` BENCH_OUT section; subprocess
+                               on 8 virtual CPU devices —
+                               scripts/tp_overlap_bench.py)
   BENCH_SCENARIOS=1            trace-driven scenario suite (adds the
                                `scenarios` BENCH_OUT section): seeded
                                open-loop traces replayed per workload
@@ -1274,6 +1291,39 @@ def main() -> None:
             ),
             file=_sys.stderr,
         )
+    tp_overlap_result = None
+    if TP_OVERLAP:
+        import subprocess
+        import sys as _sys
+
+        # subprocess: the section needs a fresh jax on 8 virtual CPU
+        # devices, and this process is already bound to the real backend
+        proc = subprocess.run(
+            [
+                _sys.executable,
+                os.path.join(
+                    os.path.dirname(os.path.abspath(__file__)),
+                    "scripts", "tp_overlap_bench.py",
+                ),
+            ],
+            capture_output=True, text=True, timeout=600,
+        )
+        if proc.returncode:
+            raise RuntimeError(
+                "tp_overlap bench failed (rc=%d):\n%s"
+                % (proc.returncode, proc.stderr[-4000:])
+            )
+        tp_overlap_result = json.loads(proc.stdout.splitlines()[-1])
+        print(
+            "tp_overlap: exposed_ratio={} wall serialized={}s "
+            "overlap={}s identical={}".format(
+                tp_overlap_result["exposed_ratio"],
+                tp_overlap_result["legs"]["serialized"]["layer_step_wall_s"],
+                tp_overlap_result["legs"]["overlap"]["layer_step_wall_s"],
+                tp_overlap_result["greedy_byte_identical_vs_tp1"],
+            ),
+            file=_sys.stderr,
+        )
     kv_capacity_result = None
     if KV_CAPACITY:
         import kv_capacity
@@ -1325,6 +1375,12 @@ def main() -> None:
                     # the margin-stable greedy token-match quality
                     # bound vs the f32-KV reference
                     "kv_capacity": kv_capacity_result,
+                    # BENCH_TP_OVERLAP=1: TP comm/compute overlap ledger
+                    # — serialized vs overlapped per-layer step wall +
+                    # the measured collective-byte ledger (exposed
+                    # exactly 0.5x, total conserved) + greedy
+                    # byte-identity vs tp=1
+                    "tp_overlap": tp_overlap_result,
                     # BENCH_SCENARIOS=1: the trace-driven scenario suite
                     # (dynamo_tpu/loadgen/) — {scale, results: {name:
                     # section}}, each section scored by SLO-gated

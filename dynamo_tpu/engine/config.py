@@ -132,6 +132,20 @@ class EngineConfig:
     # byte-identical on vs off. False restores the serialized
     # dispatch->fetch->sync steps (the A/B baseline).
     step_pipeline: bool = True
+    # TP comm/compute overlap (tp > 1 meshes): serve through the
+    # latency-hiding manual-TP layer executor (parallel/tp_overlap.py)
+    # — per-layer psums decomposed into ring reduce-scatter +
+    # matmul-fused all-gather with norms/residuals on the row-scattered
+    # view, halving EXPOSED collective bytes per layer (measured by the
+    # BENCH_TP_OVERLAP section). Greedy streams stay byte-identical to
+    # tp=1 (docs/parallelism.md documents the reduction-order
+    # invariant). Engines whose shapes the manual executor refuses
+    # (pallas serving backend, sp>1, pp>1 handled by the pipeline
+    # executor's own flag, quantized KV/weights, MoE) fall back to the
+    # GSPMD path with XLA's latency-hiding scheduler flags requested at
+    # init (logged once either way). Also feeds the collective_bytes /
+    # collective_wall_s phase counters the flight recorder digests.
+    tp_overlap: bool = False
     # admission batching window for PACED arrivals: when decode streams
     # are running and fewer than `prefill_batch_min_rows` sequences are
     # pending prefill, hold the prefill dispatch up to this many seconds

@@ -380,6 +380,63 @@ class JaxEngine:
                     f"by pp={mc.pp}"
                 )
 
+        # TP comm/compute overlap (EngineConfig.tp_overlap,
+        # docs/parallelism.md "TP comm/compute overlap"): prefer the
+        # latency-hiding manual-TP layer executor — per-layer psums
+        # decomposed into ring reduce-scatter + matmul-fused all-gather
+        # (parallel/tp_overlap.py), halving exposed collective bytes.
+        # The executor covers dense unquantized gather-backend tp-only
+        # meshes; pp>1 composes through the pipeline stage executor's
+        # own flag, and every other refused shape falls back to GSPMD
+        # with XLA's latency-hiding scheduler flags requested instead.
+        self._tp_overlap_manual = bool(
+            config.tp_overlap and mc.tp > 1 and tp_only
+            and not self._attn_pallas
+            and self._kv_quant is None
+            and not self.model_cfg.num_experts
+            and not config.quantization
+        )
+        if config.tp_overlap and mc.tp > 1 and not self._tp_overlap_manual:
+            if self._pp:
+                log.info(
+                    "tp_overlap: pp>1 — pipeline stage executor runs "
+                    "scattered-residual layers (ring collectives per "
+                    "stage, parallel/pipeline.py)"
+                )
+            else:
+                why = (
+                    "pallas attention backend" if self._attn_pallas
+                    else "sp>1 ring prefill" if self._sp
+                    else "quantized KV pools" if self._kv_quant
+                    else "MoE routing" if self.model_cfg.num_experts
+                    else "quantized weights" if config.quantization
+                    else "non-tp mesh axes"
+                )
+                added = []
+                if backend == "tpu":
+                    from dynamo_tpu.parallel.tp_overlap import (
+                        request_gspmd_overlap_flags,
+                    )
+
+                    added = request_gspmd_overlap_flags()
+                log.info(
+                    "tp_overlap: manual ring executor refused (%s) — "
+                    "GSPMD fallback%s",
+                    why,
+                    (
+                        f" with XLA overlap flags {added}"
+                        " (effective for computations compiled after this"
+                        " point; set them in the launch env to cover"
+                        " already-compiled executables)"
+                        if added else ""
+                    ),
+                )
+        elif self._tp_overlap_manual:
+            log.info(
+                "tp_overlap: manual ring executor is the serving path "
+                "(tp=%d, exposed collective bytes/layer halved)", mc.tp
+            )
+
         if params is None:
             if config.quantization and self._pp:
                 raise ValueError(
@@ -668,10 +725,42 @@ class JaxEngine:
             "prefix_reused_tokens": 0,
             "prefix_restored_tokens": 0,
             "prefix_tail_tokens": 0,
+            # per-layer TP collective attribution (tp>1 tp-only meshes;
+            # docs/parallelism.md "TP comm/compute overlap"): EXPOSED
+            # collective bytes per dispatch kind — the closed form
+            # behind the BENCH_TP_OVERLAP 0.5x invariant
+            # (tp_overlap.collective_bytes_per_layer) times the
+            # dispatch's physical token rows — plus collective_wall_s,
+            # those bytes over the init-time psum bandwidth probe (an
+            # ESTIMATE of the comm share of dispatch wall, not a device
+            # measurement; the flight recorder digests it as such).
+            "prefill_collective_bytes": 0,
+            "decode_collective_bytes": 0,
+            "spec_collective_bytes": 0,
+            "mixed_collective_bytes": 0,
+            "collective_wall_s": 0.0,
         }
         # updates run in worker threads outside _kv_lock (serving prefill
         # + concurrent prefill_only dispatches) — guard the RMWs
         self._phase_lock = threading.Lock()
+        # per-token exposed collective bytes across the layer stack (0
+        # when tp collectives are absent or owned by another executor:
+        # tp=1, sp ring prefill, pp stage rotation)
+        self._collective_tok_bytes = 0
+        self._collective_bps = 0.0
+        if mc.tp > 1 and tp_only:
+            from dynamo_tpu.parallel.tp_overlap import (
+                collective_bytes_per_layer,
+            )
+
+            self._collective_tok_bytes = (
+                self.model_cfg.num_layers * collective_bytes_per_layer(
+                    self.model_cfg.hidden_size, 1, mc.tp,
+                    itemsize=jnp.dtype(self._dtype).itemsize,
+                    overlap=self._tp_overlap_manual,
+                )
+            )
+            self._collective_bps = self._calibrate_collective_bw()
 
         # ---- fault-tolerance spine (docs/robustness.md) ----
         faults.load_env()  # arm DYN_FAULTS points (no-op when unset)
@@ -1106,6 +1195,59 @@ class JaxEngine:
     # ------------------------------------------------------------------
     # compiled steps
 
+    def _calibrate_collective_bw(self) -> float:
+        """Init-time bandwidth probe for the collective_wall_s estimate:
+        best-of-3 wall of a jitted tp psum on this mesh (1 MiB/shard —
+        large enough to dominate launch overhead, small enough to be
+        free at init), converted to achieved bytes/s via the ring
+        all-reduce wire formula. 0.0 on any failure — the byte counters
+        stay exact; only the wall estimate goes dark."""
+        try:
+            tp = self.config.mesh.tp
+            chunk = 64 * 1024  # f32 elements per shard
+            P = jax.sharding.PartitionSpec
+            fn = jax.jit(compat.shard_map(
+                lambda a: jax.lax.psum(a, "tp"), mesh=self.mesh,
+                in_specs=P("tp"), out_specs=P(), check_vma=False,
+            ))
+            x = jnp.zeros((tp * chunk,), jnp.float32)
+            jax.block_until_ready(fn(x))  # compile outside the timing
+            best = float("inf")
+            for _ in range(3):
+                t0 = time.perf_counter()
+                jax.block_until_ready(fn(x))
+                best = min(best, time.perf_counter() - t0)
+            moved = 2 * (tp - 1) * chunk * 4 // tp  # wire bytes/device
+            return moved / best if best > 0 else 0.0
+        except Exception:
+            log.warning(
+                "collective bandwidth probe failed; collective_wall_s "
+                "estimates disabled", exc_info=True,
+            )
+            return 0.0
+
+    def _note_collectives(self, kind: str, rows: int, t_end: float) -> None:
+        """Attribute one dispatch's per-layer TP collective traffic:
+        exposed bytes (closed form x physical token rows through the
+        layer stack, padding included — the wire moves padded rows too)
+        into the per-kind counter, plus the bandwidth-probe wall
+        estimate and an `engine.collective` sub-span at the dispatch
+        tail (an estimated comm window inside the step span, not a
+        device-measured interval)."""
+        if not self._collective_tok_bytes or rows <= 0:
+            return
+        nbytes = self._collective_tok_bytes * rows
+        est = nbytes / self._collective_bps if self._collective_bps else 0.0
+        with self._phase_lock:
+            self._phase_stats[f"{kind}_collective_bytes"] += nbytes
+            self._phase_stats["collective_wall_s"] += est
+        if est and tracing.enabled():
+            tracing.complete(
+                "engine.collective", t_end - est, t_end, cat="collective",
+                track="engine.collective", kind=kind, bytes=int(nbytes),
+                overlap=self._tp_overlap_manual,
+            )
+
     def _pp_forward(self, params, kv, tokens, positions, write_slots,
                     slot_matrix):
         """pp>1 forward: GPipe stage executor over stacked stage-local
@@ -1119,8 +1261,37 @@ class JaxEngine:
         hidden, (k_st, v_st) = pp_forward(
             params, self.model_cfg, tokens, positions, k_st, v_st,
             write_slots.reshape(b, t), slot_matrix, self.mesh, 1,
+            tp_overlap=self.config.tp_overlap,
         )
         return hidden, (k_st, v_st)
+
+    def _forward(self, params, kv, tokens, positions, write_slots, attn,
+                 embeds=None, embeds_mask=None):
+        """llama.forward, rerouted through the latency-hiding manual-TP
+        executor on engines that selected it. The executor serves plain
+        gather dispatches (every dispatch kind on a gather-backend
+        tp-only engine); any other AttnSpec shape reaching here keeps
+        the classic path — belt-and-suspenders, init gating should have
+        excluded those engines already."""
+        if (
+            self._tp_overlap_manual
+            and attn.slot_matrix is not None
+            and attn.block_tables is None
+            and attn.write_tables is None
+            and not attn.ring
+        ):
+            from dynamo_tpu.parallel.tp_overlap import tp_overlap_forward
+
+            return tp_overlap_forward(
+                params, self.model_cfg, tokens, positions, kv,
+                write_slots, attn.slot_matrix, self.mesh,
+                page_size=attn.page_size, q_lens=attn.lengths,
+                embeds=embeds, embeds_mask=embeds_mask,
+            )
+        return llama.forward(
+            params, self.model_cfg, tokens, positions, kv, write_slots,
+            attn, embeds=embeds, embeds_mask=embeds_mask,
+        )
 
     def _model_step(self, params, kv, tokens, positions, write_slots, slot_matrix,
                     last_idx, temp, topk, topp, key, wtables=None,
@@ -1189,8 +1360,8 @@ class JaxEngine:
                 kv_tp=self.config.mesh.tp,
                 int4_groups=self._kv_int4_groups,
             )
-        hidden, kv = llama.forward(
-            params, self.model_cfg, tokens, positions, kv, write_slots, attn,
+        hidden, kv = self._forward(
+            params, kv, tokens, positions, write_slots, attn,
             embeds=embeds, embeds_mask=embeds_mask,
         )
         last_h = jnp.take_along_axis(
@@ -1307,9 +1478,9 @@ class JaxEngine:
                     wslots, smat,
                 )
             else:
-                hidden, kv = llama.forward(
-                    params, self.model_cfg, tokens[:, None], positions[:, None],
-                    kv, wslots, attn,
+                hidden, kv = self._forward(
+                    params, kv, tokens[:, None], positions[:, None],
+                    wslots, attn,
                 )
             lg = llama.logits(params, self.model_cfg, hidden[:, 0])
 
@@ -1422,9 +1593,8 @@ class JaxEngine:
                 smat, page_size=s, kv_tp=self.config.mesh.tp,
                 int4_groups=self._kv_int4_groups,
             )
-        hidden, kv = llama.forward(
-            params, self.model_cfg, tokens, positions, kv,
-            wslots.reshape(-1), attn,
+        hidden, kv = self._forward(
+            params, kv, tokens, positions, wslots.reshape(-1), attn,
         )
         lg = llama.logits(params, self.model_cfg, hidden)  # [B, T, V]
         out, n_emit = verify_draft_tokens(
@@ -1510,9 +1680,8 @@ class JaxEngine:
                 lengths=last_idx + 1, kv_tp=self.config.mesh.tp,
                 int4_groups=self._kv_int4_groups,
             )
-        hidden, kv = llama.forward(
-            params, self.model_cfg, tokens, positions, kv,
-            wslots.reshape(-1), attn,
+        hidden, kv = self._forward(
+            params, kv, tokens, positions, wslots.reshape(-1), attn,
         )
 
         def _scatter_carry(vals):
@@ -3064,6 +3233,7 @@ class JaxEngine:
             self._phase_stats["prefill_dispatch_s"] += now - t_dispatch0
             self._phase_stats["prefill_dispatches"] += 1
             self._phase_stats["prefill_tokens"] += n_tok
+        self._note_collectives("prefill", len(seqs) * bucket, now)
         self._flight_record(
             "prefill", now - t_dispatch0, rows=len(seqs), tokens=n_tok,
         )
@@ -3703,6 +3873,10 @@ class JaxEngine:
         t1 = time.perf_counter()
         with self._phase_lock:
             self._phase_stats["mixed_dispatch_s"] += t1 - t0
+        # physical rows: every hot row x its chunk width flows the stack
+        self._note_collectives(
+            "mixed", int(bld["hot"].shape[1] * bld["hot"].shape[2]), t1
+        )
         self._flight_record(
             "mixed", t1 - t0, rows=len(bld["entries"]),
             tokens=sum(e[3] for e in bld["entries"]),
@@ -4084,6 +4258,9 @@ class JaxEngine:
             with self._phase_lock:
                 self._phase_stats["spec_dispatch_s"] += t1 - t0
                 self._phase_stats["spec_dispatches"] += 1
+            self._note_collectives(
+                "spec", int(np.asarray(bld.tokens).size), t1
+            )
             self._flight_record(
                 "spec_verify", t1 - t0, rows=rows, tokens=n_tok,
             )
@@ -4101,6 +4278,10 @@ class JaxEngine:
             # includes the <= steps-1 overshoot positions of rows that
             # finish mid-scan, so this bounds emitted tokens from above
             self._phase_stats["decode_tokens"] += n_tok
+        # physical rows: the scan runs the FULL padded batch every step
+        self._note_collectives(
+            "decode", int(bld.pos_act.shape[0]) * bld.steps, t1
+        )
         self._flight_record("decode", t1 - t0, rows=rows, tokens=n_tok)
         if tracing.enabled():
             tracing.complete(

@@ -283,8 +283,16 @@ def _attn_block(
     kv_vs=None,
     tp_axis=None,  # set when running INSIDE a shard_map (manual tp):
     # row-parallel projections then need an explicit psum
+    tp_overlap: bool = False,  # latency-hiding manual tp (requires
+    # tp_axis): x arrives ROW-SCATTERED [R/tp, D]; qkv ride the
+    # all-gather-fused ring matmuls and the output projection ends in a
+    # ring reduce-scatter instead of a psum (parallel/tp_overlap.py)
+    bt_shape=None,  # static (b, t) — scattered x has no batch/time axes
 ):
-    b, t, _ = x.shape
+    if tp_overlap:
+        b, t = bt_shape
+    else:
+        b, t, _ = x.shape
     h, kh, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
     if tp_axis is not None:
         # manual tp: this shard holds its local slice of the heads
@@ -330,9 +338,22 @@ def _attn_block(
         kv_k, kv_v = write_kv_slots(kv_k, kv_v, write_slots, kr, vr)
         return kv_k, kv_v, kv_ks, kv_vs
 
-    q = mm(x, lp["wq"])
-    k = mm(x, lp["wk"])
-    v = mm(x, lp["wv"])
+    if tp_overlap:
+        # one gather ring serves all three projections: x's row chunks
+        # circulate over ICI while the resident chunk multiplies into
+        # the local head shards — the all-gather half of the decomposed
+        # psum never runs as a standalone collective
+        from dynamo_tpu.parallel import tp_overlap as _ov
+
+        q, k, v = _ov.ring_ag_matmul(
+            x, (lp["wq"], lp["wk"], lp["wv"]), tp_axis
+        )
+        # drop the ring's row padding; attention never sees pad rows
+        q, k, v = q[: b * t], k[: b * t], v[: b * t]
+    else:
+        q = mm(x, lp["wq"])
+        k = mm(x, lp["wk"])
+        v = mm(x, lp["wv"])
     if cfg.attn_bias:
         q = q + lp["bq"]
         k = k + lp["bk"]
@@ -679,8 +700,18 @@ def _attn_block(
                 int4_groups=attn.int4_groups or None,
             )
     proj = mm(out.reshape(b, t, h * hd), lp["wo"])
-    if tp_axis is not None:
-        proj = jax.lax.psum(proj, tp_axis)
+    if tp_overlap:
+        # decomposed psum, half 1: ring reduce-scatter back to the
+        # row-scattered residual view (the all-gather half rides the
+        # next layer segment's ring matmuls)
+        from dynamo_tpu.parallel import tp_overlap as _ov
+
+        proj = _ov.pad_rows(proj.reshape(b * t, -1), tpn)
+        proj = _ov.ring_reduce_scatter(proj, tp_axis)
+    elif tp_axis is not None:
+        from dynamo_tpu.parallel.tp_overlap import psum_allreduce
+
+        proj = psum_allreduce(proj, tp_axis)
     return proj, kv_k, kv_v, kv_ks, kv_vs
 
 
@@ -692,13 +723,28 @@ _ACTIVATIONS = {
 
 
 def _mlp_block(
-    lp: Params, x: jnp.ndarray, tp_axis=None, act: str = "silu"
+    lp: Params, x: jnp.ndarray, tp_axis=None, act: str = "silu",
+    tp_overlap: bool = False,
 ) -> jnp.ndarray:
+    if tp_overlap:
+        # x is row-scattered [R/tp, D]; gate/up share one gather ring
+        # (chunk i's matmuls run while chunk i+1 is on the wire) and the
+        # down projection ends in a ring reduce-scatter, returning the
+        # scattered view for the residual add
+        from dynamo_tpu.parallel import tp_overlap as _ov
+
+        gate, up = _ov.ring_ag_matmul(
+            x, (lp["w_gate"], lp["w_up"]), tp_axis
+        )
+        out = mm(_ACTIVATIONS[act](gate) * up, lp["w_down"])
+        return _ov.ring_reduce_scatter(out, tp_axis)
     gate = _ACTIVATIONS[act](mm(x, lp["w_gate"]))
     up = mm(x, lp["w_up"])
     out = mm(gate * up, lp["w_down"])
     if tp_axis is not None:
-        out = jax.lax.psum(out, tp_axis)
+        from dynamo_tpu.parallel.tp_overlap import psum_allreduce
+
+        out = psum_allreduce(out, tp_axis)
     return out
 
 
@@ -775,18 +821,25 @@ def forward(
 
 def layer_step(lp, cfg, x, cos, sin, kv_k, kv_v, write_slots, attn,
                positions, real_mask=None, kv_ks=None, kv_vs=None,
-               tp_axis=None):
+               tp_axis=None, tp_overlap: bool = False, bt_shape=None):
     """One transformer layer (attention + FFN, pre-norm residuals) over
     the paged pools — shared by `forward` and the pipeline-parallel
     stage executor (parallel/pipeline.py). `tp_axis` enables manual-tp
     semantics for use inside a shard_map (explicit psums after the
-    row-parallel projections). kv_ks/kv_vs are the int8-KV scale pools
-    (None in unquantized mode; returned as-is)."""
+    row-parallel projections). `tp_overlap` (with `tp_axis` and the
+    static `bt_shape=(b, t)`) is the latency-hiding variant: x arrives
+    and leaves ROW-SCATTERED [ceil(b*t/tp), D] — norms and residual
+    adds run on the scattered view and every collective is a chunked
+    `lax.ppermute` ring (parallel/tp_overlap.py). kv_ks/kv_vs are the
+    int8-KV scale pools (None in unquantized mode; returned as-is)."""
+    if tp_overlap and cfg.num_experts:
+        raise ValueError("tp_overlap layer executor covers dense models")
     w_off = cfg.norm_weight_offset
     attn_in = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps, weight_offset=w_off)
     attn_out, kv_k, kv_v, kv_ks, kv_vs = _attn_block(
         lp, cfg, attn_in, cos, sin, kv_k, kv_v, write_slots, attn, positions,
         kv_ks=kv_ks, kv_vs=kv_vs, tp_axis=tp_axis,
+        tp_overlap=tp_overlap, bt_shape=bt_shape,
     )
     x = x + attn_out
     mlp_in = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps, weight_offset=w_off)
@@ -795,7 +848,10 @@ def layer_step(lp, cfg, x, cos, sin, kv_k, kv_v, write_slots, attn,
 
         x = x + moe_block(lp, cfg, mlp_in, real_mask=real_mask)
     else:
-        x = x + _mlp_block(lp, mlp_in, tp_axis=tp_axis, act=cfg.hidden_act)
+        x = x + _mlp_block(
+            lp, mlp_in, tp_axis=tp_axis, act=cfg.hidden_act,
+            tp_overlap=tp_overlap,
+        )
     return x, kv_k, kv_v, kv_ks, kv_vs
 
 

@@ -86,6 +86,24 @@ def validate_model_mesh(cfg: ModelConfig, mc: MeshConfig) -> None:
             f"model '{cfg.name}' has num_heads={cfg.num_heads}, which is "
             f"not divisible by tp={mc.tp}"
         )
+    # the row-parallel projections shard their INPUT dim over tp (wo:
+    # [q_size, hidden] -> psum; w_down: [intermediate, hidden]); a
+    # non-divisible width would mis-shard them silently under GSPMD
+    # (uneven padding shards) and break the manual-TP ring executor's
+    # even row blocks outright
+    if cfg.hidden_size % mc.tp:
+        raise ValueError(
+            f"model '{cfg.name}' has hidden_size={cfg.hidden_size}, which "
+            f"is not divisible by tp={mc.tp}; choose tp from the divisors "
+            f"of {cfg.hidden_size}"
+        )
+    if cfg.intermediate_size % mc.tp:
+        raise ValueError(
+            f"model '{cfg.name}' has intermediate_size="
+            f"{cfg.intermediate_size}, which is not divisible by "
+            f"tp={mc.tp}; choose tp from the divisors of "
+            f"{cfg.intermediate_size}"
+        )
     if mc.ep > 1 and cfg.num_experts % mc.ep:
         raise ValueError(
             f"model '{cfg.name}' has num_experts={cfg.num_experts}, which "

@@ -96,8 +96,18 @@ def pp_forward(
     slot_matrix: jnp.ndarray,   # [B, C]
     mesh,
     n_microbatches: int = 2,
+    tp_overlap: bool = False,
 ):
-    """Returns (hidden [B, T, D] after final norm, (k_pool, v_pool))."""
+    """Returns (hidden [B, T, D] after final norm, (k_pool, v_pool)).
+
+    `tp_overlap` (tp > 1 meshes): run each stage's layers in the
+    latency-hiding manual-tp mode (parallel/tp_overlap.py) — the
+    residual stream stays ROW-SCATTERED over tp across the whole
+    schedule, including the stage-to-stage ppermute rotation (which then
+    carries 1/tp of the activation bytes), so a stage's collectives are
+    two ring reduce-scatters per layer instead of two all-reduces and
+    nothing re-gathers until the out_specs reassembly (layout, not a
+    collective)."""
     if cfg.num_experts:
         raise NotImplementedError("pp v1 covers dense models")
     b = tokens.shape[0]
@@ -105,6 +115,8 @@ def pp_forward(
     if b % m:
         raise ValueError(f"batch {b} not divisible by {m} microbatches")
     pp = mesh.shape["pp"]
+    tpn = mesh.shape.get("tp", 1)
+    overlap = tp_overlap and tpn > 1
 
     x = params["embed"][tokens]
     if cfg.scale_embeddings:  # gemma: sqrt(d)-scaled embedding outputs
@@ -124,9 +136,25 @@ def pp_forward(
     P = _P
     layer_specs = {k: LAYER_SPECS[k] for k in params["layers"]}
 
+    t = tokens.shape[1]
+    mb_rows = mb * t
+    rows_p = -(-mb_rows // tpn) * tpn  # ring-padded rows per microbatch
+
     def stage_prog(layers_local, k_local, v_local, x_mb, cos_mb, sin_mb,
                    pos_mb, ws_mb, sm_mb):
         stage = jax.lax.axis_index("pp")
+        if overlap:
+            from dynamo_tpu.parallel import tp_overlap as _ov
+
+            # scatter every microbatch's flattened rows over tp once,
+            # up front: [M, mb, T, D] -> [M, rows_p/tp, D] per shard
+            tp_idx = jax.lax.axis_index("tp")
+            xf = x_mb.reshape(m, mb_rows, x_mb.shape[-1])
+            if rows_p != mb_rows:
+                xf = jnp.pad(xf, ((0, 0), (0, rows_p - mb_rows), (0, 0)))
+            x_mb = jax.lax.dynamic_slice_in_dim(
+                xf, tp_idx * (rows_p // tpn), rows_p // tpn, axis=1
+            )
 
         def run_stage(x_in, cos1, sin1, ws1, sm1, pos1, k_local, v_local):
             def body(x, xs):
@@ -134,7 +162,8 @@ def pp_forward(
                 x, kvk, kvv, _, _ = llama.layer_step(
                     lp, cfg, x, cos1, sin1, kvk, kvv,
                     ws1.reshape(-1), llama.AttnSpec.gather(sm1), pos1,
-                    tp_axis="tp",
+                    tp_axis="tp", tp_overlap=overlap,
+                    bt_shape=(mb, t) if overlap else None,
                 )
                 return x, (kvk, kvv)
 
@@ -183,11 +212,18 @@ def pp_forward(
             layer_specs, P("pp", None, "tp"), P("pp", None, "tp"),
             P(), P(), P(), P(), P(), P(),
         ),
-        out_specs=(P(), P("pp", None, "tp"), P("pp", None, "tp")),
+        out_specs=(
+            # overlap keeps the banked outputs row-scattered; the spec
+            # reassembles the global [M, rows_p, D] for free
+            P(None, "tp", None) if overlap else P(),
+            P("pp", None, "tp"), P("pp", None, "tp"),
+        ),
         check_vma=False,
     )(params["layers"], k_pool, v_pool, x_mb, cos_mb, sin_mb,
       pos_mb, ws_mb, sm_mb)
 
+    if overlap:
+        outs = outs[:, :mb_rows].reshape(m, mb, t, outs.shape[-1])
     hidden = outs.reshape(b, *outs.shape[2:])
     hidden = rms_norm(
         hidden, params["final_norm"], cfg.rms_norm_eps,

@@ -1,0 +1,475 @@
+"""Latency-hiding manual-TP layer executor: reduce-scatter residual
+stream + software-pipelined ring collectives.
+
+The GSPMD tp path pays two serialized full-width all-reduces per layer
+(models/llama.py after `wo` and after `w_down`) during which the MXU
+sits idle. This module removes that stall with the Megatron-style
+sequence-parallel decomposition (Korthikanti et al., 2022) plus the
+Wang et al. 2023 chunked-collective overlap:
+
+- **Reduce-scatter residual stream.** Each per-layer `psum` splits into
+  reduce-scatter + all-gather; the residual add and RMS-norm between
+  them run on the SCATTERED view (activation rows — batch*tokens —
+  sharded over tp), so the replicated-activation window between the two
+  projections disappears. Rows shard over tp for decode/mixed steps and
+  over tokens for prefill chunks — both are the same flattened
+  [B*T, D] row axis, which is what the executor scatters.
+- **Software-pipelined rings.** The all-gather half never runs as a
+  standalone collective: it rides `ring_ag_matmul`, a `lax.ppermute`
+  ring interleaved with slices of the next column-parallel matmul
+  (wq/wk/wv, w_gate/w_up) — chunk i's matmul runs while chunk i+1 is on
+  the wire (the permute for step i+1 is issued BEFORE step i's matmuls,
+  which is what lets the latency-hiding scheduler overlap them). The
+  reduce-scatter half runs as a chunked `lax.ppermute` ring too
+  (`ring_reduce_scatter`), so no collective in the layer is a
+  full-width blocking all-reduce.
+
+Byte accounting (the bench's 0.5x invariant, docs/parallelism.md):
+ring RS+AG moves the SAME total wire bytes as a ring all-reduce —
+2(n-1)/n * S per device either way; sequence parallelism adds no
+communication. What halves is the EXPOSED bytes: the traffic of
+standalone collectives on the critical path. The overlap executor
+exposes only the two reduce-scatters ((n-1)/n * S each) — the
+all-gather halves ride the column-matmul rings as overlapped traffic —
+so exposed bytes per layer read exactly 0.5x the baseline's two
+all-reduces. `CollectiveLedger` measures both kinds off the traced
+collectives; `collective_bytes_per_layer` is the closed-form the engine
+counters and the bench invariant use.
+
+FP reduction-order invariant (greedy byte-identity): the rings chunk
+only the activation ROW axis, never the matmul contraction axis, so
+every per-shard partial product is bitwise identical to the serialized
+manual-TP path. Cross-shard summation order differs (the RS ring
+accumulates block j in cyclic order j+1, .., j-1, j; psum's order is
+XLA's choice) — exactly the class of difference the GSPMD tp path
+already carries vs tp=1 — and greedy streams stay byte-identical to
+tp=1 (gated by scripts/multichip_smoke.py and the tp_overlap bench).
+
+Composition matrix (docs/parallelism.md "TP comm/compute overlap"):
+composes with mixed batching, the step pipeline, spec decode and the
+pipeline stage executor (parallel/pipeline.py takes `tp_overlap=True`);
+refuses — engine falls back to GSPMD + XLA latency-hiding flags — on
+the pallas serving backend (its shard_maps own the per-layer layout),
+sp>1 ring prefill, quantized KV pools and MoE routing (v1).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from dynamo_tpu import compat
+from dynamo_tpu.ops.norm import rms_norm
+from dynamo_tpu.ops.quant import mm
+from dynamo_tpu.ops.rope import rope_cos_sin, rope_inv_freq
+
+_P = jax.sharding.PartitionSpec
+
+
+# ---------------------------------------------------------------------------
+# collective-bytes ledger
+# ---------------------------------------------------------------------------
+
+
+class CollectiveLedger:
+    """Trace-time wire-byte meter for the manual-TP collectives.
+
+    The ring primitives below (and `psum_allreduce`, the serialized
+    baseline's all-reduce spelling) add their per-device wire bytes here
+    WHILE THEY TRACE — chunk shapes are static, so the counts are
+    measured off the actual collectives in the jaxpr, not re-derived
+    from a formula. `exposed` counts standalone collectives on the
+    critical path (all-reduce, reduce-scatter); `overlapped` counts
+    traffic hidden under matmul slices (the ring-AG-fused gathers).
+    Arm with `record_collectives()` around the TRACING call (a jit
+    cache hit re-traces nothing and records nothing).
+    """
+
+    def __init__(self):
+        self.exposed = 0
+        self.overlapped = 0
+
+    @property
+    def total(self) -> int:
+        return self.exposed + self.overlapped
+
+
+_ledger: CollectiveLedger | None = None
+
+
+class record_collectives:
+    """Context manager arming a fresh CollectiveLedger (module-global:
+    tracing is single-threaded per process in practice, and the bench
+    arms it only around one-shot trace calls)."""
+
+    def __enter__(self) -> CollectiveLedger:
+        global _ledger
+        self._prev = _ledger
+        _ledger = CollectiveLedger()
+        return _ledger
+
+    def __exit__(self, *exc):
+        global _ledger
+        _ledger = self._prev
+        return False
+
+
+def _note(kind: str, nbytes: int) -> None:
+    if _ledger is not None:
+        setattr(_ledger, kind, getattr(_ledger, kind) + int(nbytes))
+
+
+def collective_bytes_per_layer(
+    hidden_size: int, rows: int, tp: int, itemsize: int = 4,
+    overlap: bool = False,
+) -> int:
+    """Closed-form EXPOSED per-layer collective bytes per device.
+
+    Baseline: two ring all-reduces of the [rows, hidden] residual tensor
+    (2(n-1)/n * S wire bytes each). Overlap: two ring reduce-scatters
+    ((n-1)/n * S each) — the all-gather halves ride the column-matmul
+    rings and count as overlapped, not exposed. The ratio is exactly
+    0.5 for every tp > 1; total wire bytes are conserved (sequence
+    parallelism adds no communication, it re-schedules it)."""
+    if tp <= 1:
+        return 0
+    s = rows * hidden_size * itemsize
+    per_rs = (tp - 1) * s // tp
+    return 2 * (2 * per_rs if not overlap else per_rs)
+
+
+def psum_allreduce(x: jnp.ndarray, axis_name) -> jnp.ndarray:
+    """The serialized manual-TP all-reduce, routed through the ledger:
+    ring all-reduce wire bytes are 2(n-1)/n * S per device."""
+    n = compat.axis_size(axis_name)
+    if n > 1:
+        _note("exposed", 2 * (n - 1) * x.size * x.dtype.itemsize // n)
+    return jax.lax.psum(x, axis_name)
+
+
+# XLA latency-hiding scheduler / async-collective flags for the GSPMD
+# fallback path (engines whose shapes the manual executor refuses).
+# These are the TPU-backend scheduler knobs that let XLA overlap its own
+# GSPMD-inserted collectives with adjacent compute — the flag-level
+# sibling of what the ring executor does by construction.
+_XLA_OVERLAP_FLAGS = (
+    "--xla_tpu_enable_latency_hiding_scheduler=true",
+    "--xla_tpu_enable_async_collective_fusion=true",
+    "--xla_tpu_enable_async_collective_fusion_fuse_all_gather=true",
+    "--xla_tpu_enable_async_collective_fusion_multiple_steps=true",
+    "--xla_tpu_overlap_compute_collective_tc=true",
+)
+
+
+def request_gspmd_overlap_flags() -> list[str]:
+    """Append the latency-hiding flags to XLA_FLAGS (TPU backends only —
+    callers gate on backend; the CPU XLA rejects unknown TPU flags).
+    Flags already present (any value) are left untouched so an explicit
+    launch-env choice wins. Returns the flags newly added; XLA reads the
+    env at compile time, so they cover executables compiled after this
+    call — engine init runs before any step function compiles."""
+    import os
+
+    cur = os.environ.get("XLA_FLAGS", "")
+    added = [f for f in _XLA_OVERLAP_FLAGS if f.split("=")[0] not in cur]
+    if added:
+        os.environ["XLA_FLAGS"] = " ".join([cur, *added]).strip()
+    return added
+
+
+# ---------------------------------------------------------------------------
+# ring primitives
+# ---------------------------------------------------------------------------
+
+
+def _ring_perm(n: int) -> list[tuple[int, int]]:
+    return [(i, (i + 1) % n) for i in range(n)]
+
+
+def ring_all_gather(x: jnp.ndarray, axis_name) -> jnp.ndarray:
+    """Chunked ppermute ring all-gather over the leading axis:
+    bit-identical to `lax.all_gather(..., tiled=True)` (pure data
+    movement, no arithmetic). Standalone spelling — counts as EXPOSED;
+    the layer executor prefers `ring_ag_matmul`, which hides the same
+    traffic under matmul slices."""
+    n = compat.axis_size(axis_name)
+    if n == 1:
+        return x
+    idx = jax.lax.axis_index(axis_name)
+    m = x.shape[0]
+    perm = _ring_perm(n)
+    _note("exposed", (n - 1) * x.size * x.dtype.itemsize)
+    out = jnp.zeros((n * m,) + x.shape[1:], x.dtype)
+    out = jax.lax.dynamic_update_slice_in_dim(out, x, idx * m, axis=0)
+    chunk = x
+    for step in range(1, n):
+        chunk = jax.lax.ppermute(chunk, axis_name, perm)
+        src = (idx - step) % n
+        out = jax.lax.dynamic_update_slice_in_dim(
+            out, chunk, src * m, axis=0
+        )
+    return out
+
+
+def ring_ag_matmul(
+    x: jnp.ndarray, weights: tuple, axis_name,
+) -> list[jnp.ndarray]:
+    """All-gather-fused column-parallel matmuls: gather the row-scattered
+    activation `x` [m, D] around the ring WHILE each shard multiplies the
+    resident chunk into its local weight shards ([D, F/n] each).
+
+    One gather ring serves every weight in `weights` (wq/wk/wv share a
+    ring, w_gate/w_up share a ring). The permute for chunk i+1 is issued
+    BEFORE chunk i's matmuls — the double-buffered shape the
+    latency-hiding scheduler overlaps; on backends that run it
+    sequentially the result is the same bits, just unhidden.
+
+    Returns full-row outputs [n*m, F/n], one per weight, each block
+    bitwise identical to `all_gather(x) @ w` — the ring splits only the
+    row axis, never the contraction axis, so no summation is reordered.
+    """
+    n = compat.axis_size(axis_name)
+    if n == 1:
+        return [mm(x, w) for w in weights]
+    idx = jax.lax.axis_index(axis_name)
+    m = x.shape[0]
+    perm = _ring_perm(n)
+    _note("overlapped", (n - 1) * x.size * x.dtype.itemsize)
+    outs = None
+    chunk = x
+    for step in range(n):
+        # issue the send first: chunk i+1 is on the wire during chunk
+        # i's matmuls (the overlap this module exists for)
+        nxt = (
+            jax.lax.ppermute(chunk, axis_name, perm)
+            if step < n - 1 else None
+        )
+        src = (idx - step) % n
+        ys = [mm(chunk, w) for w in weights]
+        if outs is None:
+            outs = [
+                jnp.zeros((n * m,) + y.shape[1:], y.dtype) for y in ys
+            ]
+        outs = [
+            jax.lax.dynamic_update_slice_in_dim(o, y, src * m, axis=0)
+            for o, y in zip(outs, ys)
+        ]
+        chunk = nxt
+    return outs
+
+
+def ring_reduce_scatter(y: jnp.ndarray, axis_name) -> jnp.ndarray:
+    """Chunked ppermute ring reduce-scatter over the leading axis:
+    [n*m, ...] partial sums in, [m, ...] fully-reduced block `idx` out.
+    Block j accumulates in cyclic shard order j+1, .., j-1, j — the
+    documented cross-shard reduction order (see module docstring)."""
+    n = compat.axis_size(axis_name)
+    if n == 1:
+        return y
+    idx = jax.lax.axis_index(axis_name)
+    m = y.shape[0] // n
+    perm = _ring_perm(n)
+    _note("exposed", (n - 1) * y.size * y.dtype.itemsize // n)
+
+    def blk(j):
+        return jax.lax.dynamic_slice_in_dim(y, j * m, m, axis=0)
+
+    acc = blk((idx - 1) % n)
+    for step in range(1, n):
+        acc = jax.lax.ppermute(acc, axis_name, perm)
+        acc = acc + blk((idx - 1 - step) % n)
+    return acc
+
+
+def scatter_rows(x: jnp.ndarray, axis_name) -> jnp.ndarray:
+    """Slice this shard's row block out of a replicated [n*m, ...] array
+    (free under shard_map — no collective)."""
+    n = compat.axis_size(axis_name)
+    if n == 1:
+        return x
+    idx = jax.lax.axis_index(axis_name)
+    m = x.shape[0] // n
+    return jax.lax.dynamic_slice_in_dim(x, idx * m, m, axis=0)
+
+
+def pad_rows(x: jnp.ndarray, tp: int) -> jnp.ndarray:
+    """Zero-pad the leading (row) axis to a tp multiple so it scatters
+    evenly. Zero rows are inert through norms and matmuls; callers slice
+    the real rows back after the final gather."""
+    r = x.shape[0]
+    rp = -(-r // tp) * tp
+    if rp == r:
+        return x
+    return jnp.pad(x, ((0, rp - r),) + ((0, 0),) * (x.ndim - 1))
+
+
+# ---------------------------------------------------------------------------
+# whole-forward shard_map wrapper
+# ---------------------------------------------------------------------------
+
+
+def _layer_in_specs(layers: list[dict]) -> list[dict]:
+    """Per-layer PartitionSpecs matching parallel/mesh.param_shardings —
+    the shard_map in_specs must agree with the GSPMD placement so entry
+    is a no-op reslice, not a reshard."""
+    col, row = _P(None, "tp"), _P("tp", None)
+    spec = {
+        "attn_norm": _P(), "mlp_norm": _P(),
+        "wq": col, "wk": col, "wv": col, "wo": row,
+        "w_gate": col, "w_up": col, "w_down": row,
+        "bq": _P("tp"), "bk": _P("tp"), "bv": _P("tp"),
+    }
+    return [{k: spec[k] for k in lp} for lp in layers]
+
+
+def single_layer_executor(
+    cfg, mesh, b: int, t: int, page_size: int = 16, overlap: bool = True,
+):
+    """One `layer_step` under shard_map — the bench/test harness behind
+    the tp_overlap BENCH_OUT section's serialized-vs-overlapped per-layer
+    wall and its amortization-free measured byte ratio.
+
+    The overlap leg returns the residual STILL SCATTERED (out_spec
+    P('tp', None) reassembles the global [Rp, D] for free — shard_map
+    concatenation is layout, not a collective), so a
+    `record_collectives()` armed around this trace sees EXACTLY one
+    layer's collectives: two ring reduce-scatters exposed + the two
+    matmul-ring gathers overlapped, against the serialized leg's two
+    all-reduces. Returns a fresh jitted callable
+    `(lp, kv_k, kv_v, x, cos, sin, write_slots, slot_matrix, positions)
+    -> (x_out, kv_k, kv_v)`; callers slice `[:b*t]` and reshape the
+    overlap leg's rows."""
+    from dynamo_tpu.models import llama
+
+    tp = mesh.shape["tp"]
+
+    def prog(lp, kv_k, kv_v, x, cos, sin, ws, sm, pos):
+        attn = llama.AttnSpec.gather(sm, page_size=page_size)
+        if overlap:
+            xs = scatter_rows(pad_rows(x.reshape(b * t, -1), tp), "tp")
+            xs, kv_k, kv_v, _, _ = llama.layer_step(
+                lp, cfg, xs, cos, sin, kv_k, kv_v, ws, attn, pos,
+                tp_axis="tp", tp_overlap=True, bt_shape=(b, t),
+            )
+        else:
+            xs, kv_k, kv_v, _, _ = llama.layer_step(
+                lp, cfg, x, cos, sin, kv_k, kv_v, ws, attn, pos,
+                tp_axis="tp",
+            )
+        return xs, kv_k, kv_v
+
+    def run(lp, kv_k, kv_v, x, cos, sin, ws, sm, pos):
+        return compat.shard_map(
+            prog,
+            mesh=mesh,
+            in_specs=(
+                _layer_in_specs([lp])[0], _P(None, "tp"), _P(None, "tp"),
+                _P(), _P(), _P(), _P(), _P(), _P(),
+            ),
+            out_specs=(
+                _P("tp", None) if overlap else _P(),
+                _P(None, "tp"), _P(None, "tp"),
+            ),
+            check_vma=False,
+        )(lp, kv_k, kv_v, x, cos, sin, ws, sm, pos)
+
+    return jax.jit(run)
+
+
+def tp_overlap_forward(
+    params: dict,
+    cfg,                        # ModelConfig
+    tokens: jnp.ndarray,        # [B, T] int32
+    positions: jnp.ndarray,     # [B, T] int32
+    kv,                         # llama.KVCache (unquantized pools)
+    write_slots: jnp.ndarray,   # [B*T] int32 flat slots (0 = trash)
+    slot_matrix: jnp.ndarray,   # [B, C] gather-mode slot matrix
+    mesh,
+    page_size: int = 16,
+    q_lens: jnp.ndarray | None = None,   # [B] ragged query lengths (mixed)
+    embeds: jnp.ndarray | None = None,
+    embeds_mask: jnp.ndarray | None = None,
+):
+    """Drop-in for `llama.forward` on tp>1 gather-backend meshes: the
+    layer stack runs inside ONE `shard_map` over ('tp',) with the
+    residual stream row-scattered and every collective a chunked ring
+    (`llama.layer_step(..., tp_overlap=True)` per layer).
+
+    Embedding lookup, rope tables, final norm and logits stay OUTSIDE
+    the wrapper — the embed table is vocab-sharded and GSPMD already
+    handles its gather; the wrapper covers exactly the per-layer segment
+    where the serialized psums lived. Returns (hidden [B, T, D], kv)
+    like `llama.forward`."""
+    from dynamo_tpu.models import llama  # deferred: llama imports us lazily
+
+    if kv.quantized:
+        raise ValueError(
+            "tp_overlap manual executor requires unquantized KV pools "
+            "(engine falls back to GSPMD + XLA overlap flags)"
+        )
+    if cfg.num_experts:
+        raise ValueError("tp_overlap manual executor covers dense models")
+
+    tp = mesh.shape["tp"]
+    b, t = tokens.shape
+
+    x = params["embed"][tokens]
+    if cfg.scale_embeddings:
+        x = x * jnp.asarray(cfg.hidden_size ** 0.5, x.dtype)
+    if embeds is not None:
+        x = jnp.where(embeds_mask[..., None], embeds.astype(x.dtype), x)
+    inv_freq = jnp.asarray(rope_inv_freq(cfg))
+    cos, sin = rope_cos_sin(inv_freq, positions)
+
+    if q_lens is None:
+        # static sentinel: the shard body rebuilds the same AttnSpec
+        # variant (lengths=None) the serialized path would use
+        q_lens_arr = jnp.zeros((0,), jnp.int32)
+    else:
+        q_lens_arr = q_lens
+
+    def prog(layers, k_pools, v_pools, x, cos, sin, ws, sm, pos, qlens):
+        r = b * t
+        xf = pad_rows(x.reshape(r, cfg.hidden_size), tp)
+        x_scat = scatter_rows(xf, "tp")
+        attn = llama.AttnSpec.gather(
+            sm, page_size=page_size,
+            lengths=qlens if qlens.shape[0] else None,
+        )
+        new_k, new_v = [], []
+        for kp, vp, lp in zip(k_pools, v_pools, layers):
+            x_scat, kp, vp, _, _ = llama.layer_step(
+                lp, cfg, x_scat, cos, sin, kp, vp, ws, attn, pos,
+                tp_axis="tp", tp_overlap=True, bt_shape=(b, t),
+            )
+            new_k.append(kp)
+            new_v.append(vp)
+        xf = ring_all_gather(x_scat, "tp")[:r]
+        # lists, not tuples: the out_specs pytree below is list-shaped
+        return xf.reshape(b, t, cfg.hidden_size), new_k, new_v
+
+    layers = params["layers"]
+    hidden, new_k, new_v = compat.shard_map(
+        prog,
+        mesh=mesh,
+        in_specs=(
+            _layer_in_specs(layers),
+            [_P(None, "tp")] * len(layers), [_P(None, "tp")] * len(layers),
+            _P(), _P(), _P(), _P(), _P(), _P(), _P(),
+        ),
+        out_specs=(
+            _P(), [_P(None, "tp")] * len(layers),
+            [_P(None, "tp")] * len(layers),
+        ),
+        check_vma=False,
+    )(
+        layers, list(kv.k), list(kv.v), x, cos, sin,
+        write_slots, slot_matrix, positions, q_lens_arr,
+    )
+
+    kv = llama.KVCache(k=tuple(new_k), v=tuple(new_v))
+    hidden = rms_norm(
+        hidden, params["final_norm"], cfg.rms_norm_eps,
+        weight_offset=cfg.norm_weight_offset,
+    )
+    return hidden, kv

@@ -54,7 +54,7 @@ MAX_TOKENS = 16
 _ENGINES: list = []
 
 
-def make_engine(tp: int) -> JaxEngine:
+def make_engine(tp: int, tp_overlap: bool = False) -> JaxEngine:
     engine = JaxEngine(
         EngineConfig(
             model=CFG,
@@ -69,6 +69,7 @@ def make_engine(tp: int) -> JaxEngine:
             # the sharded mesh are exactly what a smoke must cover
             mixed_batching=True,
             step_pipeline=True,
+            tp_overlap=tp_overlap,
             seed=0,
         )
     )
@@ -134,10 +135,32 @@ async def main() -> None:
 
     assert got == want, f"tp=8 diverged from tp=1:\n{got}\nvs\n{want}"
     assert got2 == want, f"tp=8 second wave diverged:\n{got2}\nvs\n{want}"
+
+    # overlap leg: the latency-hiding manual-TP executor (ring
+    # reduce-scatter residual stream, parallel/tp_overlap.py) must be
+    # byte-identical too — a ring-scheduling regression reads red here
+    ov8 = make_engine(tp=8, tp_overlap=True)
+    assert ov8._tp_overlap_manual, "tp_overlap engine fell back to GSPMD"
+    got_ov = await serve(ov8)
+    got_ov2 = await serve(ov8)  # warm wave: steady-state ring path
+    stats = ov8.phase_stats
+    await ov8.close()
+    assert got_ov == want, (
+        f"tp=8 tp_overlap diverged from tp=1:\n{got_ov}\nvs\n{want}"
+    )
+    assert got_ov2 == want, (
+        f"tp=8 tp_overlap second wave diverged:\n{got_ov2}\nvs\n{want}"
+    )
+    moved = sum(
+        stats[k] for k in stats if k.endswith("_collective_bytes")
+    )
+    assert moved > 0, f"overlap engine recorded no collective bytes: {stats}"
+
     print(
         f"multichip smoke ok: {n_dev} devices, tp=8, "
         f"{len(PROMPTS)} streams x {MAX_TOKENS} tokens byte-identical "
-        "to tp=1 (mixed+pipeline on)"
+        "to tp=1 (mixed+pipeline on; overlap leg byte-identical, "
+        f"{moved} exposed collective bytes attributed)"
     )
 
 

@@ -1,0 +1,278 @@
+"""Roofline ledger: flight-recorder digests x collective counters.
+
+Joins the two measurement planes this engine already carries into one
+achieved-vs-peak table per dispatch kind:
+
+- **Flight-recorder digests** (engine/flight_recorder.py): per-step
+  kind/rows/tokens/wall sampled at the engine's `_phase_stats` sites —
+  the wall-clock denominator.
+- **Collective counters** (`{kind}_collective_bytes` +
+  `collective_wall_s` in phase stats, fed by the tp_overlap byte
+  formula in parallel/tp_overlap.py): the measured interconnect
+  numerator GSPMD profiling never attributes per dispatch kind.
+
+For each dispatch kind (prefill/decode/mixed/spec_verify) it reports
+steps, tokens, wall, modeled dense-projection FLOP/s vs peak, modeled
+weight+KV-write HBM traffic vs peak, and the measured collective bytes
+vs ICI peak — enough to read which roof each phase sits under. The
+FLOPs/HBM sides are MODELED from the model config (2 FLOPs per matmul
+param per token; weights streamed once per dispatch; KV write bytes per
+token); attention-score FLOPs and decode KV READS are workload-
+dependent and excluded — the ledger says what a phase *at least* did,
+not a profiler truth. The collective side is measured, not modeled.
+
+Input modes:
+  python scripts/roofline.py                    # self-contained demo:
+      8 virtual CPU devices, tp=8 tp_overlap engine serves a few greedy
+      streams, then the ledger runs on its own digests + counters
+  python scripts/roofline.py --artifact X.json  # a flight-recorder
+      artifact (watchdog/SLO dump or GET /debug/snapshot); digests +
+      context.phase_stats come from the file, --model names the preset
+  python scripts/roofline.py --json             # machine-readable
+      ledger on stdout (either mode); scripts/bench_history.py-style
+      tooling can join it to commits
+
+Peaks default to one v5e chip (bf16 MXU 197 TFLOP/s, HBM 819 GB/s, ICI
+~90 GB/s aggregate) — override for other parts; on the CPU demo the
+percentages are illustrative only, the JOIN is what this script proves.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+DISPATCH_KINDS = ("prefill", "decode", "mixed", "spec_verify")
+
+# per-chip v5e peaks (the deployment part this repo targets)
+PEAK_FLOPS = 197e12
+PEAK_HBM = 819e9
+PEAK_ICI = 90e9
+
+
+def matmul_params(cfg) -> tuple[int, int]:
+    """(per-layer-stack matmul params, vocab-head params) of the dense
+    architecture — the 2-FLOPs-per-param-per-token roofline numerator."""
+    per_layer = (
+        cfg.hidden_size * cfg.q_size          # wq
+        + 2 * cfg.hidden_size * cfg.kv_size   # wk, wv
+        + cfg.q_size * cfg.hidden_size        # wo
+        + 3 * cfg.hidden_size * cfg.intermediate_size  # gate/up/down
+    )
+    return cfg.num_layers * per_layer, cfg.hidden_size * cfg.vocab_size
+
+
+def build_ledger(
+    digests: list,
+    fields: list,
+    kinds: list,
+    phase_stats: dict,
+    cfg,
+    itemsize: int = 2,
+    peak_flops: float = PEAK_FLOPS,
+    peak_hbm: float = PEAK_HBM,
+    peak_ici: float = PEAK_ICI,
+) -> dict:
+    """The join: digest rows keyed by kind x the per-kind collective
+    counters, normalized into achieved-vs-peak rates."""
+    col = {f: i for i, f in enumerate(fields)}
+    kind_name = {i: k for i, k in enumerate(kinds)}
+    stack_params, _head_params = matmul_params(cfg)
+    flops_per_tok = 2 * stack_params
+    weight_bytes = stack_params * itemsize
+    kv_write_per_tok = 2 * cfg.kv_size * cfg.num_layers * itemsize
+
+    ledger = {}
+    for kind in DISPATCH_KINDS:
+        rows = [
+            d for d in digests
+            if kind_name.get(int(d[col["kind"]])) == kind
+        ]
+        if not rows:
+            continue
+        steps = len(rows)
+        tokens = int(sum(d[col["tokens"]] for d in rows))
+        wall = float(sum(d[col["wall_s"]] for d in rows))
+        flops = tokens * flops_per_tok
+        # HBM floor: weights streamed once per dispatch + KV writes
+        hbm = steps * weight_bytes + tokens * kv_write_per_tok
+        coll = int(phase_stats.get(f"{kind}_collective_bytes", 0))
+        entry = {
+            "steps": steps,
+            "tokens": tokens,
+            "wall_s": round(wall, 6),
+            "model_flops": flops,
+            "model_hbm_bytes": hbm,
+            "collective_bytes": coll,
+        }
+        if wall > 0:
+            entry.update({
+                "achieved_tflops": round(flops / wall / 1e12, 6),
+                "pct_peak_flops": round(100 * flops / wall / peak_flops, 4),
+                "achieved_hbm_gbps": round(hbm / wall / 1e9, 6),
+                "pct_peak_hbm": round(100 * hbm / wall / peak_hbm, 4),
+                "collective_gbps": round(coll / wall / 1e9, 6),
+                "pct_peak_ici": round(100 * coll / wall / peak_ici, 4),
+                # bytes per FLOP the phase actually ran at — compare
+                # against peak_flops/peak_hbm to see which roof binds
+                "arithmetic_intensity": round(flops / max(hbm, 1), 3),
+            })
+        ledger[kind] = entry
+
+    total_coll = sum(
+        int(v) for k, v in phase_stats.items()
+        if k.endswith("_collective_bytes")
+    )
+    return {
+        "model": cfg.name,
+        "itemsize": itemsize,
+        "flops_per_token": flops_per_tok,
+        "weight_stream_bytes": weight_bytes,
+        "peaks": {"flops": peak_flops, "hbm": peak_hbm, "ici": peak_ici},
+        "kinds": ledger,
+        "collective": {
+            "total_bytes": total_coll,
+            "wall_s_est": round(
+                float(phase_stats.get("collective_wall_s", 0.0)), 6
+            ),
+        },
+        "note": (
+            "FLOPs/HBM are modeled floors (dense projections; weights "
+            "once per dispatch; KV writes) — attention scores and "
+            "decode KV reads excluded; collective bytes are measured"
+        ),
+    }
+
+
+def _demo() -> tuple[list, list, list, dict, object]:
+    """Self-contained source: a tp=8 tp_overlap engine on 8 virtual CPU
+    devices serves greedy streams; its own digests + counters feed the
+    ledger (the same join a production artifact gets)."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+
+    import asyncio
+
+    from dynamo_tpu.engine import EngineConfig, JaxEngine
+    from dynamo_tpu.llm.protocols.common import (
+        PreprocessedRequest, SamplingOptions, StopConditions,
+    )
+    from dynamo_tpu.models import config as cfgmod
+    from dynamo_tpu.parallel.mesh import MeshConfig
+    from dynamo_tpu.runtime.pipeline.context import Context
+
+    cfg = cfgmod.get_config("tiny").with_(num_heads=8, num_kv_heads=8)
+    engine = JaxEngine(EngineConfig(
+        model=cfg, dtype="float32", mesh=MeshConfig(tp=8),
+        page_size=8, num_pages=96, max_batch_size=4, max_model_len=128,
+        prefill_chunk=32, tp_overlap=True, seed=0,
+    ))
+
+    async def serve():
+        async def one(prompt):
+            pre = PreprocessedRequest(
+                token_ids=list(prompt),
+                stop_conditions=StopConditions(max_tokens=12),
+                sampling_options=SamplingOptions(greedy=True),
+            )
+            return [
+                f async for f in await engine.generate(Context(pre.to_dict()))
+            ]
+
+        await asyncio.gather(*(one(p) for p in (
+            [5, 17, 42, 9, 88, 3], [11, 3, 7, 29, 31],
+        )))
+
+    asyncio.run(serve())
+    digests = engine.flight.snapshot_rows()
+    from dynamo_tpu.engine.flight_recorder import FIELDS, KINDS
+
+    stats = engine.phase_stats
+    asyncio.run(engine.close())
+    return digests, list(FIELDS), list(KINDS), stats, engine.model_cfg
+
+
+def _render(ledger: dict) -> str:
+    lines = [
+        "roofline ledger — model=%s (modeled floors vs per-chip peaks; "
+        "collective bytes measured)" % ledger["model"],
+        "%-12s %6s %8s %10s %10s %8s %10s %8s %12s" % (
+            "kind", "steps", "tokens", "wall_s", "TFLOP/s", "%peak",
+            "HBM GB/s", "%peak", "coll bytes",
+        ),
+    ]
+    for kind, e in ledger["kinds"].items():
+        lines.append(
+            "%-12s %6d %8d %10.4f %10.4f %8.3f %10.4f %8.3f %12d" % (
+                kind, e["steps"], e["tokens"], e["wall_s"],
+                e.get("achieved_tflops", 0.0),
+                e.get("pct_peak_flops", 0.0),
+                e.get("achieved_hbm_gbps", 0.0),
+                e.get("pct_peak_hbm", 0.0),
+                e["collective_bytes"],
+            )
+        )
+    c = ledger["collective"]
+    lines.append(
+        "collectives: %d bytes total, est wall %.4fs"
+        % (c["total_bytes"], c["wall_s_est"])
+    )
+    return "\n".join(lines)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "--artifact",
+        help="flight-recorder artifact JSON (digests + context."
+             "phase_stats); default: run the self-contained demo engine",
+    )
+    ap.add_argument(
+        "--model", default="tiny",
+        help="model preset the artifact's engine served (tiny)",
+    )
+    ap.add_argument(
+        "--itemsize", type=int, default=2,
+        help="weight/KV element bytes (2 = bf16)",
+    )
+    ap.add_argument("--peak-flops", type=float, default=PEAK_FLOPS)
+    ap.add_argument("--peak-hbm", type=float, default=PEAK_HBM)
+    ap.add_argument("--peak-ici", type=float, default=PEAK_ICI)
+    ap.add_argument(
+        "--json", action="store_true",
+        help="machine-readable ledger on stdout instead of the table",
+    )
+    args = ap.parse_args()
+
+    if args.artifact:
+        with open(args.artifact) as f:
+            art = json.load(f)
+        from dynamo_tpu.models.config import get_config
+
+        digests = art["digests"]
+        fields = art["digest_fields"]
+        kinds = art["digest_kinds"]
+        stats = (art.get("context") or {}).get("phase_stats") or {}
+        cfg = get_config(args.model)
+    else:
+        digests, fields, kinds, stats, cfg = _demo()
+
+    ledger = build_ledger(
+        digests, fields, kinds, stats, cfg,
+        itemsize=args.itemsize, peak_flops=args.peak_flops,
+        peak_hbm=args.peak_hbm, peak_ici=args.peak_ici,
+    )
+    if args.json:
+        print(json.dumps(ledger, indent=2))
+    else:
+        print(_render(ledger))
+
+
+if __name__ == "__main__":
+    main()

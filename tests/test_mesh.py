@@ -5,6 +5,7 @@ single-device forward (GSPMD inserts the collectives)."""
 
 import jax
 import jax.numpy as jnp
+import pytest
 
 from dynamo_tpu import compat
 import numpy as np
@@ -64,6 +65,27 @@ def test_tp_forward_matches_single_device():
     assert kv_out.k[0].sharding.is_equivalent_to(
         meshmod.kv_cache_sharding(m), kv_out.k[0].ndim
     )
+
+
+def test_validate_model_mesh_rejects_indivisible_widths():
+    """hidden/intermediate width checks (same clear-message contract as
+    the head-count checks): the row-parallel wo/w_down shard their input
+    dim over tp, and the tp_overlap ring executor needs even row blocks."""
+    wide = CFG.with_(num_heads=8, num_kv_heads=8)  # heads pass at tp=8
+    mc = meshmod.MeshConfig(tp=8)
+
+    # widths divide -> fine
+    meshmod.validate_model_mesh(wide, mc)
+
+    with pytest.raises(ValueError, match=r"hidden_size=100.*not divisible by tp=8"):
+        meshmod.validate_model_mesh(wide.with_(hidden_size=100), mc)
+    with pytest.raises(
+        ValueError, match=r"intermediate_size=\s*100.*not divisible by\s*tp=8"
+    ):
+        meshmod.validate_model_mesh(wide.with_(intermediate_size=100), mc)
+    # unchanged contract for the head checks
+    with pytest.raises(ValueError, match="num_kv_heads=2"):
+        meshmod.validate_model_mesh(CFG, mc)
 
 
 def test_tp_sharded_param_layout():
