@@ -93,9 +93,11 @@ class EngineConfig:
     # token-budgeted model step — decode rows ride as q_len=1 rows next
     # to the prefill chunks, so an admission wave never stalls running
     # decode streams for longer than one budgeted step. Composes with
-    # spec_decode (see mixed_spec); unsupported with pp>1, sp>1 and
-    # the int32-packed pallas+int8 KV pools (the mixed step row-scatters
-    # KV mid-page). Runtime-togglable like spec_decode: incompatible
+    # spec_decode (see mixed_spec); unsupported with pp>1 and sp>1.
+    # Composes with the int32-packed pallas+quantized KV pools: mid-page
+    # decode rows land via byte-lane surgery on the packed rows
+    # (ops/quant.scatter_packed_kv_rows), width-agnostic so the int4
+    # nibble tier rides too. Runtime-togglable like spec_decode: incompatible
     # engines just never build a mixed step (logged once).
     mixed_batching: bool = False
     # spec x mixed composition: with both features on, spec-eligible
@@ -139,11 +141,17 @@ class EngineConfig:
     # view, halving EXPOSED collective bytes per layer (measured by the
     # BENCH_TP_OVERLAP section). Greedy streams stay byte-identical to
     # tp=1 (docs/parallelism.md documents the reduction-order
-    # invariant). Engines whose shapes the manual executor refuses
-    # (pallas serving backend, sp>1, pp>1 handled by the pipeline
-    # executor's own flag, quantized KV/weights, MoE) fall back to the
-    # GSPMD path with XLA's latency-hiding scheduler flags requested at
-    # init (logged once either way). Also feeds the collective_bytes /
+    # invariant). Serves the pallas backend with int8/int4 packed KV
+    # (the kernels' per-layer shard_maps collapse into the executor's
+    # single one; block tables, packed pools and scale tiles ride
+    # shard-local) and int8 weights (ring_rs_matmul's int32 accumulator
+    # ring + global pmax activation scale — bitwise tp=1-identical).
+    # Only sp>1 ring prefill and MoE routing still fall back to the
+    # GSPMD path, with XLA's latency-hiding scheduler flags requested
+    # at init (logged once, reason in tp_overlap_refusal_reason;
+    # metrics() attributes tp_overlap_dispatches vs
+    # gspmd_fallback_dispatches). pp>1 is handled by the pipeline
+    # executor's own flag. Also feeds the collective_bytes /
     # collective_wall_s phase counters the flight recorder digests.
     tp_overlap: bool = False
     # admission batching window for PACED arrivals: when decode streams

@@ -328,22 +328,15 @@ class JaxEngine:
         # a multi-query unified step — row-scatter KV write + the oracle
         # attention over the slot matrix (gather backends) or the ragged
         # flash kernel (pallas backends, same path mixed steps read
-        # through). int32-PACKED pools have no row-scatter path (a
-        # byte-level scatter into packed rows would corrupt pages) and
-        # pp's stage executor has no multi-query decode, so both gate it
-        # off loudly instead of corrupting.
+        # through). int32-PACKED pools row-scatter through the byte-lane
+        # write (ops/quant.scatter_packed_kv_rows), so the packed
+        # pallas+quantized tier composes; pp's stage executor has no
+        # multi-query decode, so pp>1 gates it off loudly.
         if config.spec_decode:
             if config.spec_k_max < 1:
                 raise ValueError("spec_k_max must be >= 1")
             if mc.pp > 1:
                 raise ValueError("spec_decode unsupported with pp>1 (v1)")
-            if self._kv_packed:
-                raise ValueError(
-                    "spec_decode unsupported with int32-packed int8 KV "
-                    "pools (the pallas+int8 serving path): the verify "
-                    "step row-scatters KV mid-page. Use "
-                    "attn_backend='gather' or kv_quantization=None."
-                )
 
         # pipeline-parallel serving: pp > 1 runs the GPipe stage executor
         # (parallel/pipeline.py) — layers AND KV pools live stage-local;
@@ -385,19 +378,28 @@ class JaxEngine:
         # latency-hiding manual-TP layer executor — per-layer psums
         # decomposed into ring reduce-scatter + matmul-fused all-gather
         # (parallel/tp_overlap.py), halving exposed collective bytes.
-        # The executor covers dense unquantized gather-backend tp-only
-        # meshes; pp>1 composes through the pipeline stage executor's
-        # own flag, and every other refused shape falls back to GSPMD
-        # with XLA's latency-hiding scheduler flags requested instead.
+        # The executor covers dense tp-only meshes on BOTH serving
+        # backends — the pallas kernels and the int8/int4 packed KV
+        # pools run inside the executor's single shard_map (the
+        # kernels' per-layer shard_maps collapse into it), and int8
+        # quantized weights ride the ring matmuls with an int32
+        # reduce-scatter epilogue. pp>1 composes through the pipeline
+        # stage executor's own flag; the remaining refusals (MoE
+        # routing, sp>1 / non-tp mesh axes) fall back to GSPMD with
+        # XLA's latency-hiding scheduler flags requested instead.
         self._tp_overlap_manual = bool(
             config.tp_overlap and mc.tp > 1 and tp_only
-            and not self._attn_pallas
-            and self._kv_quant is None
             and not self.model_cfg.num_experts
-            and not config.quantization
         )
+        # why the manual executor did NOT serve (the /metrics
+        # gspmd_fallback_dispatches{reason} label; "" when it serves or
+        # tp_overlap is off/moot)
+        self.tp_overlap_refusal_reason = ""
         if config.tp_overlap and mc.tp > 1 and not self._tp_overlap_manual:
             if self._pp:
+                self.tp_overlap_refusal_reason = (
+                    "pp>1 pipeline stage executor"
+                )
                 log.info(
                     "tp_overlap: pp>1 — pipeline stage executor runs "
                     "scattered-residual layers (ring collectives per "
@@ -405,13 +407,11 @@ class JaxEngine:
                 )
             else:
                 why = (
-                    "pallas attention backend" if self._attn_pallas
+                    "MoE routing" if self.model_cfg.num_experts
                     else "sp>1 ring prefill" if self._sp
-                    else "quantized KV pools" if self._kv_quant
-                    else "MoE routing" if self.model_cfg.num_experts
-                    else "quantized weights" if config.quantization
                     else "non-tp mesh axes"
                 )
+                self.tp_overlap_refusal_reason = why
                 added = []
                 if backend == "tpu":
                     from dynamo_tpu.parallel.tp_overlap import (
@@ -739,6 +739,15 @@ class JaxEngine:
             "spec_collective_bytes": 0,
             "mixed_collective_bytes": 0,
             "collective_wall_s": 0.0,
+            # per-dispatch executor attribution (tp>1 tp-only meshes):
+            # dispatches the manual ring executor served vs dispatches
+            # that took the GSPMD path (with tp_overlap requested, that
+            # means a silently-refused config — the refusal reason rides
+            # /metrics as gspmd_fallback_dispatches{reason}). A config
+            # the executor was expected to serve but didn't reads here
+            # in telemetry instead of in a profile.
+            "tp_overlap_dispatches": 0,
+            "gspmd_fallback_dispatches": 0,
         }
         # updates run in worker threads outside _kv_lock (serving prefill
         # + concurrent prefill_only dispatches) — guard the RMWs
@@ -1161,6 +1170,13 @@ class JaxEngine:
             "pipeline_overlapped": ps["pipeline_overlapped"],
             "pipeline_overlap_s": round(ps["pipeline_overlap_s"], 4),
             "mixed_carry_rows": ps["mixed_carry_rows"],
+            # per-dispatch executor attribution (docs/parallelism.md):
+            # which executor actually served — the manual ring overlap
+            # path or the GSPMD fallback. The fallback's refusal reason
+            # rides /metrics as the {reason} label (EngineMetrics reads
+            # engine.tp_overlap_refusal_reason).
+            "tp_overlap_dispatches": ps["tp_overlap_dispatches"],
+            "gspmd_fallback_dispatches": ps["gspmd_fallback_dispatches"],
             # fault-tolerance spine (docs/robustness.md): per-rung
             # degrade state (degraded_step_pipeline/.../_decode_scan),
             # ladder transition totals, watchdog firings, deadline
@@ -1241,6 +1257,10 @@ class JaxEngine:
         with self._phase_lock:
             self._phase_stats[f"{kind}_collective_bytes"] += nbytes
             self._phase_stats["collective_wall_s"] += est
+            self._phase_stats[
+                "tp_overlap_dispatches" if self._tp_overlap_manual
+                else "gspmd_fallback_dispatches"
+            ] += 1
         if est and tracing.enabled():
             tracing.complete(
                 "engine.collective", t_end - est, t_end, cat="collective",
@@ -1268,24 +1288,19 @@ class JaxEngine:
     def _forward(self, params, kv, tokens, positions, write_slots, attn,
                  embeds=None, embeds_mask=None):
         """llama.forward, rerouted through the latency-hiding manual-TP
-        executor on engines that selected it. The executor serves plain
-        gather dispatches (every dispatch kind on a gather-backend
-        tp-only engine); any other AttnSpec shape reaching here keeps
-        the classic path — belt-and-suspenders, init gating should have
-        excluded those engines already."""
-        if (
-            self._tp_overlap_manual
-            and attn.slot_matrix is not None
-            and attn.block_tables is None
-            and attn.write_tables is None
-            and not attn.ring
-        ):
+        executor on engines that selected it. The executor serves every
+        dispatch family's AttnSpec shape on tp-only engines — gather
+        oracles AND the pallas prefill/fused-decode/ragged kernels with
+        any KV tier (the spec passes through whole; the executor's shard
+        body reruns the kernels mesh-free on shard-local operands). Only
+        the sp ring spec keeps the classic path — belt-and-suspenders,
+        init gating already excludes sp engines."""
+        if self._tp_overlap_manual and not attn.ring:
             from dynamo_tpu.parallel.tp_overlap import tp_overlap_forward
 
             return tp_overlap_forward(
                 params, self.model_cfg, tokens, positions, kv,
-                write_slots, attn.slot_matrix, self.mesh,
-                page_size=attn.page_size, q_lens=attn.lengths,
+                write_slots, attn, self.mesh,
                 embeds=embeds, embeds_mask=embeds_mask,
             )
         return llama.forward(
@@ -3366,13 +3381,6 @@ class JaxEngine:
                 "mixed_batching unsupported with sp>1: ring attention "
                 "prefills whole prompts in one pass — there is no chunk "
                 "for decode rows to ride"
-            )
-        if self._kv_packed:
-            return (
-                "mixed_batching unsupported with int32-packed int8 KV "
-                "pools (the pallas+int8 serving path): the mixed step "
-                "row-scatters KV mid-page. Use attn_backend='gather' or "
-                "kv_quantization=None."
             )
         if self.config.mixed_step_tokens < 1:
             return "mixed_step_tokens must be >= 1"

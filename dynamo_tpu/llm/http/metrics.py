@@ -207,6 +207,7 @@ class EngineMetrics:
         self._worker_label = (
             f'{{worker_id="{worker_id}"}}' if worker_id else ""
         )
+        self._worker_id = worker_id
         self.ttft = Histogram(
             f"{prefix}_engine_ttft_seconds",
             "Engine TTFT: request submit to first token emitted",
@@ -250,6 +251,20 @@ class EngineMetrics:
             for key, val in gauges.items():
                 name = f"{self._prefix}_engine_{key}"
                 yield f"# TYPE {name} gauge"
+                if key == "gspmd_fallback_dispatches":
+                    # executor attribution: the refusal reason rides as
+                    # a label so a silently-refused tp_overlap config
+                    # reads straight off the scrape
+                    labels = {}
+                    if self._worker_id:
+                        labels["worker_id"] = self._worker_id
+                    reason = getattr(
+                        self.engine, "tp_overlap_refusal_reason", ""
+                    )
+                    if reason:
+                        labels["reason"] = str(reason)
+                    yield f"{name}{_fmt_labels(labels)} {float(val)}"
+                    continue
                 yield f"{name}{self._worker_label} {float(val)}"
         for h in (self.ttft, self.itl, self.queue_wait, self.tokens):
             yield from h.render()

@@ -319,15 +319,24 @@ def _attn_block(
         in the tp-blocked pool layout."""
         if kv_k.dtype == jnp.int32:
             # int32-PACKED quantized pools (ops/quant.pack_kv_slots)
-            # carry 4 quantized bytes per element: a row scatter of
-            # unpacked values here would silently corrupt whole pages.
-            # Packed pools are written only by the pallas page-scatter
-            # kernels.
-            raise ValueError(
-                "row-scatter KV write reached an int32-packed pool; "
-                "packed pools (pallas quantized serving) must go through "
-                "the paged write kernel, not the gather/ring path"
+            # carry 4 token rows per int32 row: the write is byte-lane
+            # surgery on the packed rows (ops/quant.scatter_packed_kv_rows)
+            # plus the same scale scatter as the dense int8 tier. This is
+            # what lets mixed/spec-verify steps land decode rows MID-PAGE
+            # on the pallas+quantized serving path; whole-page prefill
+            # writes still prefer the pallas page-scatter kernel.
+            from dynamo_tpu.ops.quant import (
+                scatter_kv_scales,
+                scatter_packed_kv_rows,
             )
+
+            kr, krs = _quant_rows(kr)
+            vr, vrs = _quant_rows(vr)
+            kv_ks = scatter_kv_scales(kv_ks, write_slots, krs, s_ch, attn.kv_tp)
+            kv_vs = scatter_kv_scales(kv_vs, write_slots, vrs, s_ch, attn.kv_tp)
+            kv_k = scatter_packed_kv_rows(kv_k, write_slots, kr)
+            kv_v = scatter_packed_kv_rows(kv_v, write_slots, vr)
+            return kv_k, kv_v, kv_ks, kv_vs
         if quant:
             from dynamo_tpu.ops.quant import scatter_kv_scales
 
@@ -457,8 +466,11 @@ def _attn_block(
                 vs2 = jnp.pad(vs2, ((0, 0), (0, t_pad - t), (0, 0)),
                               constant_values=1.0)
         n_pg = b * (t_pad // ps)
-        k_pages = k2.reshape(n_pg, ps, kh * hd)
-        v_pages = v2.reshape(n_pg, ps, kh * hd)
+        # row width is kh*hd, except the int4 tier nibble-packs rows to
+        # half width at quantize time — read it off the rows themselves
+        row_w = k2.shape[-1]
+        k_pages = k2.reshape(n_pg, ps, row_w)
+        v_pages = v2.reshape(n_pg, ps, row_w)
         if quant and kv_k.dtype == jnp.int32:
             # int32-packed pools: pack the chunk's source pages to match
             # (4 token rows per int32 row, ops/quant.pack_kv_slots)
@@ -699,19 +711,23 @@ def _attn_block(
                 q_lens=attn.lengths,
                 int4_groups=attn.int4_groups or None,
             )
-    proj = mm(out.reshape(b, t, h * hd), lp["wo"])
     if tp_overlap:
         # decomposed psum, half 1: ring reduce-scatter back to the
         # row-scattered residual view (the all-gather half rides the
-        # next layer segment's ring matmuls)
+        # next layer segment's ring matmuls). ring_rs_matmul folds the
+        # matmul in so quantized wo keeps its int32 accumulator across
+        # the ring (bitwise tp=1 dequant epilogue).
         from dynamo_tpu.parallel import tp_overlap as _ov
 
-        proj = _ov.pad_rows(proj.reshape(b * t, -1), tpn)
-        proj = _ov.ring_reduce_scatter(proj, tp_axis)
-    elif tp_axis is not None:
-        from dynamo_tpu.parallel.tp_overlap import psum_allreduce
+        proj = _ov.ring_rs_matmul(
+            out.reshape(b * t, h * hd), lp["wo"], tp_axis
+        )
+    else:
+        proj = mm(out.reshape(b, t, h * hd), lp["wo"])
+        if tp_axis is not None:
+            from dynamo_tpu.parallel.tp_overlap import psum_allreduce
 
-        proj = psum_allreduce(proj, tp_axis)
+            proj = psum_allreduce(proj, tp_axis)
     return proj, kv_k, kv_v, kv_ks, kv_vs
 
 
@@ -736,8 +752,9 @@ def _mlp_block(
         gate, up = _ov.ring_ag_matmul(
             x, (lp["w_gate"], lp["w_up"]), tp_axis
         )
-        out = mm(_ACTIVATIONS[act](gate) * up, lp["w_down"])
-        return _ov.ring_reduce_scatter(out, tp_axis)
+        return _ov.ring_rs_matmul(
+            _ACTIVATIONS[act](gate) * up, lp["w_down"], tp_axis
+        )
     gate = _ACTIVATIONS[act](mm(x, lp["w_gate"]))
     up = mm(x, lp["w_up"])
     out = mm(gate * up, lp["w_down"])

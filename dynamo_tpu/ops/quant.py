@@ -215,6 +215,44 @@ def gather_packed_kv(pool: jnp.ndarray, slots: jnp.ndarray) -> jnp.ndarray:
     return jnp.take_along_axis(b8, byte, axis=2)[..., 0]
 
 
+def scatter_packed_kv_rows(
+    pool: jnp.ndarray,   # [num_slots//4, W] int32 (pack_kv_slots layout)
+    slots: jnp.ndarray,  # [M] flat slot ids (0 = trash)
+    rows: jnp.ndarray,   # [M, W] int8 quantized rows (nibble-packed for int4)
+) -> jnp.ndarray:
+    """Row-scatter dense int8 rows into an int32-PACKED pool (write-side
+    sibling of `gather_packed_kv` — the piece that lets mixed/spec-verify
+    steps land decode rows MID-PAGE on the pallas+quantized serving path,
+    where the page-granular `paged_kv_write` cannot express the write).
+
+    int32 row g holds token rows 4g..4g+3 as its little-endian bytes, so
+    a row write is byte-lane surgery: four sequential masked passes, one
+    per lane l, each gathering the packed rows of the slots with
+    slot % 4 == l, splicing byte lane l with uint32 masks and scattering
+    the rows back. Slots outside the pass's lane redirect to packed row 0
+    (trash-page slots 0..3, never read) and write their row back
+    unmodified, so every pass is one fixed-shape gather + scatter. Passes
+    chain sequentially because two slots of one write batch may share a
+    packed row (4 tokens per int32 row). Byte-level and width-agnostic,
+    so the int4 nibble-packed tier composes unchanged."""
+    pool_u = jax.lax.bitcast_convert_type(pool, jnp.uint32)
+    byte_u = jax.lax.bitcast_convert_type(
+        rows.astype(jnp.int8), jnp.uint8
+    ).astype(jnp.uint32)                                 # [M, W]
+    lanes = (slots % 4).astype(jnp.int32)
+    groups = (slots // 4).astype(jnp.int32)
+    for lane in range(4):
+        sel = lanes == lane
+        g = jnp.where(sel, groups, 0)
+        cur = pool_u[g]                                  # [M, W]
+        shift = jnp.uint32(8 * lane)
+        mask = jnp.uint32(0xFF) << shift
+        upd = (cur & ~mask) | (byte_u << shift)
+        upd = jnp.where(sel[:, None], upd, cur)
+        pool_u = pool_u.at[g].set(upd)
+    return jax.lax.bitcast_convert_type(pool_u, jnp.int32)
+
+
 def scales_to_page_tiles(
     dense: jnp.ndarray, page_size: int, num_kv_heads: int, tp: int = 1
 ) -> jnp.ndarray:

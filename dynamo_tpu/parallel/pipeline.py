@@ -23,7 +23,13 @@ SPMD shape (everything inside one `jax.shard_map` over ('pp',)):
 v1 scope: dense models (no MoE routing inside the pipeline), gather-mode
 attention. The engine serves pp-sharded models by jitting this forward;
 tp composes (kernel shard_maps nest on the same mesh's tp axis) since
-stage slices preserve the head dimension.
+stage slices preserve the head dimension. With `tp_overlap=True` each
+stage's layers run in the manual-tp ring-executor mode
+(parallel/tp_overlap.py) — the residual stays row-scattered across the
+whole fill/drain schedule, so stage-to-stage `ppermute` carries 1/tp of
+the activation bytes; the single-mesh executor additionally serves the
+pallas + packed-KV kernels, which stay pp=1 in v1 (the stage step has
+no paged-kernel family).
 """
 
 from __future__ import annotations

@@ -46,11 +46,21 @@ already carries vs tp=1 — and greedy streams stay byte-identical to
 tp=1 (gated by scripts/multichip_smoke.py and the tp_overlap bench).
 
 Composition matrix (docs/parallelism.md "TP comm/compute overlap"):
-composes with mixed batching, the step pipeline, spec decode and the
-pipeline stage executor (parallel/pipeline.py takes `tp_overlap=True`);
-refuses — engine falls back to GSPMD + XLA latency-hiding flags — on
-the pallas serving backend (its shard_maps own the per-layer layout),
-sp>1 ring prefill, quantized KV pools and MoE routing (v1).
+composes with mixed batching, the step pipeline, spec decode, the
+pipeline stage executor (parallel/pipeline.py takes `tp_overlap=True`),
+the pallas serving backend (the kernels' per-layer shard_maps collapse
+into the executor's single one — `tp_overlap_forward` takes the full
+AttnSpec and the shard body reruns the kernels on shard-local pools
+with a mesh-free spec), int8/int4 packed KV pools (block tables, packed
+pools and scale channels ride as shard-local operands; the tp-blocked
+scale layout restricts per shard to exactly the kv_tp=1 layout over its
+local channels) and int8 quantized weights (`ring_ag_matmul` dispatches
+per chunk through `ops/quant.mm`; the row-parallel projections run
+`ring_rs_matmul`, whose INT32 ring reduce-scatter keeps quantized
+outputs bitwise equal to tp=1). Refuses — engine falls back to GSPMD +
+XLA latency-hiding flags — MoE routing (expert dispatch/combine
+all-to-alls own the layer layout) and sp>1 ring prefill (the ring owns
+the token axis the executor would scatter).
 """
 
 from __future__ import annotations
@@ -60,7 +70,7 @@ import jax.numpy as jnp
 
 from dynamo_tpu import compat
 from dynamo_tpu.ops.norm import rms_norm
-from dynamo_tpu.ops.quant import mm
+from dynamo_tpu.ops.quant import is_quantized, mm
 from dynamo_tpu.ops.rope import rope_cos_sin, rope_inv_freq
 
 _P = jax.sharding.PartitionSpec
@@ -281,6 +291,48 @@ def ring_reduce_scatter(y: jnp.ndarray, axis_name) -> jnp.ndarray:
     return acc
 
 
+def ring_rs_matmul(x: jnp.ndarray, w, axis_name) -> jnp.ndarray:
+    """Row-parallel projection ending in a ring reduce-scatter — the RS
+    half of the decomposed psum, with the matmul folded in so quantized
+    weights dequantize EXACTLY once.
+
+    Plain weights: local matmul, pad rows to a tp multiple, ring RS of
+    the partial products (bitwise what the callers previously spelled
+    inline). Quantized weights ({"q","s"}, ops/quant.py): the per-row
+    dynamic activation scale is computed GLOBALLY — a pmax over tp of the
+    per-row absmax, the same value tp=1 sees (max of maxes reorders
+    nothing) — each shard quantizes its contraction slice against it and
+    dots to int32 partials, and the ring reduce-scatter runs in INT32.
+    Integer addition is associative, so the scattered accumulator rows
+    are bitwise equal to tp=1's before the one shared f32 dequant
+    epilogue: quantized row-parallel outputs stay byte-identical to tp=1
+    (the serialized manual path's per-shard local scales cannot offer
+    that). The tiny pmax rides the ledger as exposed bytes, so quantized
+    layers read slightly above the exact 0.5x of the unquantized
+    invariant — documented, not gated.
+
+    `x` [R, F_local] full rows (contraction dim sharded); returns the
+    row-scattered [ceil(R/tp)*tp/tp, D] block for this shard."""
+    n = compat.axis_size(axis_name)
+    if not is_quantized(w):
+        return ring_reduce_scatter(pad_rows(mm(x, w), n), axis_name)
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)
+    if n > 1:
+        _note("exposed", 2 * (n - 1) * amax.size * amax.dtype.itemsize // n)
+        amax = jax.lax.pmax(amax, axis_name)
+    xs = jnp.where(amax > 0, amax / 127.0, 1.0)
+    xi = jnp.clip(jnp.round(xf / xs), -127, 127).astype(jnp.int8)
+    acc = jax.lax.dot_general(
+        xi, w["q"], (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+    acc = ring_reduce_scatter(pad_rows(acc, n), axis_name)
+    xs_rows = scatter_rows(pad_rows(xs, n), axis_name)
+    out = acc.astype(jnp.float32) * xs_rows * w["s"]
+    return out.astype(x.dtype)
+
+
 def scatter_rows(x: jnp.ndarray, axis_name) -> jnp.ndarray:
     """Slice this shard's row block out of a replicated [n*m, ...] array
     (free under shard_map — no collective)."""
@@ -311,7 +363,10 @@ def pad_rows(x: jnp.ndarray, tp: int) -> jnp.ndarray:
 def _layer_in_specs(layers: list[dict]) -> list[dict]:
     """Per-layer PartitionSpecs matching parallel/mesh.param_shardings —
     the shard_map in_specs must agree with the GSPMD placement so entry
-    is a no-op reslice, not a reshard."""
+    is a no-op reslice, not a reshard. Quantized leaves ({"q","s"}
+    dicts) mirror `mesh.shard_params`: q at the weight's spec, the
+    per-output-channel scale on the spec's last axis (sharded for
+    column-parallel, replicated for row-parallel)."""
     col, row = _P(None, "tp"), _P("tp", None)
     spec = {
         "attn_norm": _P(), "mlp_norm": _P(),
@@ -319,7 +374,14 @@ def _layer_in_specs(layers: list[dict]) -> list[dict]:
         "w_gate": col, "w_up": col, "w_down": row,
         "bq": _P("tp"), "bk": _P("tp"), "bv": _P("tp"),
     }
-    return [{k: spec[k] for k in lp} for lp in layers]
+
+    def leaf(k, v):
+        s = spec[k]
+        if is_quantized(v):
+            return {"q": s, "s": _P(s[-1]) if len(s) else _P()}
+        return s
+
+    return [{k: leaf(k, lp[k]) for k in lp} for lp in layers]
 
 
 def single_layer_executor(
@@ -381,19 +443,32 @@ def tp_overlap_forward(
     cfg,                        # ModelConfig
     tokens: jnp.ndarray,        # [B, T] int32
     positions: jnp.ndarray,     # [B, T] int32
-    kv,                         # llama.KVCache (unquantized pools)
+    kv,                         # llama.KVCache (any tier: bf16 / int8 / int4 packed)
     write_slots: jnp.ndarray,   # [B*T] int32 flat slots (0 = trash)
-    slot_matrix: jnp.ndarray,   # [B, C] gather-mode slot matrix
+    attn,                       # llama.AttnSpec (any non-ring shape), or a
+    #                             raw [B, C] slot matrix (legacy gather form)
     mesh,
-    page_size: int = 16,
-    q_lens: jnp.ndarray | None = None,   # [B] ragged query lengths (mixed)
+    page_size: int = 16,        # legacy raw-slot-matrix form only
+    q_lens: jnp.ndarray | None = None,   # legacy form: ragged query lengths
     embeds: jnp.ndarray | None = None,
     embeds_mask: jnp.ndarray | None = None,
 ):
-    """Drop-in for `llama.forward` on tp>1 gather-backend meshes: the
-    layer stack runs inside ONE `shard_map` over ('tp',) with the
-    residual stream row-scattered and every collective a chunked ring
+    """Drop-in for `llama.forward` on tp>1 tp-only meshes: the layer
+    stack runs inside ONE `shard_map` over ('tp',) with the residual
+    stream row-scattered and every collective a chunked ring
     (`llama.layer_step(..., tp_overlap=True)` per layer).
+
+    Serves every AttnSpec shape except the sp ring: gather oracles,
+    pallas prefill page-scatter + flash prefill, fused decode,
+    ragged mixed/spec-verify — the kernels' own per-layer shard_maps
+    COLLAPSE into this one. The shard body rebuilds the spec with
+    `mesh=None` (kernels run directly on the shard's local heads) and
+    `kv_tp=1` (each shard's scale-pool slab IS the kv_tp=1 layout over
+    its local channels — ops/quant.kv_scale_subl is tp-blocked by
+    construction); block tables, packed pools and scale channels ride as
+    shard-local operands. Quantized KV pools (int8 dense, int32-packed,
+    int4 nibble) pass through on their engine shardings; quantized
+    weights ride `ring_ag_matmul`/`ring_rs_matmul`.
 
     Embedding lookup, rope tables, final norm and logits stay OUTSIDE
     the wrapper — the embed table is vocab-sharded and GSPMD already
@@ -402,16 +477,21 @@ def tp_overlap_forward(
     like `llama.forward`."""
     from dynamo_tpu.models import llama  # deferred: llama imports us lazily
 
-    if kv.quantized:
-        raise ValueError(
-            "tp_overlap manual executor requires unquantized KV pools "
-            "(engine falls back to GSPMD + XLA overlap flags)"
+    if not isinstance(attn, llama.AttnSpec):
+        attn = llama.AttnSpec.gather(
+            attn, page_size=page_size, lengths=q_lens
         )
     if cfg.num_experts:
         raise ValueError("tp_overlap manual executor covers dense models")
+    if attn.ring:
+        raise ValueError(
+            "tp_overlap manual executor does not serve the sp ring "
+            "prefill (the ring owns the token axis)"
+        )
 
     tp = mesh.shape["tp"]
     b, t = tokens.shape
+    quantized = kv.quantized
 
     x = params["embed"][tokens]
     if cfg.scale_embeddings:
@@ -421,53 +501,75 @@ def tp_overlap_forward(
     inv_freq = jnp.asarray(rope_inv_freq(cfg))
     cos, sin = rope_cos_sin(inv_freq, positions)
 
-    if q_lens is None:
-        # static sentinel: the shard body rebuilds the same AttnSpec
-        # variant (lengths=None) the serialized path would use
-        q_lens_arr = jnp.zeros((0,), jnp.int32)
-    else:
-        q_lens_arr = q_lens
-
-    def prog(layers, k_pools, v_pools, x, cos, sin, ws, sm, pos, qlens):
+    def prog(layers, k_pools, v_pools, ks_pools, vs_pools,
+             x, cos, sin, ws, attn_l, pos):
         r = b * t
         xf = pad_rows(x.reshape(r, cfg.hidden_size), tp)
         x_scat = scatter_rows(xf, "tp")
-        attn = llama.AttnSpec.gather(
-            sm, page_size=page_size,
-            lengths=qlens if qlens.shape[0] else None,
+        # shard-local spec: same control arrays (replicated operands),
+        # no kernel-level mesh (this shard_map already owns the layout),
+        # kv_tp=1 scale-row layout (the local slab's own layout)
+        local = llama.AttnSpec(
+            slot_matrix=attn_l.slot_matrix,
+            block_tables=attn_l.block_tables,
+            lengths=attn_l.lengths,
+            write_pos=attn_l.write_pos,
+            write_tables=attn_l.write_tables,
+            q_pos0=attn_l.q_pos0,
+            page_size=attn_l.page_size,
+            interpret=attn_l.interpret,
+            mesh=None,
+            kv_tp=1,
+            prefix_cols=attn_l.prefix_cols,
+            int4_groups=attn_l.int4_groups,
         )
-        new_k, new_v = [], []
-        for kp, vp, lp in zip(k_pools, v_pools, layers):
-            x_scat, kp, vp, _, _ = llama.layer_step(
-                lp, cfg, x_scat, cos, sin, kp, vp, ws, attn, pos,
+        # lists, not tuples: the out_specs pytrees below are list-shaped
+        new_k, new_v, new_ks, new_vs = [], [], [], []
+        for i, lp in enumerate(layers):
+            x_scat, kp, vp, ksp, vsp = llama.layer_step(
+                lp, cfg, x_scat, cos, sin, k_pools[i], v_pools[i],
+                ws, local, pos,
+                kv_ks=ks_pools[i] if quantized else None,
+                kv_vs=vs_pools[i] if quantized else None,
                 tp_axis="tp", tp_overlap=True, bt_shape=(b, t),
             )
             new_k.append(kp)
             new_v.append(vp)
+            if quantized:
+                new_ks.append(ksp)
+                new_vs.append(vsp)
         xf = ring_all_gather(x_scat, "tp")[:r]
-        # lists, not tuples: the out_specs pytree below is list-shaped
-        return xf.reshape(b, t, cfg.hidden_size), new_k, new_v
+        return xf.reshape(b, t, cfg.hidden_size), new_k, new_v, new_ks, new_vs
 
     layers = params["layers"]
-    hidden, new_k, new_v = compat.shard_map(
+    nl = len(layers)
+    kv_spec = [_P(None, "tp")] * nl
+    scale_spec = [_P(None, "tp", None)] * nl if quantized else []
+    hidden, new_k, new_v, new_ks, new_vs = compat.shard_map(
         prog,
         mesh=mesh,
         in_specs=(
-            _layer_in_specs(layers),
-            [_P(None, "tp")] * len(layers), [_P(None, "tp")] * len(layers),
-            _P(), _P(), _P(), _P(), _P(), _P(), _P(),
+            _layer_in_specs(layers), kv_spec, kv_spec,
+            scale_spec, scale_spec,
+            _P(), _P(), _P(), _P(),
+            jax.tree.map(lambda _: _P(), attn), _P(),
         ),
         out_specs=(
-            _P(), [_P(None, "tp")] * len(layers),
-            [_P(None, "tp")] * len(layers),
+            _P(), kv_spec, kv_spec, scale_spec, scale_spec,
         ),
         check_vma=False,
     )(
-        layers, list(kv.k), list(kv.v), x, cos, sin,
-        write_slots, slot_matrix, positions, q_lens_arr,
+        layers, list(kv.k), list(kv.v),
+        list(kv.ks) if quantized else [],
+        list(kv.vs) if quantized else [],
+        x, cos, sin, write_slots, attn, positions,
     )
 
-    kv = llama.KVCache(k=tuple(new_k), v=tuple(new_v))
+    kv = llama.KVCache(
+        k=tuple(new_k), v=tuple(new_v),
+        ks=tuple(new_ks) if quantized else None,
+        vs=tuple(new_vs) if quantized else None,
+    )
     hidden = rms_norm(
         hidden, params["final_norm"], cfg.rms_norm_eps,
         weight_offset=cfg.norm_weight_offset,

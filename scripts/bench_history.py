@@ -242,6 +242,50 @@ def normalize(doc: dict) -> dict:
             metrics["kv_capacity.int4_vs_int8_toks"] = Metric(
                 v, True, kctx, rtol=0.50, atol=0.2
             )
+    tov = doc.get("tp_overlap")
+    if isinstance(tov, dict):
+        note_prov(tov)
+        # the leg's backend/KV-tier descriptor IS the comparability key:
+        # a pallas/quantized leg must never gate against the gather-
+        # backend baseline trajectory (different kernels, different byte
+        # floors), and a shape change is a different experiment
+        base_ctx = _ctx(
+            "tp_overlap", tov.get("model"), tov.get("tp"),
+            tov.get("rows"), tov.get("hidden_size"), "gather", "bf16",
+        )
+        v = _num(tov.get("exposed_ratio"))
+        if v is not None:
+            # exactly 0.5 by construction — drift means the ring
+            # executor's byte schedule changed
+            metrics["tp_overlap.exposed_ratio"] = Metric(
+                v, False, base_ctx, rtol=0.001
+            )
+        v = _num(tov.get("layer_step_overlap_speedup"))
+        if v is not None:
+            # CPU virtual-device wall: scheduling-shape trend only
+            metrics["tp_overlap.layer_step_speedup"] = Metric(
+                v, True, base_ctx, rtol=0.5, atol=0.2
+            )
+        for tier, leg in (tov.get("pallas_legs") or {}).items():
+            if not isinstance(leg, dict):
+                continue
+            lctx = _ctx(
+                "tp_overlap", tov.get("model"), tov.get("tp"),
+                tov.get("rows"), tov.get("hidden_size"),
+                leg.get("backend"), tier,
+                "packed" if leg.get("kv_packed") else "dense",
+            )
+            v = _num(leg.get("exposed_ratio"))
+            if v is not None:
+                metrics[f"tp_overlap.pallas_{tier}.exposed_ratio"] = Metric(
+                    v, False, lctx, rtol=0.001
+                )
+            ov_w = _num(leg.get("layer_step_wall_s"))
+            fb_w = _num(leg.get("fallback_layer_step_wall_s"))
+            if ov_w and fb_w:
+                metrics[f"tp_overlap.pallas_{tier}.wall_vs_fallback"] = (
+                    Metric(ov_w / fb_w, False, lctx, rtol=0.5, atol=0.25)
+                )
     scenarios = doc.get("scenarios")
     if isinstance(scenarios, dict):
         note_prov(scenarios)

@@ -8,7 +8,12 @@ suspect), and byte-compares against a single-device engine of the same
 config — a sharded-path hang reads as the CI job's own timeout (red),
 and a sharded-path divergence reads as the mismatch assert (red).
 
-Run:  python scripts/multichip_smoke.py        (~1-3 min on CPU)
+Four legs: gather tp=8 vs tp=1, the gather tp_overlap executor (cold +
+warm waves), and the pallas+int8 packed-KV tp_overlap executor (cold +
+warm waves, executor-attribution counters proving no GSPMD fallback) —
+each byte-compared against its own tp=1 reference.
+
+Run:  python scripts/multichip_smoke.py        (~2-6 min on CPU)
 CI:   pre-merge.yml `multichip-smoke` job, wrapped in `timeout` so a
       hang can never eat the runner.
 """
@@ -67,6 +72,33 @@ def make_engine(tp: int, tp_overlap: bool = False) -> JaxEngine:
             prefill_chunk=32,
             # the r05 suspect paths stay ON: pipelined mixed steps over
             # the sharded mesh are exactly what a smoke must cover
+            mixed_batching=True,
+            step_pipeline=True,
+            tp_overlap=tp_overlap,
+            seed=0,
+        )
+    )
+    _ENGINES.append(engine)
+    return engine
+
+
+def make_pallas_engine(tp: int, tp_overlap: bool = False) -> JaxEngine:
+    """The production serving combination: pallas kernels (interpret on
+    CPU) + int8 KV in int32-PACKED pools + mixed batching + the step
+    pipeline. page_size=128 is the pallas+quantized floor (scale-page
+    tokens live in lanes), so each sequence is one page."""
+    engine = JaxEngine(
+        EngineConfig(
+            model=CFG,
+            dtype="float32",
+            mesh=MeshConfig(tp=tp),
+            attn_backend="pallas",
+            kv_quantization="int8",
+            page_size=128,
+            num_pages=8,
+            max_batch_size=4,
+            max_model_len=128,
+            prefill_chunk=128,
             mixed_batching=True,
             step_pipeline=True,
             tp_overlap=tp_overlap,
@@ -156,11 +188,47 @@ async def main() -> None:
     )
     assert moved > 0, f"overlap engine recorded no collective bytes: {stats}"
 
+    # pallas + packed int8 KV leg: the production backend combination
+    # through the SAME overlap executor (the kernels' per-layer
+    # shard_maps collapse into its single one) — mixed+pipeline stay on,
+    # cold and warm waves, byte-compared against a tp=1 engine of the
+    # same pallas+int8 config
+    pal1 = make_pallas_engine(tp=1)
+    want_pal = await serve(pal1)
+    await pal1.close()
+
+    pal8 = make_pallas_engine(tp=8, tp_overlap=True)
+    assert pal8._tp_overlap_manual, (
+        "pallas tp_overlap engine fell back to GSPMD: "
+        f"{pal8.tp_overlap_refusal_reason!r}"
+    )
+    assert pal8._attn_pallas and pal8._kv_packed, "leg lost the pallas+packed path"
+    got_pal = await serve(pal8)
+    got_pal2 = await serve(pal8)  # warm wave
+    pal_metrics = pal8.metrics()
+    await pal8.close()
+    assert got_pal == want_pal, (
+        f"pallas+int8 tp=8 tp_overlap diverged from tp=1:\n{got_pal}\nvs\n{want_pal}"
+    )
+    assert got_pal2 == want_pal, (
+        f"pallas+int8 tp=8 second wave diverged:\n{got_pal2}\nvs\n{want_pal}"
+    )
+    # executor attribution: every tp-collective dispatch went through the
+    # overlap executor, none fell back to GSPMD
+    served = pal_metrics["tp_overlap_dispatches"]
+    fell_back = pal_metrics["gspmd_fallback_dispatches"]
+    assert served > 0, f"no dispatch attributed to the overlap executor: {pal_metrics}"
+    assert fell_back == 0, (
+        f"{fell_back} dispatches fell back to GSPMD on the overlap engine"
+    )
+
     print(
         f"multichip smoke ok: {n_dev} devices, tp=8, "
         f"{len(PROMPTS)} streams x {MAX_TOKENS} tokens byte-identical "
         "to tp=1 (mixed+pipeline on; overlap leg byte-identical, "
-        f"{moved} exposed collective bytes attributed)"
+        f"{moved} exposed collective bytes attributed; pallas+int8 "
+        f"packed-KV overlap leg byte-identical, {served} dispatches "
+        "served by the executor, 0 GSPMD fallbacks)"
     )
 
 
@@ -173,7 +241,7 @@ if __name__ == "__main__":
     _tracing.enable()
     _tracing.set_process("multichip-smoke")
     try:
-        asyncio.run(asyncio.wait_for(main(), timeout=540))
+        asyncio.run(asyncio.wait_for(main(), timeout=840))
     except asyncio.TimeoutError:
         path = dump_timeout_artifact()
         print(

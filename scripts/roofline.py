@@ -64,6 +64,34 @@ def matmul_params(cfg) -> tuple[int, int]:
     return cfg.num_layers * per_layer, cfg.hidden_size * cfg.vocab_size
 
 
+def kv_write_bytes_per_token(
+    cfg, itemsize: int = 2, kv_quant: str | None = None,
+    kv_quant_group: int | None = None,
+) -> int:
+    """Per-token KV-write HBM bytes for the pool tier actually served.
+
+    The quantized tiers write int8 bytes (one per element) or int4
+    nibbles (half) plus the f32 scale channels (one per kv head for
+    int8; head_dim/kv_quant_group per head for int4 — ops/quant.py), so
+    a pallas+packed dispatch must NOT be read against the bf16 byte
+    floor: at 8B dims the int8 tier's floor is ~0.53x bf16's and int4's
+    ~0.28x — accounting them at bf16 bytes would overstate achieved HBM
+    by 2-4x on exactly the legs the packed-KV executor serves."""
+    k_and_v = 2 * cfg.kv_size * cfg.num_layers
+    if kv_quant == "int8":
+        scale_ch = cfg.num_kv_heads
+        return k_and_v + 2 * scale_ch * 4 * cfg.num_layers
+    if kv_quant == "int4":
+        groups = cfg.head_dim // (kv_quant_group or cfg.head_dim)
+        scale_ch = cfg.num_kv_heads * groups
+        return k_and_v // 2 + 2 * scale_ch * 4 * cfg.num_layers
+    if kv_quant is not None:
+        raise ValueError(
+            f"unknown kv_quant {kv_quant!r}; expected 'int8' or 'int4'"
+        )
+    return k_and_v * itemsize
+
+
 def build_ledger(
     digests: list,
     fields: list,
@@ -74,6 +102,8 @@ def build_ledger(
     peak_flops: float = PEAK_FLOPS,
     peak_hbm: float = PEAK_HBM,
     peak_ici: float = PEAK_ICI,
+    kv_quant: str | None = None,
+    kv_quant_group: int | None = None,
 ) -> dict:
     """The join: digest rows keyed by kind x the per-kind collective
     counters, normalized into achieved-vs-peak rates."""
@@ -82,7 +112,9 @@ def build_ledger(
     stack_params, _head_params = matmul_params(cfg)
     flops_per_tok = 2 * stack_params
     weight_bytes = stack_params * itemsize
-    kv_write_per_tok = 2 * cfg.kv_size * cfg.num_layers * itemsize
+    kv_write_per_tok = kv_write_bytes_per_token(
+        cfg, itemsize, kv_quant, kv_quant_group
+    )
 
     ledger = {}
     for kind in DISPATCH_KINDS:
@@ -128,6 +160,8 @@ def build_ledger(
     return {
         "model": cfg.name,
         "itemsize": itemsize,
+        "kv_quant": kv_quant,
+        "kv_write_bytes_per_token": kv_write_per_tok,
         "flops_per_token": flops_per_tok,
         "weight_stream_bytes": weight_bytes,
         "peaks": {"flops": peak_flops, "hbm": peak_hbm, "ici": peak_ici},
@@ -241,6 +275,17 @@ def main() -> None:
         "--itemsize", type=int, default=2,
         help="weight/KV element bytes (2 = bf16)",
     )
+    ap.add_argument(
+        "--kv-quant", choices=("int8", "int4"), default=None,
+        help="KV pool tier the engine served: int8/int4 write quantized "
+             "bytes + f32 scale tiles, not --itemsize bytes (the "
+             "pallas+packed legs must not be read against bf16 floors)",
+    )
+    ap.add_argument(
+        "--kv-quant-group", type=int, default=None,
+        help="int4 scale-group width in features (default head_dim: one "
+             "scale group per kv head)",
+    )
     ap.add_argument("--peak-flops", type=float, default=PEAK_FLOPS)
     ap.add_argument("--peak-hbm", type=float, default=PEAK_HBM)
     ap.add_argument("--peak-ici", type=float, default=PEAK_ICI)
@@ -267,6 +312,7 @@ def main() -> None:
         digests, fields, kinds, stats, cfg,
         itemsize=args.itemsize, peak_flops=args.peak_flops,
         peak_hbm=args.peak_hbm, peak_ici=args.peak_ici,
+        kv_quant=args.kv_quant, kv_quant_group=args.kv_quant_group,
     )
     if args.json:
         print(json.dumps(ledger, indent=2))

@@ -24,8 +24,20 @@ What it measures (parallel/tp_overlap.py, docs/parallelism.md):
 - **Greedy byte-identity** — `tp_overlap_forward` argmax tokens vs the
   tp=1 `llama.forward` (the FP reduction-order invariant the serving
   path relies on).
+- **Pallas + packed-KV legs** (`pallas_legs` in the JSON): the same
+  invariants on the PRODUCTION serving combination — pallas prefill
+  kernels (interpret mode on CPU) over int32-PACKED int8 and int4
+  pools, whole-forward through `tp_overlap_forward` vs (a) tp=1 with
+  the same kernels and (b) the GSPMD-fallback leg (per-layer kernel
+  shard_maps + GSPMD-inserted psums, what `tp_overlap=False` serves).
+  Gated: greedy byte-identity vs tp=1, the per-layer-segment exposed
+  bytes exactly 0.5x the serialized closed form, total wire bytes
+  conserved, and per-layer wall bounded vs the fallback leg (see
+  PALLAS_WALL_SLACK — virtual CPU devices serialize the ring chunk
+  ops a real rig overlaps, so the CPU gate bounds the known
+  serialization cost rather than asserting a speedup).
 
-Run:  python scripts/tp_overlap_bench.py        (~1 min on CPU)
+Run:  python scripts/tp_overlap_bench.py        (~4 min on CPU)
 """
 
 import json
@@ -71,6 +83,146 @@ def _inputs(b, t, page=8):
         [np.arange(page * (1 + 8 * i), page * (1 + 8 * i) + t) for i in range(b)]
     ).astype(np.int32)
     return tokens, positions, wslots, wslots.copy()
+
+
+# CPU-noise slack on the pallas-leg wall gate. Both legs run the same 8
+# sequential interpret-kernel shard bodies, but the overlap executor's
+# decomposed rings issue ~n chunked ppermute+matmul ops where GSPMD
+# fuses one psum — traffic a real rig hides under the MXU, but on
+# virtual CPU devices every chunk op is serialized wall time (measured
+# ~2.8x on an idle 8-core host). The default slack bounds that known
+# serialization cost so a genuine compute regression in the executor
+# (say, re-quantizing per ring chunk) still reads red; on the real rig
+# set BENCH_TP_OVERLAP_WALL_SLACK=1.0 to assert the actual "no worse
+# than fallback" property the overlap claims.
+WALL_SLACK = float(os.environ.get("BENCH_TP_OVERLAP_WALL_SLACK", "1.5"))
+PALLAS_WALL_SLACK = float(
+    os.environ.get("BENCH_TP_OVERLAP_PALLAS_WALL_SLACK", "4.0")
+)
+
+
+def _pallas_leg(tier: str, params, mesh) -> dict:
+    """One pallas+packed-KV leg: interpret-mode page-scatter write +
+    flash prefill over int32-packed `tier` pools, tp=8 overlap executor
+    vs tp=1 and vs the GSPMD fallback (per-layer kernel shard_maps)."""
+    page = 8
+    tokens, positions, wslots, _ = _inputs(B, T, page=page)
+    ppseq = T // page
+    btables = np.stack(
+        [np.arange(1 + 8 * i, 1 + 8 * i + ppseq) for i in range(B)]
+    ).astype(np.int32)
+    wtables = btables.reshape(-1)
+    smat = (
+        btables[:, :, None] * page + np.arange(page, dtype=np.int32)
+    ).reshape(B, -1)
+    groups = 1 if tier == "int4" else 0
+
+    def spec(kv_tp, with_mesh):
+        return llama.AttnSpec.gather(
+            jnp.asarray(smat), write_tables=jnp.asarray(wtables),
+            page_size=page, interpret=True,
+            mesh=mesh if with_mesh else None,
+            block_tables=jnp.asarray(btables),
+            q_pos0=jnp.zeros(B, jnp.int32),
+            lengths=jnp.full(B, T, jnp.int32),
+            kv_tp=kv_tp, int4_groups=groups,
+        )
+
+    def fresh_kv(tp):
+        return llama.init_kv_cache(
+            CFG, 512, kv_quant=tier, page_size=page, tp=tp, packed=True
+        )
+
+    tok_j, pos_j = jnp.asarray(tokens), jnp.asarray(positions)
+    ws_j = jnp.asarray(wslots.reshape(-1))
+
+    # tp=1 reference: same interpret kernels, mesh-free spec
+    ref_hidden, _ = llama.forward(
+        params, CFG, tok_j, pos_j, fresh_kv(1), ws_j, spec(1, False)
+    )
+    ref_tok = np.asarray(
+        jnp.argmax(llama.logits(params, CFG, ref_hidden[:, -1]), -1)
+    )
+
+    # overlap executor leg — ledger armed around the trace
+    spec8 = spec(TP, False)
+    ov_fn = jax.jit(
+        lambda p, kv: ov.tp_overlap_forward(
+            p, CFG, tok_j, pos_j, kv, ws_j, spec8, mesh
+        )
+    )
+    kv8 = fresh_kv(TP)
+    with compat.set_mesh(mesh):
+        with ov.record_collectives() as led:
+            hidden = jax.block_until_ready(ov_fn(params, kv8)[0])
+        ov_walls = []
+        for _ in range(REPS):
+            t0 = time.perf_counter()
+            jax.block_until_ready(ov_fn(params, kv8)[0])
+            ov_walls.append(time.perf_counter() - t0)
+    ov_tok = np.asarray(
+        jnp.argmax(llama.logits(params, CFG, hidden[:, -1]), -1)
+    )
+
+    # GSPMD fallback leg: sharded params, per-layer kernel shard_maps,
+    # XLA-inserted psums (what tp_overlap=False serves on this shape)
+    sh_params = meshmod.shard_params(params, CFG, mesh)
+    kv_sh = meshmod.kv_cache_sharding(mesh)
+    kv8_fb = jax.tree.map(lambda a: jax.device_put(a, kv_sh), fresh_kv(TP))
+    fb_spec = spec(TP, True)
+    fb_fn = jax.jit(
+        lambda p, kv: llama.forward(
+            p, CFG, tok_j, pos_j, kv, ws_j, fb_spec
+        )
+    )
+    with compat.set_mesh(mesh):
+        fb_hidden = jax.block_until_ready(fb_fn(sh_params, kv8_fb)[0])
+        fb_walls = []
+        for _ in range(REPS):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fb_fn(sh_params, kv8_fb)[0])
+            fb_walls.append(time.perf_counter() - t0)
+    fb_tok = np.asarray(
+        jnp.argmax(llama.logits(params, CFG, fb_hidden[:, -1]), -1)
+    )
+
+    # byte ledger: per-layer segment exposed = exactly half the
+    # serialized closed form; the one standalone final all-gather
+    # (residual reassembly after the last layer) rides on top
+    nl = CFG.num_layers
+    rs = (TP - 1) * B * T * CFG.hidden_size * 4 // TP
+    seg_exposed = led.exposed - rs
+    serialized = nl * ov.collective_bytes_per_layer(
+        CFG.hidden_size, B * T, TP, itemsize=4, overlap=False
+    )
+    assert seg_exposed * 2 == serialized, (tier, led.exposed, serialized)
+    assert led.total - rs == serialized, (tier, led.total, serialized)
+
+    identical = bool(np.array_equal(ref_tok, ov_tok))
+    assert identical, (tier, ref_tok, ov_tok)
+    assert np.array_equal(ref_tok, fb_tok), (tier, ref_tok, fb_tok)
+
+    ov_layer = min(ov_walls) / nl
+    fb_layer = min(fb_walls) / nl
+    assert ov_layer <= fb_layer * PALLAS_WALL_SLACK, (
+        tier, ov_layer, fb_layer
+    )
+
+    return {
+        "kv_tier": tier,
+        "backend": "pallas-interpret",
+        "kv_packed": True,
+        "layer_step_wall_s": round(ov_layer, 6),
+        "fallback_layer_step_wall_s": round(fb_layer, 6),
+        "exposed_bytes": led.exposed,
+        "overlapped_bytes": led.overlapped,
+        "total_bytes": led.total,
+        "final_gather_bytes": rs,
+        "exposed_ratio": seg_exposed / serialized,
+        "total_bytes_conserved": True,
+        "greedy_byte_identical_vs_tp1": identical,
+        "wall_gate_slack": PALLAS_WALL_SLACK,
+    }
 
 
 def run() -> dict:
@@ -151,6 +303,12 @@ def run() -> dict:
     identical = bool(np.array_equal(ref_tok, ov_tok))
     assert identical, (ref_tok, ov_tok)
 
+    # the production serving combination: pallas kernels + packed
+    # quantized pools through the same executor, both KV tiers
+    pallas_legs = {
+        tier: _pallas_leg(tier, params, mesh) for tier in ("int8", "int4")
+    }
+
     return {
         "devices": 8,
         "tp": TP,
@@ -166,6 +324,7 @@ def run() -> dict:
             base["layer_step_wall_s"] / over["layer_step_wall_s"], 4
         ),
         "greedy_byte_identical_vs_tp1": identical,
+        "pallas_legs": pallas_legs,
         "note": (
             "CPU virtual devices run the rings sequentially: the wall "
             "delta is scheduling shape, not the TPU speedup; the gated "
@@ -186,4 +345,14 @@ if __name__ == "__main__":
         ),
         file=sys.stderr,
     )
+    for tier, leg in out["pallas_legs"].items():
+        print(
+            "tp_overlap pallas+{}: exposed_ratio={} wall overlap={}s "
+            "fallback={}s identical={}".format(
+                tier, leg["exposed_ratio"], leg["layer_step_wall_s"],
+                leg["fallback_layer_step_wall_s"],
+                leg["greedy_byte_identical_vs_tp1"],
+            ),
+            file=sys.stderr,
+        )
     print(json.dumps(out))

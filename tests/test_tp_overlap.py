@@ -252,17 +252,16 @@ def test_pp_composes_with_tp_overlap():
     )
 
 
-def test_tp_overlap_forward_refuses_quantized_kv_and_moe():
+def test_tp_overlap_forward_refuses_moe_and_sp_ring():
+    """The two REMAINING refusals: MoE routing (all-to-all expert
+    dispatch doesn't decompose into row rings) and the sp ring prefill
+    (the ring owns the token axis the executor wants to scatter).
+    Quantized KV composes since the packed-KV executor rev — see the
+    equivalence tests below."""
     mesh = _mesh()
     b, t = 2, 8
     tokens, positions, wslots, smat = _inputs(b, t)
     params = llama.init_params(CFG, jax.random.PRNGKey(0), dtype=jnp.float32)
-    kvq = llama.init_kv_cache(CFG, 512, kv_quant="int8", page_size=8, tp=1)
-    with pytest.raises(ValueError, match="unquantized"):
-        ov.tp_overlap_forward(
-            params, CFG, jnp.asarray(tokens), jnp.asarray(positions), kvq,
-            jnp.asarray(wslots.reshape(-1)), jnp.asarray(smat), mesh,
-        )
     kv = llama.init_kv_cache(CFG, 512, dtype=jnp.float32)
     with pytest.raises(ValueError, match="dense"):
         ov.tp_overlap_forward(
@@ -270,6 +269,229 @@ def test_tp_overlap_forward_refuses_quantized_kv_and_moe():
             jnp.asarray(positions), kv, jnp.asarray(wslots.reshape(-1)),
             jnp.asarray(smat), mesh,
         )
+    ring_spec = llama.AttnSpec.ring(jnp.asarray(smat), mesh, page_size=8)
+    with pytest.raises(ValueError, match="ring"):
+        ov.tp_overlap_forward(
+            params, CFG, jnp.asarray(tokens), jnp.asarray(positions), kv,
+            jnp.asarray(wslots.reshape(-1)), ring_spec, mesh,
+        )
+
+
+def test_forward_overlap_int8_kv_matches_tp1():
+    """int8 dense KV (gather read path) under the overlap executor: the
+    shard-local spec rebuild (kv_tp=1 over local scale channels) must
+    reproduce the tp=1 quantized forward — same greedy argmax, hidden
+    within manual-tp float tolerance."""
+    mesh = _mesh()
+    b, t = 4, 16
+    tokens, positions, wslots, smat = _inputs(b, t)
+    params = llama.init_params(CFG, jax.random.PRNGKey(0), dtype=jnp.float32)
+
+    kv1 = llama.init_kv_cache(CFG, 512, kv_quant="int8", page_size=8, tp=1)
+    ref_hidden, ref_kv = llama.forward(
+        params, CFG, jnp.asarray(tokens), jnp.asarray(positions), kv1,
+        jnp.asarray(wslots.reshape(-1)),
+        llama.AttnSpec.gather(jnp.asarray(smat), page_size=8, kv_tp=1),
+    )
+
+    # tp=8 pools carry the tp-blocked scale layout (ops/quant.kv_scale_subl)
+    kv8 = llama.init_kv_cache(CFG, 512, kv_quant="int8", page_size=8, tp=TP)
+    spec8 = llama.AttnSpec.gather(jnp.asarray(smat), page_size=8, kv_tp=TP)
+    with compat.set_mesh(mesh):
+        hidden, kv_out = ov.tp_overlap_forward(
+            params, CFG, jnp.asarray(tokens), jnp.asarray(positions), kv8,
+            jnp.asarray(wslots.reshape(-1)), spec8, mesh,
+        )
+    assert kv_out.k[0].dtype == jnp.int8
+    assert kv_out.ks[0].shape[1] == TP * 8  # tp-blocked scale sublanes
+    np.testing.assert_allclose(np.asarray(hidden), np.asarray(ref_hidden),
+                               rtol=2e-4, atol=2e-4)
+    lg_ref = llama.logits(params, CFG, ref_hidden[:, -1])
+    lg_ov = llama.logits(params, CFG, hidden[:, -1])
+    assert np.array_equal(
+        np.asarray(jnp.argmax(lg_ref, -1)), np.asarray(jnp.argmax(lg_ov, -1))
+    )
+    # the written slots actually hold quantized rows (not pool zeros)
+    w0 = np.asarray(kv_out.k[0])[wslots.reshape(-1)]
+    assert np.any(w0 != 0)
+    # dequantized written rows agree with the tp=1 reference within one
+    # int8 bucket (a 1-ULP pre-quant diff may flip a rounding boundary)
+    from dynamo_tpu.ops.quant import dequantize_kv_rows, gather_kv_scales
+
+    flat = jnp.asarray(wslots.reshape(-1))
+    for layer in (0, CFG.num_layers - 1):
+        got = dequantize_kv_rows(
+            kv_out.k[layer][flat],
+            gather_kv_scales(kv_out.ks[layer], flat, CFG.num_kv_heads, TP),
+        )
+        want = dequantize_kv_rows(
+            ref_kv.k[layer][flat],
+            gather_kv_scales(ref_kv.ks[layer], flat, CFG.num_kv_heads, 1),
+        )
+        scale = float(jnp.max(jnp.abs(want))) / 127.0
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), atol=2.5 * scale, rtol=0
+        )
+
+
+@pytest.mark.parametrize("tier", ["int8", "int4"])
+def test_forward_overlap_packed_pallas_prefill_matches_tp1(tier):
+    """The pallas serving combination the executor was extended for:
+    int32-PACKED quantized pools + the pallas page-scatter write + flash
+    prefill kernels (interpret mode on CPU), tp=8 overlap vs tp=1. The
+    kernels' per-layer shard_maps collapse into the executor's single
+    one; block tables, packed pools and scale tiles ride shard-local."""
+    mesh = _mesh()
+    b, t, page = 4, 16, 8
+    tokens, positions, wslots, smat = _inputs(b, t, page=page)
+    params = llama.init_params(CFG, jax.random.PRNGKey(0), dtype=jnp.float32)
+    quant = tier
+
+    # _inputs rows write slots [page*(1+8i), page*(1+8i)+t): pages
+    # 1+8i, 2+8i per sequence — contiguous, page-aligned, trash-free
+    ppseq = t // page
+    btables = np.stack(
+        [np.arange(1 + 8 * i, 1 + 8 * i + ppseq) for i in range(b)]
+    ).astype(np.int32)
+    wtables = btables.reshape(-1).astype(np.int32)
+    q_pos0 = np.zeros(b, np.int32)
+    lens = np.full(b, t, np.int32)
+
+    def spec(kv_tp):
+        return llama.AttnSpec.gather(
+            jnp.asarray(smat), write_tables=jnp.asarray(wtables),
+            page_size=page, interpret=True,
+            block_tables=jnp.asarray(btables),
+            q_pos0=jnp.asarray(q_pos0), lengths=jnp.asarray(lens),
+            kv_tp=kv_tp,
+            # int4 pools are nibble-packed at half width, so the kernels
+            # need the static tier flag (pallas requires groups == 1)
+            int4_groups=1 if tier == "int4" else 0,
+        )
+
+    kv1 = llama.init_kv_cache(
+        CFG, 512, kv_quant=quant, page_size=page, tp=1, packed=True
+    )
+    assert kv1.k[0].dtype == jnp.int32
+    ref_hidden, ref_kv = llama.forward(
+        params, CFG, jnp.asarray(tokens), jnp.asarray(positions), kv1,
+        jnp.asarray(wslots.reshape(-1)), spec(1),
+    )
+
+    kv8 = llama.init_kv_cache(
+        CFG, 512, kv_quant=quant, page_size=page, tp=TP, packed=True
+    )
+    with compat.set_mesh(mesh):
+        hidden, kv_out = ov.tp_overlap_forward(
+            params, CFG, jnp.asarray(tokens), jnp.asarray(positions), kv8,
+            jnp.asarray(wslots.reshape(-1)), spec(TP), mesh,
+        )
+    assert kv_out.k[0].dtype == jnp.int32
+    np.testing.assert_allclose(np.asarray(hidden), np.asarray(ref_hidden),
+                               rtol=3e-4, atol=3e-4)
+    # the serving property that gates the engine dispatch: greedy streams
+    # byte-identical to tp=1
+    lg_ref = llama.logits(params, CFG, ref_hidden[:, -1])
+    lg_ov = llama.logits(params, CFG, hidden[:, -1])
+    assert np.array_equal(
+        np.asarray(jnp.argmax(lg_ref, -1)), np.asarray(jnp.argmax(lg_ov, -1))
+    )
+    # packed page writes landed (row group of the first written page)
+    g0 = int(wslots[0, 0]) // 4
+    assert np.any(np.asarray(kv_out.k[0])[g0] != 0)
+
+
+def test_forward_overlap_quantized_weights_matches_tp1_bitwise():
+    """int8 quantized WEIGHTS under the executor: ring_rs_matmul carries
+    the row-parallel projections' int32 accumulator across the ring
+    (integer addition is associative), and the global activation scale is
+    a pmax of per-shard absmaxes — so quantized layers are bitwise
+    tp=1-identical, a property the serialized per-shard-scale manual-tp
+    path never had."""
+    from dynamo_tpu.ops.quant import quantize_params
+
+    mesh = _mesh()
+    b, t = 4, 16
+    tokens, positions, wslots, smat = _inputs(b, t)
+    params = quantize_params(
+        llama.init_params(CFG, jax.random.PRNGKey(0), dtype=jnp.float32), CFG
+    )
+
+    kv1 = llama.init_kv_cache(CFG, 512, dtype=jnp.float32)
+    ref_hidden, _ = llama.forward(
+        params, CFG, jnp.asarray(tokens), jnp.asarray(positions), kv1,
+        jnp.asarray(wslots.reshape(-1)), jnp.asarray(smat),
+    )
+    kv8 = llama.init_kv_cache(CFG, 512, dtype=jnp.float32)
+    with compat.set_mesh(mesh):
+        hidden, _ = ov.tp_overlap_forward(
+            params, CFG, jnp.asarray(tokens), jnp.asarray(positions), kv8,
+            jnp.asarray(wslots.reshape(-1)), jnp.asarray(smat), mesh,
+            page_size=8,
+        )
+    np.testing.assert_allclose(np.asarray(hidden), np.asarray(ref_hidden),
+                               rtol=2e-4, atol=2e-4)
+    lg_ref = llama.logits(params, CFG, ref_hidden[:, -1])
+    lg_ov = llama.logits(params, CFG, hidden[:, -1])
+    assert np.array_equal(
+        np.asarray(jnp.argmax(lg_ref, -1)), np.asarray(jnp.argmax(lg_ov, -1))
+    )
+
+
+# ---------------------------------------------------------------------------
+# compile-variant census: the overlap executor adds no variant family
+# ---------------------------------------------------------------------------
+
+
+async def test_compile_census_flat_with_tp_overlap_pallas():
+    """tp_overlap=1 on the pallas+quantized backend must not mint a new
+    compile-variant family per shape bucket: the executor REPLACES the
+    per-layer forward inside the same dispatch entry points, so serving
+    the same workload compiles no more executables than the GSPMD leg
+    (process-global census, engine/telemetry.py compile listener)."""
+    from dynamo_tpu.engine import EngineConfig, JaxEngine, telemetry
+    from dynamo_tpu.llm.protocols.common import (
+        PreprocessedRequest, SamplingOptions, StopConditions,
+    )
+    from dynamo_tpu.parallel.mesh import MeshConfig
+    from dynamo_tpu.runtime.pipeline.context import Context
+
+    def eng(tp_overlap):
+        return JaxEngine(EngineConfig(
+            model=CFG, dtype="float32", mesh=MeshConfig(tp=2),
+            attn_backend="pallas", kv_quantization="int8",
+            page_size=128, num_pages=8, max_batch_size=2,
+            max_model_len=256, prefill_chunk=128, tp_overlap=tp_overlap,
+            seed=0,
+        ))
+
+    async def serve(engine):
+        pre = PreprocessedRequest(
+            token_ids=[5, 17, 42, 9, 88, 3],
+            stop_conditions=StopConditions(max_tokens=4, ignore_eos=True),
+            sampling_options=SamplingOptions(greedy=True),
+        )
+        frames = [
+            f async for f in await engine.generate(Context(pre.to_dict()))
+        ]
+        return [t for f in frames for t in f.get("token_ids") or []]
+
+    telemetry.install_compile_listener()
+    deltas, tokens = {}, {}
+    for overlap in (False, True):
+        engine = eng(overlap)
+        c0 = telemetry.compile_stats()["compile_events"]
+        tokens[overlap] = await serve(engine)
+        deltas[overlap] = telemetry.compile_stats()["compile_events"] - c0
+        if overlap:
+            assert engine._tp_overlap_manual
+            assert engine.metrics()["tp_overlap_dispatches"] > 0
+        await engine.close()
+
+    assert tokens[True] == tokens[False]
+    assert deltas[True] <= deltas[False], (
+        f"tp_overlap minted extra compile variants: {deltas}"
+    )
 
 
 # ---------------------------------------------------------------------------
