@@ -59,6 +59,7 @@ class PageAllocator:
         page_size: int,
         on_event: Optional[Callable[[dict], None]] = None,
         on_cached: Optional[Callable[[int, "PageMeta"], None]] = None,
+        ledger=None,
     ):
         if num_pages < 2:
             raise ValueError("need at least 2 pages (page 0 is reserved)")
@@ -68,6 +69,13 @@ class PageAllocator:
         # called when a hashed page's refcount drops to 0 (it became
         # reusable-and-evictable) — the offload tier's write-through hook
         self.on_cached = on_cached
+        # optional KvLedger (engine/kv_ledger.py): every lifecycle
+        # transition gets stamped; release misuse becomes a typed
+        # violation instead of silent corruption
+        self.ledger = ledger
+        # standalone counters so direct-allocator users (tests) see the
+        # release-misuse taxonomy even without a ledger attached
+        self.release_violations = {"double_release": 0, "unknown_page": 0}
         self._free: deque[int] = deque(range(1, num_pages))
         self._meta: dict[int, PageMeta] = {}
         self._by_hash: dict[int, int] = {}  # sequence_hash -> page_id
@@ -148,6 +156,8 @@ class PageAllocator:
         if meta.refs == 0:
             self._lru.pop(sequence_hash, None)
         meta.refs += 1
+        if self.ledger is not None:
+            self.ledger.page_event(pid, "pin")
         self.peak_used = max(self.peak_used, self.pages_used)
         return pid
 
@@ -186,11 +196,15 @@ class PageAllocator:
             del self._by_hash[h]
             evicted.append(meta.sequence_hash)
             self._free.append(pid)
+            if self.ledger is not None:
+                self.ledger.page_event(pid, "evict")
         if evicted and self.on_event:
             self.on_event(removed_event(evicted))
         pages = [self._free.popleft() for _ in range(n)]
         for pid in pages:
             self._meta[pid] = PageMeta(refs=1)
+            if self.ledger is not None:
+                self.ledger.page_event(pid, "alloc")
         self.peak_used = max(self.peak_used, self.pages_used)
         return pages
 
@@ -212,6 +226,8 @@ class PageAllocator:
                 parent_hash = meta.sequence_hash
                 continue  # already registered (shared prefix page)
             meta.sequence_hash, meta.local_hash, meta.parent_hash = sh, lh, parent_hash
+            if self.ledger is not None:
+                self.ledger.page_event(pid, "register")
             if sh not in self._by_hash:
                 self._by_hash[sh] = pid
                 if not stored:
@@ -221,23 +237,43 @@ class PageAllocator:
         if stored and self.on_event:
             self.on_event(stored_event(stored, parent_hash=event_parent))
 
+    def _release_violation(self, kind: str, pid: int) -> None:
+        self.release_violations[kind] += 1
+        if self.ledger is not None:
+            self.ledger.violation(kind, page_ids=[pid])
+
     def release(self, page_ids: list[int]) -> None:
         """Drop one reference per page. Hashed pages at refs==0 stay cached
-        (LRU-evictable); unhashed pages free immediately."""
+        (LRU-evictable); unhashed pages free immediately.
+
+        Misuse is a counted, typed violation, never a silent mutation:
+        releasing an unknown page id ticks ``unknown_page``; releasing a
+        page whose refs are already 0 ticks ``double_release`` and skips
+        the page entirely — the old behavior drove refs negative and
+        re-freed/re-cached the page (free-list duplication, double
+        `on_cached` offload enqueues)."""
         for pid in page_ids:
             meta = self._meta.get(pid)
             if meta is None:
+                self._release_violation("unknown_page", pid)
+                continue
+            if meta.refs <= 0:
+                self._release_violation("double_release", pid)
                 continue
             meta.refs -= 1
             if meta.refs > 0:
                 continue
             if meta.sequence_hash is not None and self._by_hash.get(meta.sequence_hash) == pid:
                 self._lru[meta.sequence_hash] = pid
+                if self.ledger is not None:
+                    self.ledger.page_event(pid, "cache")
                 if self.on_cached:
                     self.on_cached(pid, meta)
             else:
                 del self._meta[pid]
                 self._free.append(pid)
+                if self.ledger is not None:
+                    self.ledger.page_event(pid, "free")
 
     def clear_cache(self) -> None:
         """Drop all refs==0 cached pages (emits removed)."""
@@ -248,6 +284,8 @@ class PageAllocator:
             del self._by_hash[h]
             del self._meta[pid]
             self._free.append(pid)
+            if self.ledger is not None:
+                self.ledger.page_event(pid, "clear")
         self._lru.clear()
         if self.on_event:
             self.on_event(removed_event(hashes))

@@ -197,6 +197,15 @@ class EngineConfig:
     # trigger knobs ride DYN_FLIGHT_* env vars). False disables the
     # ring entirely (byte-identical serving either way).
     flight_recorder: bool = True
+    # KV page-custody ledger audit period in seconds
+    # (engine/kv_ledger.py; docs/observability.md "KV ledger"). The
+    # audit runs at the top of the engine-loop tick — accounting
+    # identities, orphan detector, in-flight transfer deadlines — and a
+    # violation ticks kv_ledger_violations_total{kind} + arms the
+    # flight recorder's kv_leak trigger. None = DYN_KV_AUDIT_S env,
+    # default 5.0; 0 disables the audit (transition stamping stays on —
+    # it is O(1) per transition and feeds /debug/kv either way).
+    kv_audit_s: Optional[float] = None
     # ---- fleet control plane (docs/control.md) ----
     # tenant-priority scheduling: admission picks the highest-priority
     # waiting class (FIFO within a class) and preemption evicts the

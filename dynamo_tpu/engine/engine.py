@@ -85,6 +85,7 @@ from dynamo_tpu.ops.sampling import (
     verify_draft_tokens,
 )
 from dynamo_tpu.engine import flight_recorder as flightmod
+from dynamo_tpu.engine import kv_ledger as kvledgermod
 from dynamo_tpu.engine import profiler, telemetry
 from dynamo_tpu.parallel import mesh as meshmod
 from dynamo_tpu.runtime.pipeline.context import Context
@@ -540,6 +541,16 @@ class JaxEngine:
             self.num_pages, self.page_size, on_event=self._emit_event,
             on_cached=self._on_page_cached if config.host_kv_pages else None,
         )
+        # page-custody ledger (engine/kv_ledger.py): every allocator
+        # transition stamped, holdings attributed per request/plane, and
+        # a periodic loop audit (config.kv_audit_s / DYN_KV_AUDIT_S)
+        # runs the orphan detector; violations arm the flight
+        # recorder's kv_leak trigger via _on_kv_leak
+        self.kv_ledger = kvledgermod.KvLedger(
+            allocator=self.allocator,
+            on_leak=self._on_kv_leak,
+        )
+        self.allocator.ledger = self.kv_ledger
         # HBM->host offload tier (engine/offload.py); None when disabled
         self.host_pool = None
         # pause switch: a D2H page gather holds _kv_lock for its whole
@@ -577,6 +588,8 @@ class JaxEngine:
                     self._kv_scale_channels() if self._kv_quant else None
                 ),
             )
+            self.host_pool.ledger = self.kv_ledger
+            self.kv_ledger.host_pool = self.host_pool
 
         self.waiting: deque[Sequence] = deque()
         self.slots: list[Optional[Sequence]] = [None] * config.max_batch_size
@@ -792,6 +805,17 @@ class JaxEngine:
             context_fn=self._flight_context,
             directory=config.crash_dir,
         ) if config.flight_recorder else None
+        # KV ledger audit cadence: config.kv_audit_s wins, else
+        # DYN_KV_AUDIT_S, default 5 s; 0 disables. Runs at the top of
+        # the loop tick — O(pool) reads off the dispatch path.
+        audit_s = config.kv_audit_s
+        if audit_s is None:
+            try:
+                audit_s = float(os.environ.get("DYN_KV_AUDIT_S", "") or 5.0)
+            except ValueError:
+                audit_s = 5.0
+        self._kv_audit_s = float(audit_s)
+        self._kv_audit_next = 0.0
         # watchdog: in-flight device-critical ops (dispatch calls and
         # result fetches) register here as {token: (label, t_start)};
         # the monitor task trips the ladder + dumps a crash artifact
@@ -1120,6 +1144,13 @@ class JaxEngine:
             "kv_pages_free": self.allocator.pages_free,
             "kv_pages_peak_used": self.allocator.peak_used,
             "kv_fragmentation": round(self.allocator.fragmentation(), 4),
+            # custody ledger (engine/kv_ledger.py): cumulative violations
+            # by the audit + release misuse, pages currently attributed
+            # to orphans, completed audit passes, open in-flight windows
+            "kv_ledger_violations": self.kv_ledger.violations_total,
+            "kv_ledger_orphan_pages": len(self.kv_ledger.last_orphans),
+            "kv_ledger_audits": self.kv_ledger.audits_total,
+            "kv_ledger_inflight": len(self.kv_ledger._inflight),
             "slot_occupancy": (
                 round(active / len(self.slots), 4) if self.slots else 0.0
             ),
@@ -1974,6 +2005,7 @@ class JaxEngine:
                 return (first_token, *arrs)
             return (first_token, arrs[0], arrs[1], None, None)
         finally:
+            self._kv_drop(seq.page_ids, seq.ctx.id)
             self.allocator.release(seq.page_ids)
 
     def ingest_prefix(self, token_ids: list[int], k, v, ks=None, vs=None) -> int:
@@ -2007,15 +2039,19 @@ class JaxEngine:
         cached = self.allocator.match_prefix(
             [b.sequence_hash for b in blocks]
         )
+        self._kv_hold(cached, "sys:ingest")
         start = len(cached)
         if start == full_pages:
+            self._kv_drop(cached, "sys:ingest")
             self.allocator.release(cached)
             return full_pages * self.page_size
         need = full_pages - start
         pages = self.allocator.allocate(need)
         if pages is None:
+            self._kv_drop(cached, "sys:ingest")
             self.allocator.release(cached)
             return start * self.page_size
+        self._kv_hold(pages, "sys:ingest")
         t0, t1 = start * self.page_size, full_pages * self.page_size
         P = jax.sharding.PartitionSpec
         row_sh = jax.sharding.NamedSharding(self.mesh, P(None, None, "tp"))
@@ -2045,6 +2081,8 @@ class JaxEngine:
         )
         # drop this call's pins: the pages stay in the prefix cache
         # (evictable at refs 0) instead of leaking pinned forever
+        self._kv_drop(cached, "sys:ingest")
+        self._kv_drop(pages, "sys:ingest")
         self.allocator.release(cached)
         self.allocator.release(pages)
         return full_pages * self.page_size
@@ -2071,6 +2109,7 @@ class JaxEngine:
         pages = self.allocator.match_prefix(hashes)
         if not pages:
             return None
+        self._kv_hold(pages, "sys:export")
         try:
             ps = self.page_size
             slots = np.concatenate(
@@ -2080,6 +2119,7 @@ class JaxEngine:
                 out = self._extract_fn(self.kv, jnp.asarray(slots))
             arrs = tuple(np.asarray(a) for a in out)
         finally:
+            self._kv_drop(pages, "sys:export")
             self.allocator.release(pages)
         if len(arrs) == 4:
             return (len(pages) * ps, *arrs)
@@ -2354,6 +2394,13 @@ class JaxEngine:
         tracing.set_request(None)
         try:
             while not self._closed:
+                # custody audit (off the dispatch path; gated on its
+                # period so steady-state ticks pay one clock read)
+                if self._kv_audit_s > 0:
+                    now = time.monotonic()
+                    if now >= self._kv_audit_next:
+                        self._kv_audit_next = now + self._kv_audit_s
+                        self._run_kv_audit()
                 # offload first: pending write-through copies must pin
                 # their pages before this tick's admission can evict them
                 self._maybe_start_offload()
@@ -2436,7 +2483,23 @@ class JaxEngine:
                     return
                 if self.waiting or self._prefilling or self._inflight:
                     continue
-                await self._wake.wait()
+                if self._kv_audit_s > 0:
+                    # idle must not stall the custody audit: a request
+                    # that leaked pages at _finish has no successor to
+                    # wake the loop, so bound the sleep by the next
+                    # audit tick (zero cost while busy)
+                    try:
+                        await asyncio.wait_for(
+                            self._wake.wait(),
+                            timeout=max(
+                                self._kv_audit_next - time.monotonic(),
+                                0.001,
+                            ),
+                        )
+                    except asyncio.TimeoutError:
+                        pass
+                else:
+                    await self._wake.wait()
         except Exception:
             log.exception("engine loop crashed; failing all requests")
             for seq in list(self.waiting) + [s for s in self.slots if s]:
@@ -2678,6 +2741,7 @@ class JaxEngine:
                     )
                 host_run = []
         seq.page_ids = matched + fresh
+        self._kv_hold(seq.page_ids, seq.ctx.id, tenant=seq.tenant)
         seq.num_cached = (len(matched) + len(host_run)) * self.page_size
         seq.num_computed = seq.num_cached
         seq.registered_pages = len(matched) + len(host_run)
@@ -2926,7 +2990,51 @@ class JaxEngine:
                 {"op": lbl, "age_s": round(time.perf_counter() - t0, 3)}
                 for lbl, t0 in ops
             ],
+            # custody snapshot: the artifact for a kv_leak trigger names
+            # the orphaned pages and their last transitions right here
+            "kv_ledger": self.kv_ledger.snapshot(),
         }
+
+    # ---- KV custody ledger (engine/kv_ledger.py) ----------------------
+
+    def _kv_hold(self, page_ids: list[int], owner: str, tenant: str = "") -> None:
+        if page_ids:
+            self.kv_ledger.hold(page_ids, owner, tenant=tenant)
+
+    def _kv_drop(self, page_ids: list[int], owner: str) -> None:
+        if page_ids:
+            self.kv_ledger.drop(page_ids, owner)
+
+    def _run_kv_audit(self) -> None:
+        """One ledger audit pass; forensics must never break serving."""
+        try:
+            violations = self.kv_ledger.audit()
+        except Exception:
+            log.debug("kv ledger audit failed", exc_info=True)
+            return
+        if violations and self.flight is not None:
+            # ONE artifact per audit batch: the flight context already
+            # carries the full ledger snapshot (all violations, trails),
+            # and the cooldown makes a leak storm one dump anyway
+            v = violations[0]
+            owner = v.owner if v.owner and not v.owner.startswith("sys:") else None
+            try:
+                self.flight.trigger(f"kv_leak:{v.kind}", request_id=owner)
+            except Exception:
+                log.debug("kv_leak flight trigger failed", exc_info=True)
+
+    def _on_kv_leak(self, violation) -> None:
+        """Ledger hook for violations raised OUTSIDE an audit pass
+        (allocator release misuse fires synchronously at the call
+        site). Audit-pass violations arm the trigger in _run_kv_audit."""
+        if self.flight is None:
+            return
+        if violation.kind not in ("double_release", "unknown_page"):
+            return  # audit-raised kinds are handled by _run_kv_audit
+        try:
+            self.flight.trigger(f"kv_leak:{violation.kind}")
+        except Exception:
+            log.debug("kv_leak flight trigger failed", exc_info=True)
 
     def _flight_record(
         self, kind: str, wall_s: float, rows: int = 0, tokens: int = 0,
@@ -4602,6 +4710,7 @@ class JaxEngine:
             got = self.allocator.allocate(1)
             if got is not None:
                 seq.page_ids.extend(got)
+                self._kv_hold(got, seq.ctx.id, tenant=seq.tenant)
                 grew = True
                 continue
             live = [s for s in self.slots if s is not None]
@@ -4625,6 +4734,7 @@ class JaxEngine:
     def _preempt(self, seq: Sequence) -> None:
         log.info("preempting seq %s (out of KV pages)", seq.seq_id)
         self._register_full_pages(seq)
+        self._kv_drop(seq.page_ids, seq.ctx.id)
         self.allocator.release(seq.page_ids)
         self.slots[seq.slot] = None
         self._overrides.pop(seq.slot, None)
@@ -4742,8 +4852,10 @@ class JaxEngine:
             pid = self.allocator.pin(sh)
             if pid is None:
                 continue
+            self._kv_hold([pid], "sys:offload")
             buf = self.host_pool.reserve()
             if buf is None:
+                self._kv_drop([pid], "sys:offload")
                 self.allocator.release([pid])
                 self._pending_offload[sh] = (lh, parent)
                 break
@@ -4784,7 +4896,9 @@ class JaxEngine:
             # CancelledError (engine close) must not leak buffers or pins
             for _, _, _, _, buf in batch[consumed:]:
                 buf.release()
-            self.allocator.release([pid for _, _, _, pid, _ in batch])
+            pids = [pid for _, _, _, pid, _ in batch]
+            self._kv_drop(pids, "sys:offload")
+            self.allocator.release(pids)
             # re-arm the loop: remaining pending entries must offload
             # before admission traffic can evict their HBM pages
             self._wake.set()
@@ -4929,7 +5043,20 @@ class JaxEngine:
 
     def _finish(self, seq: Sequence, reason: str) -> None:
         self._register_full_pages(seq)
-        self.allocator.release(seq.page_ids)
+        try:
+            # chaos hook: an injected failure here LEAKS the pages —
+            # refs stay up, the ledger holding stays attributed to the
+            # finished request, and the next audit must flag the orphan
+            # (the census-under-faults test drives exactly this)
+            faults.fire("engine.release")
+        except faults.FaultError:
+            log.warning(
+                "fault injected: leaking %d KV page(s) of %s",
+                len(seq.page_ids), seq.ctx.id,
+            )
+        else:
+            self._kv_drop(seq.page_ids, seq.ctx.id)
+            self.allocator.release(seq.page_ids)
         if seq.slot >= 0:
             self._overrides.pop(seq.slot, None)
             self._carry_ok[seq.slot] = False
@@ -4987,6 +5114,10 @@ class JaxEngine:
                 req=seq.ctx.id, finish_reason=reason,
                 prompt_tokens=seq.prompt_len, tokens=seq.generated,
             )
+        # orphan watch: if this request still holds pages after its
+        # release path ran (a skipped release, a lost frame), the next
+        # ledger audit attributes the leak to this request id
+        self.kv_ledger.request_finished(seq.ctx.id)
         for cb in self._request_observers:
             try:
                 cb(summary)

@@ -79,7 +79,7 @@ _COL = {f: i for i, f in enumerate(FIELDS)}
 # string "family:detail" counts under its family)
 TRIGGERS = (
     "slo_breach", "watchdog", "deadline_shed_burst", "anomaly",
-    "manual", "scenario",
+    "manual", "scenario", "kv_leak",
 )
 
 

@@ -106,3 +106,14 @@ def device_transfer_kv(
     # 3. scatter into the destination pool, in place
     with dst_engine._kv_lock:
         dst_engine.kv = dst_engine._inject_fn(dst_engine.kv, dst_slots, *rows)
+
+    # custody churn stamps (engine/kv_ledger.py): pages moved out of the
+    # source pool / into the destination pool this transfer. Page refs
+    # are caller-managed on both ends, so this is telemetry, not a hold.
+    for eng, event, pids in (
+        (src_engine, "xfer_out", src_page_ids),
+        (dst_engine, "xfer_in", dst_page_ids),
+    ):
+        ledger = getattr(eng, "kv_ledger", None)
+        if ledger is not None:
+            ledger.note_transfer(event, len(pids))

@@ -64,7 +64,7 @@ class XProcKvBridge:
     collective in a multi-host serving step runs.
     """
 
-    def __init__(self, mesh, role: str):
+    def __init__(self, mesh, role: str, ledger=None):
         if tuple(mesh.axis_names) != ("host", "dev"):
             raise ValueError("transfer mesh must have ('host', 'dev') axes")
         if mesh.shape["host"] != 2:
@@ -73,6 +73,9 @@ class XProcKvBridge:
             raise ValueError(f"role {role!r}: expected 'prefill' or 'decode'")
         self.mesh = mesh
         self.role = role
+        # optional KvLedger (engine/kv_ledger.py): each transfer_kv
+        # stamps xfer_out/xfer_in churn on this process's ledger
+        self.ledger = ledger
         self.lanes = mesh.shape["dev"]
         self._row = 0 if role == "prefill" else 1
         self._my_devices = list(mesh.devices[self._row])
@@ -182,4 +185,8 @@ class XProcKvBridge:
             )
             if rs is not None:
                 rks, rvs = rs[:t], rs[t:]
+        if self.ledger is not None:
+            self.ledger.note_transfer(
+                "xfer_out" if self.role == "prefill" else "xfer_in", t
+            )
         return rk, rv, rks, rvs

@@ -438,6 +438,15 @@ class DisaggDecodeWorker:
         # the pre-push deadline check above must not read as a phantom
         # remote prefill in the scrape-visible ledger
         self.remote_prefills += 1
+        # custody window (engine/kv_ledger.py): remote-prefill KV is in
+        # flight toward this worker from push until landed/abandoned —
+        # a handoff that never drains shows up as inflight_expired
+        kvled = getattr(self.engine, "kv_ledger", None)
+        if kvled is not None:
+            kvled.inflight_begin(
+                f"disagg:{rid}", owner=request.id, plane="disagg",
+                deadline_s=wait_s + 5.0,
+            )
         await self.queue.push(req)
         try:
             await asyncio.wait_for(pending.ready.wait(), timeout=wait_s)
@@ -457,6 +466,8 @@ class DisaggDecodeWorker:
             )
         finally:
             self._pending.pop(rid, None)
+            if kvled is not None:
+                kvled.inflight_end(f"disagg:{rid}")
         k = np.concatenate([pending.parts[i][0] for i in range(pending.total)])
         v = np.concatenate([pending.parts[i][1] for i in range(pending.total)])
         ks = vs = None

@@ -275,6 +275,12 @@ class EngineMetrics:
         fr = getattr(self.engine, "flight", None)
         if fr is not None:
             yield from fr.render_prom()
+        # custody ledger (engine/kv_ledger.py): transitions/violations/
+        # audits counter families, zero-series declared at construction
+        # (scripts/check_prom.py pins these rendering too)
+        ledger = getattr(self.engine, "kv_ledger", None)
+        if ledger is not None:
+            yield from ledger.render_prom()
         if self.slo is not None:
             yield from self.slo.render()
 

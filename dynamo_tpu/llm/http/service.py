@@ -131,6 +131,7 @@ class HttpService:
                 web.get("/metrics", self._metrics),
                 web.get("/debug/trace", self._debug_trace),
                 web.get("/debug/snapshot", self._debug_snapshot),
+                web.get("/debug/kv", self._debug_kv),
                 web.post("/debug/profile", self._debug_profile),
                 web.get("/health", self._health),
                 web.get("/live", self._health),
@@ -238,6 +239,21 @@ class HttpService:
         return web.json_response(
             {"recorders": len(arts), "artifacts": arts}
         )
+
+    async def _debug_kv(self, request: web.Request) -> web.Response:
+        """KV page-custody snapshot (docs/observability.md "KV ledger"):
+        every registered ledger reports tier breakdown, per-tenant
+        attribution, top-N holders (``?top=N``, default 10), eviction
+        churn, open in-flight windows, and the bounded violation log —
+        live custody truth without an artifact dump."""
+        from dynamo_tpu.engine import kv_ledger
+
+        try:
+            top_n = int(request.query.get("top", "") or 10)
+        except ValueError:
+            return _error_response(400, "invalid top= (want an int)")
+        ledgers = [led.snapshot(top_n=top_n) for led in kv_ledger.registered()]
+        return web.json_response({"ledgers": len(ledgers), "kv": ledgers})
 
     async def _debug_profile(self, request: web.Request) -> web.Response:
         """On-demand on-device profiling (``POST /debug/profile?``

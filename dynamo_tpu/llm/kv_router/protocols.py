@@ -107,6 +107,11 @@ class ForwardPassMetrics:
     # prefill-queue depth) — empty on aggregated workers; from_dict
     # tolerates both (metrics_export renders them as labeled gauges)
     disagg: dict = field(default_factory=dict)
+    # KV custody-ledger summary (engine/kv_ledger.py summary_counts():
+    # violations/orphan_pages/audits/inflight/...) — the fleet's leak
+    # census rides the same stats scrape as everything else; empty on
+    # engines without a ledger, from_dict tolerates both
+    kv_ledger: dict = field(default_factory=dict)
 
     def to_dict(self) -> dict:
         return asdict(self)
@@ -114,7 +119,7 @@ class ForwardPassMetrics:
     @classmethod
     def from_dict(cls, d: dict) -> "ForwardPassMetrics":
         known = {f: d.get(f) for f in cls.__dataclass_fields__ if f in d}
-        for optional in ("slo_attainment", "disagg"):
+        for optional in ("slo_attainment", "disagg", "kv_ledger"):
             if known.get(optional) is None:
                 known.pop(optional, None)
         return cls(**known)

@@ -78,6 +78,7 @@ class KvMetricsPublisher:
         source: Optional[Callable[[], dict]] = None,
         slo: Optional[object] = None,
         disagg_source: Optional[Callable[[], dict]] = None,
+        ledger_source: Optional[Callable[[], dict]] = None,
     ):
         self._source = source
         # llm/http/metrics.SloTracker (duck-typed: anything with a
@@ -89,6 +90,10 @@ class KvMetricsPublisher:
         # remote/local prefill counts + live queue depth ride the same
         # reply so the disagg decision plane is scrape-visible too
         self._disagg = disagg_source
+        # engine/kv_ledger.KvLedger.summary_counts (duck-typed
+        # callable): the worker's custody-census summary rides the same
+        # reply — fleet leak visibility without a second scrape plane
+        self._ledger = ledger_source
         self.current = ForwardPassMetrics()
 
     @classmethod
@@ -98,7 +103,11 @@ class KvMetricsPublisher:
         slo: Optional[object] = None,
         disagg_source: Optional[Callable[[], dict]] = None,
     ) -> "KvMetricsPublisher":
-        return cls(source=engine.metrics, slo=slo, disagg_source=disagg_source)
+        ledger = getattr(engine, "kv_ledger", None)
+        return cls(
+            source=engine.metrics, slo=slo, disagg_source=disagg_source,
+            ledger_source=ledger.summary_counts if ledger is not None else None,
+        )
 
     def publish(self, metrics: ForwardPassMetrics) -> None:
         self.current = metrics
@@ -119,4 +128,9 @@ class KvMetricsPublisher:
             except Exception:  # noqa: BLE001 — stats must never fail on
                 # disagg counters either
                 log.exception("disagg stats failed; sending without them")
+        if self._ledger is not None:
+            try:
+                self.current.kv_ledger = dict(self._ledger())
+            except Exception:  # noqa: BLE001 — nor on the custody census
+                log.exception("kv ledger stats failed; sending without them")
         return self.current.to_dict()

@@ -38,7 +38,7 @@ import numpy as np
 
 from dynamo_tpu.llm.disagg import LAYERS_PER_PART, _np_from_wire, _np_to_wire
 from dynamo_tpu.runtime.pipeline.context import Context
-from dynamo_tpu.utils import counters, tracing
+from dynamo_tpu.utils import counters, faults, tracing
 from dynamo_tpu.utils.logging import get_logger
 
 log = get_logger("dynamo_tpu.kv_pull")
@@ -74,6 +74,8 @@ class KvExportHandler:
             self.engine.export_prefix, token_ids, d.get("hashes")
         )
 
+        ledger = getattr(self.engine, "kv_ledger", None)
+
         async def _stream() -> AsyncIterator[bytes]:
             if out is None:
                 yield msgpack.packb({"n_tokens": 0, "parts": 0})
@@ -81,8 +83,20 @@ class KvExportHandler:
             n_tokens, k, v, ks, vs = out
             n_layers = k.shape[0]
             parts = -(-n_layers // LAYERS_PER_PART)
+            # custody window: the stream carries extracted KV off this
+            # worker; closed ONLY on clean completion — an abandoned or
+            # faulted stream leaves the window dangling, and the ledger
+            # audit flags it as inflight_expired past its deadline
+            # (docs/observability.md "KV ledger")
+            key = f"export:{ctx.id}"
+            if ledger is not None:
+                ledger.inflight_begin(key, owner=ctx.id, plane="kv_export")
             yield msgpack.packb({"n_tokens": int(n_tokens), "parts": parts})
             for p in range(parts):
+                # chaos hook: an injected failure drops the stream
+                # mid-frame — the puller sees a truncated pull and
+                # recomputes; the dangling window is the leak signal
+                faults.fire("kv_export.frame")
                 lo, hi = p * LAYERS_PER_PART, min((p + 1) * LAYERS_PER_PART, n_layers)
                 frame: dict = {
                     "part": p,
@@ -94,6 +108,8 @@ class KvExportHandler:
                     frame["ks"] = _np_to_wire(np.ascontiguousarray(ks[lo:hi]))
                     frame["vs"] = _np_to_wire(np.ascontiguousarray(vs[lo:hi]))
                 yield msgpack.packb(frame, use_bin_type=True)
+            if ledger is not None:
+                ledger.inflight_end(key)
 
         return _stream()
 
@@ -177,6 +193,15 @@ class PrefixPuller:
             wait_s = min(wait_s, remaining)
         counters.inc("kv_pull_attempts_total")
         t0 = time.perf_counter()
+        # puller-side custody window: bounded by wait_for, so it always
+        # ends — the stamp makes a wedged pull attributable in /debug/kv
+        ledger = getattr(self.engine, "kv_ledger", None)
+        key = f"pull:{request.id}"
+        if ledger is not None:
+            ledger.inflight_begin(
+                key, owner=request.id, plane="kv_pull",
+                deadline_s=wait_s + 5.0,
+            )
         try:
             n = await asyncio.wait_for(
                 self._pull(request, holder, prefix), timeout=wait_s
@@ -189,6 +214,9 @@ class PrefixPuller:
                 holder, exc,
             )
             return
+        finally:
+            if ledger is not None:
+                ledger.inflight_end(key)
         if tracing.enabled():
             tracing.complete(
                 "kv.pull", t0, time.perf_counter(), cat="kv",

@@ -31,6 +31,7 @@ from dynamo_tpu.llm.protocols.common import (
     SamplingOptions,
     StopConditions,
 )
+from dynamo_tpu.engine.kv_ledger import quiesce_census
 from dynamo_tpu.loadgen.driver import LedgerJoin, engine_submitter, replay
 from dynamo_tpu.loadgen.http import engine_http_service, http_submitter
 from dynamo_tpu.loadgen.prompts import PromptFactory
@@ -217,9 +218,12 @@ async def _replay_and_score(
         # its loop; one tick is enough in-process)
         await asyncio.sleep(0)
         ledger.apply(results)
+        # custody census before the engine goes away: every
+        # engine-backed scenario section carries a zero-orphan proof
+        census = await asyncio.to_thread(quiesce_census, [engine], 5.0)
         score = score_results(
             results, wall, slo_ttft_s=scale.slo_ttft_s,
-            slo_itl_s=scale.slo_itl_s,
+            slo_itl_s=scale.slo_itl_s, kv_census=census,
         )
         return _section(name, trace, score, **extra), results
     finally:

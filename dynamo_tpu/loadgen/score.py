@@ -37,9 +37,14 @@ def score_results(
     slo_ttft_s: float = 2.0,
     slo_itl_s: Optional[float] = None,
     n_chips: int = 1,
+    kv_census: Optional[dict] = None,
 ) -> dict:
     """Score one replay: latency percentiles, throughput, SLO-gated
-    goodput, shed/error accounting, open-loop proof, reuse-ledger sums."""
+    goodput, shed/error accounting, open-loop proof, reuse-ledger sums.
+
+    `kv_census` (engine/kv_ledger.quiesce_census output) rides the
+    section verbatim when provided — the zero-orphan gate scores next
+    to goodput so a run that leaked pages cannot headline clean."""
     ok = [r for r in results if r.status == STATUS_OK]
     shed = [r for r in results if r.status == STATUS_SHED]
     errors = [r for r in results if r.status == STATUS_ERROR]
@@ -109,4 +114,5 @@ def score_results(
             ),
         },
         "wall_s": round(wall_s, 4),
+        **({"kv_census": kv_census} if kv_census is not None else {}),
     }

@@ -184,6 +184,14 @@ class MetricsExporter:
                     gauge(f"disagg_{key}", float(val), lab)
                 except (TypeError, ValueError):
                     continue
+            # KV custody census (KvLedger.summary_counts riding
+            # ForwardPassMetrics.kv_ledger): violations/orphans/audits/
+            # in-flight windows per worker — fleet leak visibility
+            for key, val in sorted((m.kv_ledger or {}).items()):
+                try:
+                    gauge(f"kv_ledger_{key}", float(val), lab)
+                except (TypeError, ValueError):
+                    continue
         loads = [m.kv_active_blocks for m in eps.values()]
         gauge("load_avg", statistics.fmean(loads) if loads else 0.0)
         gauge("load_std", statistics.pstdev(loads) if len(loads) > 1 else 0.0)

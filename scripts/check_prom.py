@@ -171,6 +171,9 @@ REQUIRED_SPAWN_FAMILIES = (
     "dynamo_tpu_flight_recorder_suppressed_total",
     "dynamo_tpu_profiler_captures_total",
     "dynamo_tpu_engine_flight_digests",
+    "dynamo_tpu_kv_ledger_transitions_total",
+    "dynamo_tpu_kv_ledger_violations_total",
+    "dynamo_tpu_kv_ledger_audits_total",
 )
 
 

@@ -57,6 +57,7 @@ sys.path.insert(
     0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 )
 
+from dynamo_tpu.engine.kv_ledger import quiesce_census  # noqa: E402
 from dynamo_tpu.llm.planner import (  # noqa: E402
     Planner,
     PlannerConfig,
@@ -391,6 +392,10 @@ async def run_scenario(connector: str = "supervisor", **overrides) -> dict:
             "final_workers": watcher.numprocesses,
         },
         "drain": {"clean": drain_clean, "events": drain_events},
+        # workers are subprocess Sim engines — no in-process paged KV —
+        # so the quiesce census is the honest degenerate one (zero
+        # engines, zero orphans); any in-process ledger would be scored
+        "kv_census": await asyncio.to_thread(quiesce_census, []),
         "requests": len(results),
         "timeline": timeline,
     }
@@ -417,6 +422,7 @@ def main(argv=None) -> int:
         out["scaling"]["ups"] >= 1
         and out["time_to_recover_s"] is not None
         and out["drain"]["clean"]
+        and out["kv_census"]["ok"]
     )
     if not ok:
         print(

@@ -61,6 +61,7 @@ sys.path.insert(
 
 import numpy as np  # noqa: E402
 
+from dynamo_tpu.engine.kv_ledger import quiesce_census  # noqa: E402
 from dynamo_tpu.runtime.component import EndpointId  # noqa: E402
 from dynamo_tpu.runtime.distributed import DistributedRuntime  # noqa: E402
 from dynamo_tpu.runtime.hub.server import HubServer  # noqa: E402
@@ -129,9 +130,16 @@ def _cfgs(d: dict):
 
 
 @contextlib.asynccontextmanager
-async def _fleet(d: dict, n_workers: int, poll_interval: float):
+async def _fleet(
+    d: dict, n_workers: int, poll_interval: float, census_out=None
+):
     """Hub + n real workers (full KV plane) + the frontend failover
-    stack behind a live HttpService; yields a handle dict."""
+    stack behind a live HttpService; yields a handle dict.
+
+    With `census_out` (a list), the teardown runs the zero-orphan
+    quiesce census over the leg's engines BEFORE closing them and
+    appends the result — a chaos-killed worker's engine outlives its
+    data plane, so its severed streams' pages must still drain."""
     from dynamo_tpu.engine import JaxEngine
     from dynamo_tpu.llm.http.discovery import RouterEngine
     from dynamo_tpu.llm.http.failover import FailoverConfig, FailoverEngine
@@ -200,6 +208,11 @@ async def _fleet(d: dict, n_workers: int, poll_interval: float):
                 "isl": isl,
             }
     finally:
+        if census_out is not None:
+            with contextlib.suppress(Exception):
+                census_out.append(
+                    await asyncio.to_thread(quiesce_census, engines)
+                )
         for e in engines:
             with contextlib.suppress(Exception):
                 await e.close()
@@ -403,9 +416,12 @@ async def run_scenario(**overrides) -> dict:
         ]
 
     legs: dict[str, dict] = {}
+    censuses: list[dict] = []
     try:
         # ---- leg 1: cold (DYN_FAULTS kill, recompute replay) ----------
-        async with _fleet(d, 2, d["poll_interval_s"]) as fleet:
+        async with _fleet(
+            d, 2, d["poll_interval_s"], census_out=censuses
+        ) as fleet:
             await _warm_compile(fleet, d, rng)
             async with aiohttp.ClientSession(
                 f"http://127.0.0.1:{fleet['svc'].port}"
@@ -437,7 +453,9 @@ async def run_scenario(**overrides) -> dict:
 
         # ---- leg 2: reuse (prefix warm fleet-wide; replay rides the
         # survivor's cache) ---------------------------------------------
-        async with _fleet(d, 2, d["poll_interval_s"]) as fleet:
+        async with _fleet(
+            d, 2, d["poll_interval_s"], census_out=censuses
+        ) as fleet:
             await _warm_compile(fleet, d, rng)
             prefix = rng.randint(
                 1, mcfg.vocab_size, size=d["prefix_pages"] * d["page"]
@@ -467,7 +485,9 @@ async def run_scenario(**overrides) -> dict:
 
         # ---- leg 3: pull (prefix only on a saturated holder; the
         # replay PULLS it instead of recomputing) -----------------------
-        async with _fleet(d, 3, d["pull_poll_interval_s"]) as fleet:
+        async with _fleet(
+            d, 3, d["pull_poll_interval_s"], census_out=censuses
+        ) as fleet:
             await _warm_compile(fleet, d, rng)
             prefix = rng.randint(
                 1, mcfg.vocab_size, size=d["prefix_pages"] * d["page"]
@@ -558,6 +578,23 @@ async def run_scenario(**overrides) -> dict:
         k: sum(leg["tokens"][k] for leg in legs.values())
         for k in ("recompute", "reused", "pull")
     }
+    # zero-orphan gate: every leg's fleet drained custody at teardown —
+    # a chaos kill that stranded KV pages fails the proof even when all
+    # the streams came back byte-identical
+    cviol: dict[str, int] = {}
+    for c in censuses:
+        for k, v in (c.get("violations") or {}).items():
+            cviol[k] = cviol.get(k, 0) + int(v)
+    kv_census = {
+        "fleets": len(censuses),
+        "engines": sum(c["engines"] for c in censuses),
+        "ok": bool(censuses) and all(c["ok"] for c in censuses),
+        "orphan_pages": sum(
+            len(c.get("orphan_pages") or []) for c in censuses
+        ),
+        "violations": cviol,
+        "per_fleet": censuses,
+    }
     return {
         "scenario": {
             k: d[k]
@@ -574,6 +611,7 @@ async def run_scenario(**overrides) -> dict:
             round(float(np.percentile(gaps, 50)), 4) if gaps else None
         ),
         "tokens": tokens,
+        "kv_census": kv_census,
     }
 
 
@@ -590,6 +628,7 @@ def proof_ok(out: dict) -> bool:
         and legs["cold"]["tokens"]["recompute"] > 0
         and legs["reuse"]["tokens"]["reused"] > 0
         and legs["pull"]["tokens"]["pull"] > 0
+        and out["kv_census"]["ok"]
     )
 
 

@@ -55,6 +55,7 @@ sys.path.insert(
 
 import numpy as np  # noqa: E402
 
+from dynamo_tpu.engine.kv_ledger import quiesce_census  # noqa: E402
 from dynamo_tpu.runtime.component import EndpointId  # noqa: E402
 from dynamo_tpu.runtime.distributed import DistributedRuntime  # noqa: E402
 from dynamo_tpu.runtime.hub.server import HubServer  # noqa: E402
@@ -344,6 +345,10 @@ async def run_scenario(**overrides) -> dict:
             for k in pull_counters0
         }
         pulls["tokens_moved"] = sum(p.pull_tokens for p in pullers)
+        # zero-orphan quiesce census (engine/kv_ledger.py): every page
+        # the phases touched must be back to free/cached custody before
+        # teardown — a leak here fails the bench, not just a dashboard
+        census = await asyncio.to_thread(quiesce_census, engines)
         return {
             "scenario": {
                 k: d[k]
@@ -371,6 +376,7 @@ async def run_scenario(**overrides) -> dict:
                 "pull_phase_wall_s": round(pull_wall, 4),
                 **_phase_dollars(total_tokens, total_wall, usd),
             },
+            "kv_census": census,
         }
     finally:
         for e in engines:
@@ -397,5 +403,6 @@ if __name__ == "__main__":
         out["warm_reuse_frac"] > 0
         and out["pulls"]["landed"] >= 1
         and out["router_blocks"] > 0
+        and out["kv_census"]["ok"]
     )
     sys.exit(0 if ok else 1)

@@ -1,0 +1,612 @@
+"""KV page-lifecycle ledger (docs/observability.md "KV ledger"):
+release-misuse taxonomy (double_release / unknown_page counted, never
+corrupting), the seeded allocator fuzz against a pure-Python model,
+custody holdings + orphan detection, confirm-twice audit semantics,
+in-flight transfer windows, census-under-faults (a DYN_FAULTS-skipped
+release is detected within one audit period and attributed in ONE
+flight artifact), the quiesce census gate, and the /debug/kv surface.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import glob
+import json
+import random
+import time
+from collections import OrderedDict, deque
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine import EngineConfig, JaxEngine
+from dynamo_tpu.engine.allocator import PageAllocator
+from dynamo_tpu.engine.kv_ledger import (
+    TRANSITION_EVENTS,
+    VIOLATION_KINDS,
+    KvLedger,
+    quiesce_census,
+    registered,
+)
+from dynamo_tpu.llm.protocols.common import (
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.models import config as cfgmod
+from dynamo_tpu.runtime.pipeline.context import Context
+from dynamo_tpu.utils import faults
+
+CFG = cfgmod.get_config("tiny")
+PAGE = 8
+
+
+def make_engine(**kw) -> JaxEngine:
+    defaults = dict(
+        model=CFG,
+        dtype="float32",
+        page_size=PAGE,
+        num_pages=64,
+        max_batch_size=4,
+        max_model_len=128,
+        prefill_chunk=32,
+        seed=0,
+    )
+    defaults.update(kw)
+    return JaxEngine(EngineConfig(**defaults))
+
+
+def greedy_request(prompt, max_tokens=8) -> PreprocessedRequest:
+    return PreprocessedRequest(
+        token_ids=list(prompt),
+        stop_conditions=StopConditions(max_tokens=max_tokens, ignore_eos=True),
+        sampling_options=SamplingOptions(greedy=True),
+    )
+
+
+async def serve(engine, prompt, request_id=None, max_tokens=8):
+    ctx = Context(
+        greedy_request(prompt, max_tokens).to_dict(), request_id=request_id
+    )
+    return [f async for f in await engine.generate(ctx)]
+
+
+# ------------------------------------------------- release misuse (typed)
+
+
+def test_unknown_page_release_counted_not_silent():
+    alloc = PageAllocator(8, PAGE)
+    alloc.release([99])
+    alloc.release([0])  # the reserved trash page has no meta either
+    assert alloc.release_violations["unknown_page"] == 2
+    assert alloc.release_violations["double_release"] == 0
+    # no state was mutated
+    assert alloc.pages_free == 7 and alloc.num_active == 0
+
+
+def test_double_release_cached_page_counted_not_corrupting():
+    alloc = PageAllocator(8, PAGE)
+    (pid,) = alloc.allocate(1)
+    alloc.register([pid], [(111, 1)], None)
+    alloc.release([pid])  # refs 1 -> 0: hashed page parks in the cache
+    assert alloc.pages_cached == 1
+    alloc.release([pid])  # misuse: refs already 0
+    assert alloc.release_violations["double_release"] == 1
+    # the old behavior drove refs negative and re-cached/re-freed the
+    # page; now the page stays cached exactly once and the pool identity
+    # holds
+    assert alloc.pages_cached == 1 and alloc.pages_free == 6
+    assert alloc._meta[pid].refs == 0
+    assert len(alloc._free) + len(alloc._meta) == alloc.num_pages - 1
+
+
+def test_double_release_no_free_list_duplication():
+    """Regression: a double release must never re-free a page — the old
+    refs-negative path could hand the same page to two sequences."""
+    alloc = PageAllocator(8, PAGE)
+    (pid,) = alloc.allocate(1)
+    alloc.release([pid])          # unhashed: freed immediately
+    alloc.release([pid])          # meta gone -> unknown_page, not a re-free
+    assert alloc.release_violations["unknown_page"] == 1
+    got = alloc.allocate(7)
+    assert got is not None and len(set(got)) == 7
+    assert alloc.allocate(1) is None
+
+
+def test_double_release_single_on_cached_fire():
+    fired = []
+    alloc = PageAllocator(8, PAGE, on_cached=lambda pid, meta: fired.append(pid))
+    (pid,) = alloc.allocate(1)
+    alloc.register([pid], [(42, 2)], None)
+    alloc.release([pid])
+    alloc.release([pid])
+    # exactly one offload write-through enqueue, not two
+    assert fired == [pid]
+
+
+def test_release_misuse_forwards_to_ledger():
+    ledger = KvLedger()
+    alloc = PageAllocator(8, PAGE, ledger=ledger)
+    (pid,) = alloc.allocate(1)
+    alloc.register([pid], [(7, 7)], None)
+    alloc.release([pid])
+    alloc.release([pid])
+    alloc.release([98, 99])
+    assert alloc.release_violations == {
+        "double_release": 1, "unknown_page": 2,
+    }
+    assert ledger.violations_total == 3
+    kinds = [v.kind for v in ledger.violations_log]
+    assert kinds.count("double_release") == 1
+    assert kinds.count("unknown_page") == 2
+
+
+# ------------------------------------------------- seeded allocator fuzz
+
+
+class _ModelAlloc:
+    """Pure-Python reference model of PageAllocator semantics."""
+
+    def __init__(self, num_pages: int):
+        self.num_pages = num_pages
+        self.free = deque(range(1, num_pages))
+        self.meta: dict[int, list] = {}  # pid -> [refs, seq_hash]
+        self.by_hash: dict[int, int] = {}
+        self.lru: OrderedDict[int, int] = OrderedDict()
+        self.viol = {"double_release": 0, "unknown_page": 0}
+
+    def allocate(self, n):
+        if n > len(self.free) + len(self.lru):
+            return None
+        while len(self.free) < n:
+            h, pid = self.lru.popitem(last=False)
+            del self.meta[pid]
+            del self.by_hash[h]
+            self.free.append(pid)
+        pages = [self.free.popleft() for _ in range(n)]
+        for pid in pages:
+            self.meta[pid] = [1, None]
+        return pages
+
+    def register(self, pid, sh):
+        ent = self.meta[pid]
+        if ent[1] is not None:
+            return
+        ent[1] = sh
+        if sh not in self.by_hash:
+            self.by_hash[sh] = pid
+
+    def pin(self, sh):
+        pid = self.by_hash.get(sh)
+        if pid is None:
+            return None
+        if self.meta[pid][0] == 0:
+            self.lru.pop(sh, None)
+        self.meta[pid][0] += 1
+        return pid
+
+    def release(self, pid):
+        ent = self.meta.get(pid)
+        if ent is None:
+            self.viol["unknown_page"] += 1
+            return
+        if ent[0] <= 0:
+            self.viol["double_release"] += 1
+            return
+        ent[0] -= 1
+        if ent[0] > 0:
+            return
+        sh = ent[1]
+        if sh is not None and self.by_hash.get(sh) == pid:
+            self.lru[sh] = pid
+        else:
+            del self.meta[pid]
+            self.free.append(pid)
+
+    def clear(self):
+        for h, pid in self.lru.items():
+            del self.by_hash[h]
+            del self.meta[pid]
+            self.free.append(pid)
+        self.lru.clear()
+
+
+def _assert_states_equal(alloc: PageAllocator, model: _ModelAlloc):
+    assert list(alloc._free) == list(model.free)
+    assert {p: (m.refs, m.sequence_hash) for p, m in alloc._meta.items()} \
+        == {p: tuple(e) for p, e in model.meta.items()}
+    assert alloc._by_hash == model.by_hash
+    assert list(alloc._lru.items()) == list(model.lru.items())
+    assert alloc.release_violations == model.viol
+    # pool identity + index consistency after EVERY op
+    assert len(alloc._free) + len(alloc._meta) == alloc.num_pages - 1
+    assert set(alloc._lru.values()) <= set(alloc._meta)
+    for sh, pid in alloc._by_hash.items():
+        assert alloc._meta[pid].sequence_hash == sh
+    free_set = set(alloc._free)
+    assert len(free_set) == len(alloc._free)            # no duplicates
+    assert not (free_set & set(alloc._meta))            # disjoint planes
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_fuzz_allocator_against_model(seed):
+    rng = random.Random(seed)
+    num_pages = 24
+    ledger = KvLedger()
+    alloc = PageAllocator(num_pages, PAGE, ledger=ledger)
+    model = _ModelAlloc(num_pages)
+    # every reference we legitimately hold: (pid, owner)
+    refs: list[tuple[int, str]] = []
+    next_hash = 1000
+
+    for step in range(800):
+        op = rng.random()
+        owner = f"req-{rng.randrange(5)}"
+        if op < 0.30:
+            n = rng.randrange(1, 5)
+            got = alloc.allocate(n)
+            want = model.allocate(n)
+            assert (got is None) == (want is None)
+            if got is not None:
+                assert got == want
+                refs.extend((pid, owner) for pid in got)
+                ledger.hold(got, owner)
+        elif op < 0.45:
+            # register an unregistered active page (sometimes a
+            # duplicate hash: two sequences computed the same block)
+            cands = [p for p, m in alloc._meta.items()
+                     if m.refs > 0 and m.sequence_hash is None]
+            if cands:
+                pid = rng.choice(cands)
+                if rng.random() < 0.2 and model.by_hash:
+                    sh = rng.choice(list(model.by_hash))
+                else:
+                    next_hash += 1
+                    sh = next_hash
+                alloc.register([pid], [(sh, sh)], None)
+                model.register(pid, sh)
+        elif op < 0.60:
+            if model.by_hash:
+                sh = rng.choice(list(model.by_hash))
+                got = alloc.pin(sh)
+                want = model.pin(sh)
+                assert got == want
+                if got is not None:
+                    refs.append((got, owner))
+                    ledger.hold([got], owner)
+        elif op < 0.85:
+            if refs:
+                pid, ref_owner = refs.pop(rng.randrange(len(refs)))
+                alloc.release([pid])
+                model.release(pid)
+                ledger.drop([pid], ref_owner)
+        elif op < 0.90:
+            alloc.clear_cache()
+            model.clear()
+        elif op < 0.95:
+            # misuse injection that cannot perturb holdings: a cached
+            # (refs==0) page double-release, or an unknown id
+            if model.lru and rng.random() < 0.5:
+                pid = rng.choice(list(model.lru.values()))
+            else:
+                pid = rng.choice(list(model.free)) if model.free else 999
+            alloc.release([pid])
+            model.release(pid)
+        else:
+            assert alloc.num_free == len(model.free) + len(model.lru)
+            assert alloc.pages_used == \
+                len(model.meta) - len(model.lru)
+        _assert_states_equal(alloc, model)
+
+    # holdings mirrored the refcounts throughout: a double audit (the
+    # confirm-twice pass) raises nothing
+    assert ledger.audit() == []
+    assert ledger.audit() == []
+    assert ledger.transition_counts["alloc"] > 0
+
+
+# ------------------------------------------------- holdings + audit
+
+
+def test_orphan_detected_first_audit_with_attribution():
+    alloc = PageAllocator(16, PAGE)
+    ledger = KvLedger(allocator=alloc)
+    alloc.ledger = ledger
+    pages = alloc.allocate(3)
+    ledger.hold(pages, "req-leak", tenant="team-a")
+    ledger.request_finished("req-leak")
+    out = ledger.audit()
+    assert [v.kind for v in out] == ["orphan_page"]
+    assert out[0].owner == "req-leak"
+    assert out[0].page_ids == sorted(pages)
+    assert ledger.last_orphans == sorted(pages)
+    # dedup: the same incident does not re-fire on the next audit
+    assert ledger.audit() == []
+    snap = ledger.snapshot()
+    assert snap["orphan_pages"] == sorted(pages)
+    assert snap["tenants"] == {"team-a": 3}
+    assert str(pages[0]) in snap["orphan_trails"]
+    json.dumps(snap)  # /debug/kv must be serializable
+
+
+def test_clean_lifecycle_audits_quiet():
+    alloc = PageAllocator(16, PAGE)
+    ledger = KvLedger(allocator=alloc)
+    alloc.ledger = ledger
+    pages = alloc.allocate(2)
+    ledger.hold(pages, "req-ok")
+    alloc.register(pages, [(1, 1), (2, 2)], None)
+    assert ledger.audit() == []
+    ledger.drop(pages, "req-ok")
+    alloc.release(pages)
+    ledger.request_finished("req-ok")  # after the drop: not watched
+    assert ledger.audit() == []
+    assert ledger.audit() == []
+    assert ledger.violations_total == 0
+    assert ledger.audits_total == 3
+
+
+def test_holdings_mismatch_requires_two_audits():
+    alloc = PageAllocator(16, PAGE)
+    ledger = KvLedger(allocator=alloc)
+    pages = alloc.allocate(1)
+    # allocator says refs=1, the ledger recorded nothing (a racy
+    # mid-operation snapshot must not fire on the first audit)
+    assert ledger.audit() == []
+    out = ledger.audit()
+    assert [v.kind for v in out] == ["holdings_mismatch"]
+    assert out[0].page_ids == pages
+    # resolving the mismatch un-flags: a later regression re-fires
+    ledger.hold(pages, "req-x")
+    assert ledger.audit() == []
+    assert ledger.audit() == []
+
+
+def test_inverse_holdings_check_hold_on_freed_page():
+    alloc = PageAllocator(16, PAGE)
+    ledger = KvLedger(allocator=alloc)
+    pages = alloc.allocate(1)
+    ledger.hold(pages, "req-y")
+    alloc.release(pages)  # freed while the ledger still holds it
+    assert ledger.audit() == []
+    out = ledger.audit()
+    assert [v.kind for v in out] == ["holdings_mismatch"]
+    assert "req-y" in out[0].owner
+
+
+def test_identity_violation_on_pool_corruption():
+    alloc = PageAllocator(8, PAGE)
+    ledger = KvLedger(allocator=alloc)
+    pages = alloc.allocate(1)
+    ledger.hold(pages, "r")
+    alloc._free.pop()  # simulate free-list corruption
+    assert ledger.audit() == []
+    out = ledger.audit()
+    assert "identity" in [v.kind for v in out]
+
+
+def test_host_orphan_confirm_twice():
+    class FakeHostPool:
+        _entries = {123: object()}
+
+        def __len__(self):
+            return len(self._entries)
+
+    ledger = KvLedger(host_pool=FakeHostPool())
+    ledger.host_stored(123)
+    ledger.host_stored(456)  # custody with no index entry
+    assert ledger.audit() == []
+    out = ledger.audit()
+    assert [v.kind for v in out] == ["host_orphan"]
+    # symmetric: fixing custody clears the suspect
+    ledger.host_removed(456)
+    assert ledger.audit() == []
+    assert ledger.audit() == []
+
+
+def test_inflight_window_expiry_and_clean_end():
+    ledger = KvLedger(inflight_deadline_s=30.0)
+    ledger.inflight_begin("pull:a", owner="req-a", plane="kv_pull")
+    ledger.inflight_begin("pull:b", owner="req-b", plane="kv_pull",
+                          deadline_s=120.0)
+    assert ledger.audit() == []           # neither expired yet
+    ledger.inflight_end("pull:b")
+    out = ledger.audit(now=time.monotonic() + 60.0)
+    assert [v.kind for v in out] == ["inflight_expired"]
+    assert out[0].owner == "req-a"
+    # expired-window dedup, and ending it clears the flag for reuse
+    assert ledger.audit(now=time.monotonic() + 61.0) == []
+    ledger.inflight_end("pull:a")
+    assert len(ledger._inflight) == 0
+
+
+def test_reacquired_owner_is_live_again():
+    """Failover re-admission: a finished request that re-acquires pages
+    (the replay) must not be flagged from the stale finished watch."""
+    alloc = PageAllocator(16, PAGE)
+    ledger = KvLedger(allocator=alloc)
+    pages = alloc.allocate(1)
+    ledger.hold(pages, "req-r")
+    ledger.request_finished("req-r")
+    ledger.hold(pages, "req-r")  # re-admitted before the audit ran
+    assert ledger.audit() == []
+    ledger.drop(pages, "req-r")
+    ledger.drop(pages, "req-r")  # second drop of same ref is a no-op
+    alloc.release(pages)
+    assert ledger.audit() == []
+    assert ledger.audit() == []
+
+
+def test_prom_families_and_zero_series():
+    ledger = KvLedger()
+    lines = list(ledger.render_prom())
+    text = "\n".join(lines)
+    for fam in (
+        "dynamo_tpu_kv_ledger_transitions_total",
+        "dynamo_tpu_kv_ledger_violations_total",
+        "dynamo_tpu_kv_ledger_audits_total",
+    ):
+        assert f"# TYPE {fam} counter" in text
+    # zero-series for every taxonomy member so rate() alerts work
+    for kind in VIOLATION_KINDS:
+        assert f'kind="{kind}"' in text
+    for ev in TRANSITION_EVENTS:
+        assert f'event="{ev}"' in text
+
+
+# ------------------------------------------------- census under faults
+
+
+async def test_engine_release_fault_leak_detected_one_artifact(tmp_path):
+    """Satellite 3: a DYN_FAULTS point that skips one release is
+    detected within one audit period, attributed to the owning request,
+    and dumps exactly ONE flight artifact naming the orphaned pages."""
+    faults.reset()
+    engine = make_engine(kv_audit_s=0.05, crash_dir=str(tmp_path))
+    try:
+        rng = np.random.RandomState(0)
+        await serve(engine, rng.randint(1, CFG.vocab_size, size=20).tolist(),
+                    request_id="healthy-req")
+        assert engine.kv_ledger.violations_total == 0
+        faults.configure("engine.release.failx1")
+        await serve(engine, rng.randint(1, CFG.vocab_size, size=20).tolist(),
+                    request_id="leaky-req")
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline \
+                and engine.kv_ledger.violations_total == 0:
+            await asyncio.sleep(0.02)
+        log = list(engine.kv_ledger.violations_log)
+        assert log, "leak not detected within the audit window"
+        assert log[0].kind == "orphan_page"
+        assert log[0].owner == "leaky-req"
+        assert log[0].page_ids  # the orphaned pages are named
+        # exactly one correlated artifact
+        await asyncio.sleep(0.2)  # a storm would have dumped by now
+        arts = glob.glob(str(tmp_path / "flight_recorder_*.json"))
+        assert len(arts) == 1
+        doc = json.loads(open(arts[0]).read())
+        assert doc["reason"] == "kv_leak:orphan_page"
+        assert doc["request_id"] == "leaky-req"
+        kv = doc["context"]["kv_ledger"]
+        assert kv["orphan_pages"] == log[0].page_ids
+        assert kv["orphan_trails"]  # last custody transitions ride along
+        # engine metrics surface the census counters
+        m = engine.metrics()
+        assert m["kv_ledger_violations"] >= 1
+        assert m["kv_ledger_orphan_pages"] == len(log[0].page_ids)
+        assert m["kv_ledger_audits"] > 0
+        # the leaked pages fail the quiesce census with attribution
+        census = quiesce_census([engine], wait_s=0.2)
+        assert census["ok"] is False
+        assert census["engines"] == 1
+        per = census["per_engine"][0]
+        assert per["pages_held"] >= 1
+    finally:
+        faults.reset()
+        await engine.close()
+
+
+async def test_export_frame_drop_leaves_dangling_window(tmp_path):
+    """Satellite 3b: a dropped in-flight pull frame (kv_export.frame)
+    strands the custody window; the audit flags it inflight_expired."""
+    import msgpack
+
+    from dynamo_tpu.llm.kv_router.pull import KvExportHandler
+
+    faults.reset()
+    engine = make_engine(kv_audit_s=0.0)
+    try:
+        rng = np.random.RandomState(1)
+        tokens = rng.randint(1, CFG.vocab_size, size=2 * PAGE + 2).tolist()
+        await serve(engine, tokens, max_tokens=6)
+        handler = KvExportHandler(None, engine, "t", "backend")
+
+        async def pull(ctx_id):
+            ctx = Context(msgpack.packb({"token_ids": tokens}),
+                          request_id=ctx_id)
+            frames = []
+            async for b in await handler._handle(ctx):
+                frames.append(b)
+            return frames
+
+        # clean export closes its window
+        frames = await pull("clean-pull")
+        assert len(frames) >= 2
+        assert len(engine.kv_ledger._inflight) == 0
+        # faulted export: the stream dies mid-frame, window dangles
+        faults.configure("kv_export.frame.failx1")
+        with pytest.raises(faults.FaultError):
+            await pull("dropped-pull")
+        assert "export:dropped-pull" in engine.kv_ledger._inflight
+        out = engine.kv_ledger.audit(now=time.monotonic() + 60.0)
+        assert [v.kind for v in out] == ["inflight_expired"]
+        assert out[0].owner == "dropped-pull"
+        assert "kv_export" in out[0].detail
+    finally:
+        faults.reset()
+        await engine.close()
+
+
+# ------------------------------------------------- quiesce census
+
+
+def test_quiesce_census_empty_fleet_is_honest():
+    out = quiesce_census([])
+    assert out == {
+        "engines": 0, "ok": True, "orphan_pages": [],
+        "violations": {}, "per_engine": [],
+    }
+
+
+async def test_quiesce_census_clean_engine_ok():
+    engine = make_engine(kv_audit_s=0.0)
+    try:
+        rng = np.random.RandomState(2)
+        await serve(engine, rng.randint(1, CFG.vocab_size, size=20).tolist())
+        census = quiesce_census([engine], wait_s=2.0)
+        assert census["ok"] is True
+        assert census["engines"] == 1
+        assert census["orphan_pages"] == []
+        per = census["per_engine"][0]
+        assert per["pages_used"] == 0 and per["pages_held"] == 0
+    finally:
+        await engine.close()
+
+
+async def test_quiesce_census_skips_closed_engines():
+    engine = make_engine(kv_audit_s=0.0)
+    await engine.close()
+    out = quiesce_census([engine], wait_s=0.1)
+    assert out["engines"] == 0 and out["ok"] is True
+
+
+# ------------------------------------------------- /debug/kv surface
+
+
+async def test_debug_kv_endpoint(tmp_path):
+    import aiohttp
+
+    from dynamo_tpu.llm.http.service import HttpService
+
+    engine = make_engine(kv_audit_s=0.0)
+    svc = HttpService()
+    await svc.start("127.0.0.1", 0)
+    try:
+        assert engine.kv_ledger in registered()
+        async with aiohttp.ClientSession(f"http://127.0.0.1:{svc.port}") as s:
+            r = await s.get("/debug/kv")
+            assert r.status == 200
+            doc = await r.json()
+            assert doc["ledgers"] >= 1
+            snap = doc["kv"][-1]
+            for key in ("tiers", "tenants", "top_holders", "churn",
+                        "inflight", "violations", "orphan_pages", "summary"):
+                assert key in snap
+            assert snap["tiers"]["device"]["num_pages"] == 64
+            r = await s.get("/debug/kv?top=2")
+            assert r.status == 200
+            r = await s.get("/debug/kv?top=nope")
+            assert r.status == 400
+    finally:
+        await svc.stop()
+        await engine.close()
